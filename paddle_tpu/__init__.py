@@ -1,0 +1,76 @@
+"""paddle_tpu — a TPU-native deep learning framework with PaddlePaddle's
+capability surface.
+
+Not a port: the reference's L0-L4 (device runtime, allocators, kernel library,
+executors, IR passes — SURVEY.md §1) are replaced by JAX/XLA; this package keeps
+the reference's *programming model* (dygraph eager UX + static capture + fleet
+distributed API) on top of a mesh-sharded, jit-compiled core.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from paddle_tpu.core.dtype import (  # noqa: F401
+    DType, float32, float64, float16, bfloat16, int8, int16, int32, int64,
+    uint8, bool_, complex64, complex128, finfo, iinfo,
+)
+from paddle_tpu.core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from paddle_tpu.core.autograd import (  # noqa: F401
+    no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad,
+)
+from paddle_tpu.core.generator import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, Generator,
+)
+from paddle_tpu.core.flags import set_flags, get_flags  # noqa: F401
+
+from paddle_tpu import ops  # noqa: F401  (installs Tensor methods)
+from paddle_tpu.ops import *  # noqa: F401,F403
+
+# paddle-API namespaces (populated as subsystems land)
+from paddle_tpu import nn  # noqa: F401
+from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import autograd  # noqa: F401
+
+bool = bool_  # paddle.bool
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+def get_device() -> str:
+    import jax
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def set_device(device: str) -> str:
+    # single-logical-device eager; placement is mesh/sharding driven on TPU
+    return device
+
+
+def enable_static():
+    raise NotImplementedError(
+        "global static mode is replaced by paddle_tpu.jit.to_static / "
+        "paddle_tpu.static program capture")
+
+
+def disable_static():
+    pass
+
+
+def in_dynamic_mode() -> bool:
+    return True

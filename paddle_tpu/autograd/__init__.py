@@ -1,0 +1,5 @@
+"""paddle.autograd namespace parity (reference: python/paddle/autograd/)."""
+from paddle_tpu.core.autograd import (  # noqa: F401
+    backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+)
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401

@@ -1,0 +1,108 @@
+"""PyLayer — user-defined autograd ops.
+
+Parity with the reference's PyLayer (``paddle/fluid/eager/pylayer/``,
+``python/paddle/autograd/py_layer.py``): a class with static ``forward``/
+``backward`` gets wired into the eager tape. On TPU the pair also defines a
+``jax.custom_vjp`` under the functional path when forward/backward are pure.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import GradNode, is_grad_enabled
+from paddle_tpu.core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Subclass and define::
+
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = paddle_tpu.exp(x)
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor()
+                return dy * y
+    """
+
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args: Any, **kwargs: Any):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads: Any):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        requires = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        # forward runs detached; the PyLayer is a tape primitive, inner ops
+        # are not recorded (reference parity: pylayer grad node is opaque)
+        detached = [a.detach() if isinstance(a, Tensor) else a for a in args]
+        out = cls.forward(ctx, *detached, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        out_arrays = [o.data if isinstance(o, Tensor) else jnp.asarray(o)
+                      for o in outs]
+
+        if not requires:
+            wrapped = [Tensor(a) for a in out_arrays]
+            return tuple(wrapped) if multi else wrapped[0]
+
+        n_out = len(out_arrays)
+
+        def vjp_fn(cots):
+            if not isinstance(cots, tuple):
+                cots = (cots,)
+            gs = cls.backward(ctx, *[Tensor(c) for c in cots])
+            if not isinstance(gs, (tuple, list)):
+                gs = (gs,)
+            arr = []
+            gi = iter(gs)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(gi, None)
+                    arr.append(None if g is None
+                               else (g.data if isinstance(g, Tensor) else g))
+            return tuple(arr)
+
+        edges = []
+        for t in tensor_inputs:
+            if t.stop_gradient:
+                edges.append(None)
+            elif t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._out_idx))
+            else:
+                edges.append(("leaf", t))
+        node = GradNode(cls.__name__, vjp_fn, edges, n_out,
+                        [(a.shape, a.dtype) for a in out_arrays],
+                        multi=multi)
+        wrapped = []
+        for i, a in enumerate(out_arrays):
+            t = Tensor(a, stop_gradient=False)
+            t._grad_node = node
+            t._out_idx = i
+            wrapped.append(t)
+        return tuple(wrapped) if multi else wrapped[0]

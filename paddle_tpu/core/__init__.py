@@ -1,0 +1,3 @@
+from . import dtype, flags, generator, autograd  # noqa: F401
+from .tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .autograd import no_grad, enable_grad, is_grad_enabled, grad  # noqa: F401

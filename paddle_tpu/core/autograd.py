@@ -1,0 +1,357 @@
+"""Eager (dygraph) autograd tape.
+
+TPU-native redesign of the reference's eager autograd engine
+(``paddle/fluid/eager/``: ``GradNodeBase``/``Edge`` in ``grad_node_info.h:168``,
+``RunBackward`` BFS with in-degree counting in ``backward.cc:104``,
+``GradTensorHolder`` accumulation; SURVEY.md §2.3, §3.2).
+
+Where the reference generates one C++ GradNode class per op from YAML
+(eager_gen.py), we need no codegen at all: every op is a pure JAX function, so its
+GradNode is simply the ``jax.vjp`` closure captured at forward time. The backward
+walk is identical in shape to the reference's: seed the output node, BFS with
+in-degree bookkeeping, accumulate cotangents per node-slot, and write leaf grads
+into ``Tensor.grad`` (the analog of GradNodeAccumulation).
+
+The hot training path does not use this tape — it uses the functional/jit path
+(paddle_tpu/jit) where the whole step is one compiled XLA program. The tape is the
+debugging/eager UX layer, matching Paddle's dygraph ergonomics.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import flags
+
+__all__ = [
+    "GradNode", "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+    "backward", "grad", "apply_op",
+]
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _tls.grad_enabled = bool(mode)
+
+
+class _GradGuard:
+    """Context manager *and* decorator, like paddle.no_grad."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with self.__class__(self._mode):
+                return fn(*a, **k)
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+def no_grad(fn=None):
+    g = _GradGuard(False)
+    return g(fn) if callable(fn) else g
+
+
+def enable_grad(fn=None):
+    g = _GradGuard(True)
+    return g(fn) if callable(fn) else g
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``vjp_fn`` maps output cotangents -> input cotangents (the jax.vjp closure).
+    ``edges`` has one entry per differentiable tensor input:
+      ('node', parent_node, slot)  — input produced by another recorded op
+      ('leaf', tensor)            — input is a trainable leaf (param)
+      None                        — cotangent discarded (stop_gradient input)
+    """
+
+    __slots__ = ("name", "vjp_fn", "edges", "n_outputs", "out_avals", "multi",
+                 "hooks", "__weakref__")
+
+    def __init__(self, name, vjp_fn, edges, n_outputs, out_avals, multi=False):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.edges = edges
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals  # (shape, dtype) per output slot
+        self.multi = multi  # forward returned a tuple (vjp expects tuple cotangent)
+        self.hooks: List[Callable] = []
+
+    def register_hook(self, hook: Callable):
+        self.hooks.append(hook)
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_out={self.n_outputs}>"
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            bad = bool(jnp.any(~jnp.isfinite(a)))
+            if bad:
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{name}' "
+                    f"(FLAGS_check_nan_inf is on; reference parity: "
+                    f"paddle/fluid/eager/nan_inf_utils.cc)")
+
+
+def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
+    """Run one op eagerly, recording a GradNode when gradients are required.
+
+    ``fn`` is a pure function over jax arrays (Tensors in ``inputs`` are unwrapped,
+    other leaves pass through). This is the analog of a generated ``*_ad_func``
+    (reference anatomy: eager/api/manual/eager_manual/forwards/add_n_fwd_func.cc:25-80 —
+    profiling scope, AMP cast, PHI call, nan/inf check, GradNode wiring), except
+    dispatch is a direct call into jax and the GradNode is the vjp closure.
+    """
+    from .tensor import Tensor  # local import to break the cycle
+
+    flat, treedef = jax.tree_util.tree_flatten(
+        inputs, is_leaf=lambda x: isinstance(x, Tensor))
+    t_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+    t_inputs = [flat[i] for i in t_idx]
+    arrays = [t.data for t in t_inputs]
+
+    def pure(*arrs):
+        buf = list(flat)
+        for i, a in zip(t_idx, arrs):
+            buf[i] = a
+        res = fn(*jax.tree_util.tree_unflatten(treedef, buf), **attrs)
+        return tuple(res) if isinstance(res, list) else res
+
+    requires = is_grad_enabled() and any(not t.stop_gradient for t in t_inputs)
+
+    if requires:
+        out, vjp_fn = jax.vjp(pure, *arrays)
+    else:
+        out = pure(*arrays)
+
+    multi = isinstance(out, (tuple, list))
+    out_arrays = list(out) if multi else [out]
+
+    if flags.flag("check_nan_inf"):
+        _check_nan_inf(op_name or fn.__name__, out_arrays)
+
+    # Only float outputs participate in AD.
+    any_float_out = any(jnp.issubdtype(a.dtype, jnp.inexact) for a in out_arrays)
+    node = None
+    if requires and any_float_out:
+        edges = []
+        for t in t_inputs:
+            if t.stop_gradient:
+                edges.append(None)
+            elif t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._out_idx))
+            else:
+                edges.append(("leaf", t))
+        node = GradNode(
+            op_name or getattr(fn, "__name__", "op"), vjp_fn, edges,
+            len(out_arrays), [(a.shape, a.dtype) for a in out_arrays],
+            multi=multi)
+
+    outs = []
+    for i, a in enumerate(out_arrays):
+        differentiable = node is not None and jnp.issubdtype(a.dtype, jnp.inexact)
+        t = Tensor(a, stop_gradient=not differentiable)
+        if differentiable:
+            t._grad_node = node
+            t._out_idx = i
+        outs.append(t)
+    return tuple(outs) if multi else outs[0]
+
+
+def _zeros_like_aval(aval):
+    shape, dtype = aval
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    # integer/bool output slots take symbolic-zero (float0) cotangents
+    import numpy as np
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """Run the tape backward from ``tensors`` (paddle.autograd.backward parity).
+
+    BFS with in-degree counting, mirroring the reference RunBackward
+    (paddle/fluid/eager/backward.cc:104): dependency counts are computed by a DFS
+    over the subgraph reachable from the roots, then nodes execute once all their
+    consumers have contributed cotangents.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # node -> list of accumulated output cotangents (per slot)
+    holders = {}
+    pending_leaf = {}
+
+    def seed(t: Tensor, g):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "pass grad_tensors for non-scalar backward()")
+            g = jnp.ones(t.data.shape, t.data.dtype)
+        else:
+            g = g.data if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._grad_node is None:
+            if not t.stop_gradient:
+                _accum_leaf(t, g)
+            return None
+        _accum_holder(t._grad_node, t._out_idx, g)
+        return t._grad_node
+
+    def _accum_holder(node, slot, g):
+        h = holders.get(node)
+        if h is None:
+            h = [None] * node.n_outputs
+            holders[node] = h
+        h[slot] = g if h[slot] is None else h[slot] + g
+
+    def _accum_leaf(t, g):
+        if id(t) in pending_leaf:
+            g = pending_leaf[id(t)][1] + g
+        pending_leaf[id(t)] = (t, g)
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        n = seed(t, g)
+        if n is not None:
+            roots.append(n)
+
+    # dependency counting (consumers per node)
+    indeg = {}
+    seen = set()
+    stack = list(dict.fromkeys(roots))
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        for e in n.edges:
+            if e is not None and e[0] == "node":
+                p = e[1]
+                indeg[id(p)] = indeg.get(id(p), 0) + 1
+                stack.append(p)
+
+    ready = [n for n in dict.fromkeys(roots)]
+    processed = set()
+    while ready:
+        node = ready.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        h = holders.pop(node, None)
+        if h is None:
+            h = [None] * node.n_outputs
+        cots = tuple(
+            h[i] if h[i] is not None else _zeros_like_aval(node.out_avals[i])
+            for i in range(node.n_outputs))
+        for hook in node.hooks:
+            cots = hook(cots) or cots
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"trying to backward through node '{node.name}' a second time "
+                "but the saved intermediates were freed; call backward/grad "
+                "with retain_graph=True the first time")
+        in_cots = node.vjp_fn(cots if _vjp_multi(node) else cots[0])
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+        for e, g in zip(node.edges, in_cots):
+            if e is None:
+                continue
+            real = g is not None and not _is_float0(g)
+            if e[0] == "leaf":
+                if real:
+                    _accum_leaf(e[1], g)
+            else:
+                _, p, slot = e
+                if real:
+                    _accum_holder(p, slot, g)
+                # decrement even for dropped cotangents or the parent never fires
+                indeg[id(p)] -= 1
+                if indeg[id(p)] == 0:
+                    ready.append(p)
+    for t, g in list(pending_leaf.values()):
+        _write_leaf_grad(t, g)
+
+
+def _vjp_multi(node):
+    return node.multi
+
+
+def _is_float0(g):
+    return hasattr(g, "dtype") and g.dtype == jax.dtypes.float0
+
+
+def _write_leaf_grad(t, g):
+    from .tensor import Tensor
+    for hook in t._hooks:
+        out = hook(Tensor(g, stop_gradient=True))
+        if out is not None:
+            g = out.data if isinstance(out, Tensor) else out
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad.data + g, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False):
+    """paddle.grad parity (first order; reference: eager/general_grad.h).
+
+    Runs backward on a copy of the leaf-accumulation targets so that ``.grad``
+    fields of the model are not polluted, and returns grads w.r.t. ``inputs``.
+    """
+    from .tensor import Tensor
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph/higher-order grad via the eager tape is not yet "
+            "supported; use the functional API (paddle_tpu.jit) with jax.grad "
+            "composition for higher-order derivatives")
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    # ensure leaves are watchable even if stop_gradient was set after trace
+    backward(outputs, grad_outputs, retain_graph=retain_graph)
+    results = []
+    for (t, old) in saved:
+        g = t.grad
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "one of the input tensors received no gradient; pass "
+                "allow_unused=True to get None instead")
+        results.append(g)
+        t.grad = old
+    return results

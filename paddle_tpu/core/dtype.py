@@ -1,0 +1,139 @@
+"""Dtype system for paddle_tpu.
+
+Capability parity with the reference's ``phi::DataType`` / ``paddle/phi/common/data_type.h``
+(see SURVEY.md §2.1 "DDim/layout/dtype"), redesigned for TPU: dtypes are thin wrappers
+over jnp dtypes, bfloat16 is first-class (the TPU-native 16-bit format), and there is no
+per-backend layout enum — XLA owns layout.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DType",
+    "float32",
+    "float64",
+    "float16",
+    "bfloat16",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "uint16",
+    "uint32",
+    "uint64",
+    "bool_",
+    "complex64",
+    "complex128",
+    "float8_e4m3fn",
+    "float8_e5m2",
+    "convert_dtype",
+    "is_floating_point",
+    "is_integer",
+    "finfo",
+    "iinfo",
+]
+
+
+class DType:
+    """A named dtype. Compares equal to its string name and to the jnp dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = jnp.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.np_dtype == other.np_dtype
+        if isinstance(other, str):
+            try:
+                return self.np_dtype == convert_dtype(other).np_dtype
+            except (TypeError, ValueError):
+                return False
+        try:
+            return self.np_dtype == jnp.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+    def __str__(self):
+        return self.name
+
+
+float32 = DType("float32", jnp.float32)
+float64 = DType("float64", jnp.float64)
+float16 = DType("float16", jnp.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+int8 = DType("int8", jnp.int8)
+int16 = DType("int16", jnp.int16)
+int32 = DType("int32", jnp.int32)
+int64 = DType("int64", jnp.int64)
+uint8 = DType("uint8", jnp.uint8)
+uint16 = DType("uint16", jnp.uint16)
+uint32 = DType("uint32", jnp.uint32)
+uint64 = DType("uint64", jnp.uint64)
+bool_ = DType("bool", jnp.bool_)
+complex64 = DType("complex64", jnp.complex64)
+complex128 = DType("complex128", jnp.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
+
+_ALL = [
+    float32, float64, float16, bfloat16, int8, int16, int32, int64,
+    uint8, uint16, uint32, uint64, bool_, complex64, complex128,
+    float8_e4m3fn, float8_e5m2,
+]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool_"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["half"] = float16
+_BY_NAME["int"] = int32
+_BY_NAME["long"] = int64
+_BY_NP = {d.np_dtype: d for d in _ALL}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize any dtype spec (DType, str, numpy/jnp dtype, python type) to DType."""
+    if dtype is None:
+        return float32
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _BY_NAME:
+            return _BY_NAME[dtype]
+        return _BY_NP.get(jnp.dtype(dtype)) or DType(dtype, jnp.dtype(dtype))
+    npd = jnp.dtype(dtype)
+    got = _BY_NP.get(npd)
+    if got is None:
+        got = DType(npd.name, npd)
+    return got
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype).np_dtype, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype).np_dtype, jnp.integer)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype).np_dtype)
+
+
+def iinfo(dtype):
+    return np.iinfo(convert_dtype(dtype).np_dtype)

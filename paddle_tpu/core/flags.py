@@ -1,0 +1,82 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's exported gflags
+(``paddle/phi/core/flags.cc`` — 90 ``FLAGS_*`` entries — surfaced to Python through
+``paddle.set_flags`` / ``paddle.get_flags``; SURVEY.md §5 "Config / flag system").
+Flags here are plain Python with env-var override (``FLAGS_<name>``), since there is no
+C++ gflags layer between Python and XLA on TPU.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Union
+
+_REGISTRY: Dict[str, "_Flag"] = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "doc", "type")
+
+    def __init__(self, name: str, default: Any, doc: str = ""):
+        self.name = name
+        self.default = default
+        self.doc = doc
+        self.type = type(default)
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            self.value = _parse(env, self.type)
+        else:
+            self.value = default
+
+
+def _parse(s: str, ty):
+    if ty is bool:
+        return s.lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(s)
+    if ty is float:
+        return float(s)
+    return s
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    if name not in _REGISTRY:
+        _REGISTRY[name] = _Flag(name, default, doc)
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags parity (reference: pybind global_value_getter_setter.cc)."""
+    for k, v in flags.items():
+        k = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if k not in _REGISTRY:
+            define_flag(k, v)
+        else:
+            _REGISTRY[k].value = v
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {k!r}")
+        out[k] = _REGISTRY[key].value
+    return out
+
+
+def flag(name: str) -> Any:
+    """Fast internal accessor."""
+    return _REGISTRY[name].value
+
+
+# Core flags (subset of the reference's inventory that is meaningful on TPU).
+define_flag("check_nan_inf", False, "check every op output for NaN/Inf (reference: FLAGS_check_nan_inf)")
+define_flag("eager_delete_tensor_gb", 0.0, "compat no-op: XLA owns buffer lifetime")
+define_flag("allocator_strategy", "xla", "compat: TPU memory is managed by the XLA runtime")
+define_flag("benchmark", False, "sync after every op for timing")
+define_flag("default_dtype", "float32", "default floating dtype for tensor creation")
+define_flag("matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("use_pallas_kernels", True, "use Pallas fused kernels (flash attention etc.) when on TPU")
+define_flag("log_level", 0, "VLOG-style verbosity")

@@ -1,0 +1,142 @@
+"""RNG state management.
+
+TPU-native replacement for the reference's stateful Philox generator
+(``phi::Generator``, ``paddle/phi/core/generator.h:36``) and the tensor-parallel
+``RNGStatesTracker`` (``python/paddle/distributed/fleet/layers/mpu/random.py:35``).
+
+JAX RNG is key-based and functional; we expose Paddle's stateful-seed UX on top of it:
+each :class:`Generator` owns (seed, counter) and derives key #n as
+``fold_in(key(seed), n)`` — deterministic, replayable, and safe under jit tracing via
+:func:`rng_guard`, which rebases the generator on an explicitly-threaded traced key
+(the functional train step passes the key in as an argument; see paddle_tpu/jit).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["Generator", "default_generator", "seed", "get_rng_state", "set_rng_state",
+           "rng_guard", "RNGStatesTracker", "get_rng_tracker", "next_key"]
+
+_tls = threading.local()
+
+
+class Generator:
+    """Stateful seed/counter pair producing a deterministic stream of JAX PRNG keys."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._count = 0
+        self._base_override = None  # traced key installed by rng_guard
+
+    def manual_seed(self, seed: int) -> "Generator":
+        self._seed = int(seed)
+        self._count = 0
+        return self
+
+    def seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        self._seed, self._count = int(state[0]), int(state[1])
+
+    def next_key(self):
+        """Return the next PRNG key in this generator's stream."""
+        if self._base_override is not None:
+            base = self._base_override
+        else:
+            base = jax.random.key(self._seed)
+        k = jax.random.fold_in(base, self._count)
+        self._count += 1
+        return k
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed parity: seeds the default generator and every tracker state."""
+    default_generator.manual_seed(s)
+    tracker = get_rng_tracker()
+    for name in list(tracker._states):
+        tracker._states[name] = Generator(s + tracker._offsets.get(name, 0))
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+def next_key():
+    """Next key from whichever generator is active (tracker state or default)."""
+    gen = getattr(_tls, "active_generator", None) or default_generator
+    return gen.next_key()
+
+
+@contextlib.contextmanager
+def rng_guard(key, generator: Optional[Generator] = None):
+    """Rebase a generator onto an explicit (possibly traced) key for the duration.
+
+    Used by the functional/jit path to keep randomness pure: the caller threads a key
+    through the step function and all stateful ``next_key()`` calls inside derive from
+    it with a counter reset, so retracing is deterministic.
+    """
+    gen = generator or default_generator
+    old = (gen._base_override, gen._count)
+    gen._base_override = key
+    gen._count = 0
+    try:
+        yield gen
+    finally:
+        gen._base_override, gen._count = old
+
+
+class RNGStatesTracker:
+    """Named RNG streams for tensor parallelism.
+
+    Parity with the reference's tracker (mpu/random.py:35): distinguishes e.g. a
+    ``global_seed`` stream (same across the model-parallel group — dropout on
+    replicated activations) from ``local_seed`` (different per mp rank — dropout on
+    sharded activations).
+    """
+
+    def __init__(self):
+        self._states = {}
+        self._offsets = {}
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise ValueError(f"rng state {name!r} already exists")
+        self._states[name] = Generator(seed)
+        self._offsets[name] = seed - default_generator.seed()
+
+    def states(self):
+        return dict(self._states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str):
+        if name not in self._states:
+            raise ValueError(f"unknown rng state {name!r}")
+        prev = getattr(_tls, "active_generator", None)
+        _tls.active_generator = self._states[name]
+        try:
+            yield
+        finally:
+            _tls.active_generator = prev
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _TRACKER

@@ -1,0 +1,364 @@
+"""The eager Tensor.
+
+Capability parity with the reference's dygraph Tensor
+(``phi::DenseTensor`` + the eager ``paddle::Tensor`` with autograd meta;
+``paddle/phi/core/dense_tensor.h:38``, ``paddle/fluid/eager/``; SURVEY.md §2.1/§2.3),
+redesigned for TPU: the storage is a ``jax.Array`` (device memory owned by the XLA
+runtime — no framework allocator needed, cf. reference ``fluid/memory/``), shape/dtype
+come from the array's aval (no separate DDim/InferMeta bookkeeping in eager mode), and
+autograd metadata is the tape described in :mod:`paddle_tpu.core.autograd`.
+
+Paddle semantics preserved:
+  * ``stop_gradient`` defaults to True for user-created tensors and False for
+    ``Parameter``s.
+  * ``.grad`` is populated by ``backward()`` and accumulates across calls until
+    ``clear_grad()``.
+  * inplace-style APIs (``set_value``, ``fill_``, ``zero_``...) mutate the leaf's
+    storage reference (functional under the hood — the old array is replaced).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd as _ag
+from .dtype import DType, convert_dtype
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+
+def _ops():
+    from paddle_tpu import ops
+    return ops
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_out_idx",
+                 "name", "persistable", "_hooks", "_version", "_sharding_spec",
+                 "trainable", "__weakref__", "__dict__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        elif not isinstance(data, jax.Array) and not _is_tracer(data):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_idx = 0
+        self.name = name or ""
+        self.persistable = False
+        self._hooks = []
+        self._version = 0
+        self._sharding_spec = None  # distributed placement annotation (dist module)
+        self.trainable = not stop_gradient
+
+    # -- storage ---------------------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else value
+        self._version += 1
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self) -> DType:
+        return convert_dtype(self._data.dtype)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    ndimension = ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    def numel(self):
+        return to_tensor(self.size, dtype="int64")
+
+    def dim(self):
+        return self.ndim
+
+    @property
+    def place(self) -> str:
+        try:
+            devs = self._data.devices()
+            d = next(iter(devs))
+            return f"{d.platform}:{d.id}"
+        except Exception:
+            return "traced"
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    # -- conversion ------------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self._data.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def astype(self, dtype):
+        return _ops().cast(self, dtype)
+
+    def cast(self, dtype):
+        return _ops().cast(self, dtype)
+
+    def cpu(self):
+        cpu_dev = jax.devices("cpu")[0]
+        return Tensor(jax.device_put(self._data, cpu_dev),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, DType)) and not isinstance(a, str) or (
+                    isinstance(a, str) and a in ("float32", "float16", "bfloat16",
+                                                 "float64", "int32", "int64")):
+                t = t.astype(a)
+            elif isinstance(a, str):
+                pass  # device strings: single-device eager; sharding via dist API
+        return t
+
+    def pin_memory(self):
+        return self  # host staging is managed by the XLA runtime on TPU
+
+    # -- autograd --------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _ag.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        if self.stop_gradient:
+            raise RuntimeError("cannot register hook on a tensor with "
+                               "stop_gradient=True")
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+        return _Handle()
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name)
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return _ops().assign(self)
+
+    @property
+    def inplace_version(self):
+        return self._version
+
+    # -- inplace-style mutation (leaf storage replacement) ---------------------
+    def set_value(self, value):
+        value = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}")
+        self._data = value.astype(self._data.dtype)
+        self._version += 1
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        self._version += 1
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        self._version += 1
+        return self
+
+    def scale_(self, scale):
+        self._data = self._data * scale
+        self._version += 1
+        return self
+
+    # -- indexing --------------------------------------------------------------
+    def __getitem__(self, idx):
+        if isinstance(idx, Tensor):
+            idx = idx._data
+        elif isinstance(idx, tuple):
+            idx = tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+        return _ag.apply_op(lambda x: x[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        if isinstance(idx, Tensor):
+            idx = idx._data
+        elif isinstance(idx, tuple):
+            idx = tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+        value = value._data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(value)
+        self._version += 1
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    # -- arithmetic dunders (delegate to ops for tape recording) ---------------
+    def __add__(self, o):
+        return _ops().add(self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return _ops().subtract(self, o)
+
+    def __rsub__(self, o):
+        return _ops().subtract(o, self)
+
+    def __mul__(self, o):
+        return _ops().multiply(self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return _ops().divide(self, o)
+
+    def __rtruediv__(self, o):
+        return _ops().divide(o, self)
+
+    def __floordiv__(self, o):
+        return _ops().floor_divide(self, o)
+
+    def __mod__(self, o):
+        return _ops().remainder(self, o)
+
+    def __pow__(self, o):
+        return _ops().pow(self, o)
+
+    def __rpow__(self, o):
+        return _ops().pow(o, self)
+
+    def __matmul__(self, o):
+        return _ops().matmul(self, o)
+
+    def __rmatmul__(self, o):
+        return _ops().matmul(o, self)
+
+    def __neg__(self):
+        return _ops().scale(self, -1.0)
+
+    def __abs__(self):
+        return _ops().abs(self)
+
+    def __invert__(self):
+        return _ops().logical_not(self)
+
+    def __eq__(self, o):
+        return _ops().equal(self, o)
+
+    def __ne__(self, o):
+        return _ops().not_equal(self, o)
+
+    def __lt__(self, o):
+        return _ops().less_than(self, o)
+
+    def __le__(self, o):
+        return _ops().less_equal(self, o)
+
+    def __gt__(self, o):
+        return _ops().greater_than(self, o)
+
+    def __ge__(self, o):
+        return _ops().greater_equal(self, o)
+
+    def __and__(self, o):
+        return _ops().logical_and(self, o)
+
+    def __or__(self, o):
+        return _ops().logical_or(self, o)
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    @property
+    def T(self):
+        return _ops().transpose(self, list(range(self.ndim))[::-1])
+
+    # numpy interop
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data_repr = repr(np.asarray(self._data))
+        except Exception:
+            data_repr = f"<traced {self._data.shape} {self._data.dtype}>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {data_repr})")
+
+
+class Parameter(Tensor):
+    """A trainable leaf tensor (reference: ``paddle.fluid.framework.Parameter``)."""
+
+    def __init__(self, data, name: Optional[str] = None, trainable: bool = True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        arr = data._data
+    elif isinstance(data, (jax.Array,)) or _is_tracer(data):
+        arr = data
+    else:
+        arr = np.asarray(data)
+        # Paddle defaults python floats to the default float dtype, ints to int64.
+        if dtype is None and arr.dtype == np.float64 and isinstance(
+                data, (float, list, tuple)):
+            arr = arr.astype(np.float32)
+        arr = jnp.asarray(arr)
+    if dtype is not None:
+        arr = arr.astype(convert_dtype(dtype).np_dtype)
+    return Tensor(arr, stop_gradient=stop_gradient)

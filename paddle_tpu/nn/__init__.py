@@ -1,0 +1,1 @@
+"""paddle.nn parity namespace (populated in nn/layer.py etc.)."""

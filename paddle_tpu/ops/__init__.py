@@ -1,0 +1,89 @@
+"""Functional op library.
+
+The single-backend (XLA) replacement for the reference's entire kernel stack
+(SURVEY.md §2.1): PHI kernels, kernel registry, InferMeta, YAML codegen, compat
+layer. Op semantics follow ``python/paddle/tensor/*`` and
+``paddle/phi/api/yaml/ops.yaml``; each op here is one pure JAX function registered
+via :mod:`._registry` (eager tape dispatch + jit-traceable).
+"""
+from __future__ import annotations
+
+from ._registry import OPS, RAW, get_op, op  # noqa: F401
+
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+
+from . import math as _math
+from . import creation as _creation
+from . import reduction as _reduction
+from . import manipulation as _manipulation
+from . import linalg as _linalg
+
+# re-export every registered op at module scope
+import sys as _sys
+_self = _sys.modules[__name__]
+for _name, _fn in OPS.items():
+    if not hasattr(_self, _name):
+        setattr(_self, _name, _fn)
+
+
+def monkey_patch_tensor():
+    """Attach the op surface as Tensor methods.
+
+    Mirrors the reference's varbase patching
+    (python/paddle/fluid/dygraph/varbase_patch_methods.py): the long tail of
+    ``Tensor.sum()/reshape()/...`` methods delegates to the functional ops.
+    """
+    from paddle_tpu.core.tensor import Tensor
+
+    method_names = [
+        # math
+        "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+        "pow", "maximum", "minimum", "abs", "exp", "log", "log2", "log10",
+        "log1p", "sqrt", "rsqrt", "square", "reciprocal", "sign", "floor",
+        "ceil", "round", "trunc", "sin", "cos", "tan", "tanh", "sigmoid",
+        "erf", "clip", "scale", "cumsum", "cumprod", "isnan", "isinf",
+        "isfinite", "equal", "not_equal", "less_than", "less_equal",
+        "greater_than", "greater_equal", "logical_and", "logical_or",
+        "logical_not", "logical_xor", "bitwise_and", "bitwise_or",
+        "bitwise_xor", "bitwise_not", "allclose", "isclose", "equal_all",
+        "lerp", "nan_to_num",
+        # reduction
+        "sum", "mean", "prod", "max", "min", "amax", "amin", "std", "var",
+        "median", "logsumexp", "argmax", "argmin", "all", "any", "topk",
+        "kthvalue", "mode", "count_nonzero", "nanmean", "nansum", "quantile",
+        # manipulation
+        "cast", "reshape", "transpose", "concat", "split", "chunk", "squeeze",
+        "unsqueeze", "flatten", "tile", "expand", "broadcast_to", "expand_as",
+        "flip", "roll", "gather", "gather_nd", "take_along_axis",
+        "put_along_axis", "scatter", "scatter_nd_add", "index_select",
+        "index_sample", "index_add", "masked_select", "masked_fill", "where",
+        "nonzero", "tril", "triu", "pad", "repeat_interleave", "sort",
+        "argsort", "unbind", "unique", "diagonal", "diff", "moveaxis",
+        "swapaxes", "one_hot", "slice", "strided_slice", "bucketize",
+        "searchsorted",
+        # linalg
+        "matmul", "bmm", "dot", "mv", "norm", "dist", "cross", "cholesky",
+        "qr", "svd", "eig", "eigh", "det", "slogdet", "inverse", "pinv",
+        "solve", "matrix_power", "t", "histogram", "bincount", "addmm",
+        "outer", "inner",
+    ]
+    for name in method_names:
+        fn = OPS.get(name)
+        if fn is None:
+            continue
+        if name in ("all", "any", "max", "min", "sum", "t"):
+            # avoid clobbering python builtins semantics where already defined
+            pass
+        setattr(Tensor, name, fn)
+
+    # aliases matching paddle method names
+    Tensor.mm = OPS["matmul"]
+    Tensor.mod = OPS["remainder"]
+    Tensor.rsub = lambda self, o: OPS["subtract"](o, self)
+
+
+monkey_patch_tensor()

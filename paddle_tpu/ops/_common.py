@@ -1,0 +1,7 @@
+"""Shared helpers for ops."""
+import jax
+import jax.numpy as jnp
+
+# TPU runs with x64 disabled; "int64" tensors are stored 32-bit (same policy as
+# torch/xla). LONG is the canonical widest int actually materialized.
+LONG = jax.dtypes.canonicalize_dtype(jnp.int64)

@@ -1,0 +1,47 @@
+"""Op registry and eager-dispatch decorator.
+
+TPU-native collapse of the reference's op stack (SURVEY.md §2.1, §3.1): where Paddle
+needs a YAML schema (``paddle/phi/api/yaml/ops.yaml``), codegen
+(``api_gen.py``/``eager_gen.py``), a kernel registry keyed by
+(name, backend, layout, dtype) (``phi/core/kernel_factory.h:314``) and per-backend
+kernel files, a TPU framework needs exactly one definition per op: a pure JAX
+function. XLA is the only backend; dtype/layout dispatch, fusion and scheduling are
+the compiler's job. The registry here exists for introspection, the Tensor-method
+monkey-patch (the reference patches methods onto its Tensor too —
+``python/paddle/fluid/dygraph/varbase_patch_methods.py``), and the static-capture
+path which records op names.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+from paddle_tpu.core.autograd import apply_op
+
+OPS: Dict[str, Callable] = {}      # name -> eager wrapper
+RAW: Dict[str, Callable] = {}      # name -> pure jax fn
+
+
+def op(fn: Optional[Callable] = None, *, name: Optional[str] = None):
+    """Register a pure jax-level function as an eager op.
+
+    The wrapper unwraps Tensor args, records a GradNode via jax.vjp when needed,
+    and re-wraps outputs (see core.autograd.apply_op).
+    """
+
+    def deco(f):
+        opname = name or f.__name__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return apply_op(f, *args, op_name=opname, **kwargs)
+
+        OPS[opname] = wrapper
+        RAW[opname] = f
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def get_op(name: str) -> Callable:
+    return OPS[name]

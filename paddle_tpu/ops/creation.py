@@ -1,0 +1,244 @@
+"""Tensor creation ops (reference: paddle/phi/kernels/*/full_kernel.cc, arange,
+gaussian, uniform etc.; Python surface python/paddle/tensor/creation.py /
+random.py). Random ops draw keys from the stateful Generator stream
+(core/generator.py) so eager UX matches Paddle while staying pure under trace."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import generator as _gen
+from paddle_tpu.core.dtype import convert_dtype
+from paddle_tpu.core.tensor import Tensor, to_tensor
+from ._common import LONG
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "rand", "randn", "randint", "randint_like",
+    "uniform", "normal", "standard_normal", "randperm", "bernoulli",
+    "multinomial", "poisson", "exponential_", "tril_indices", "triu_indices",
+    "clone_detached", "complex",
+]
+
+
+def _dt(dtype, default="float32"):
+    from paddle_tpu.core.flags import flag
+    if dtype is None:
+        dtype = flag("default_dtype") if default == "float32" else default
+    return convert_dtype(dtype).np_dtype
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape.data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def zeros_like(x, dtype=None):
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.zeros_like(d, dtype=None if dtype is None else _dt(dtype)))
+
+
+def ones_like(x, dtype=None):
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.ones_like(d, dtype=None if dtype is None else _dt(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.full_like(d, fill_value,
+                                dtype=None if dtype is None else _dt(dtype)))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("int64" if all(isinstance(v, (int, np.integer))
+                                for v in (start, end, step)) else "float32")
+    return Tensor(jnp.arange(start, end, step, _dt(dtype, default=dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0):
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    if d.ndim == 1 and padding_value != 0:
+        n = d.shape[0] + builtins_abs(offset)
+        base = jnp.full((n, n), padding_value, d.dtype)
+        return Tensor(base + jnp.diag(d, offset) - jnp.diag(
+            jnp.zeros_like(d) + padding_value, offset))
+    return Tensor(jnp.diag(d, offset))
+
+
+builtins_abs = abs
+
+
+def diagflat(x, offset=0):
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.diagflat(d, offset))
+
+
+def tril(x, diagonal=0):
+    from ._registry import OPS
+    return OPS["tril"](x, diagonal=diagonal)
+
+
+def triu(x, diagonal=0):
+    from ._registry import OPS
+    return OPS["triu"](x, diagonal=diagonal)
+
+
+def tril_indices(row, col, offset=0):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def triu_indices(row, col=None, offset=0):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(np.int64)))
+
+
+def meshgrid(*args):
+    arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    if len(arrs) == 1 and isinstance(arrs[0], (list, tuple)):
+        arrs = list(arrs[0])
+    outs = jnp.meshgrid(*arrs, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def complex(real, imag):
+    r = real.data if isinstance(real, Tensor) else jnp.asarray(real)
+    i = imag.data if isinstance(imag, Tensor) else jnp.asarray(imag)
+    return Tensor(jax.lax.complex(r, i))
+
+
+# -- random --------------------------------------------------------------------
+def rand(shape, dtype=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None):
+    k = _gen.next_key()
+    return Tensor(jax.random.normal(k, _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean.data if isinstance(mean, Tensor) else mean
+        s = std.data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            jnp.shape(m), jnp.shape(s)) if shape is None else _shape(shape)
+        k = _gen.next_key()
+        return Tensor(jax.random.normal(k, out_shape, jnp.result_type(m)) * s + m)
+    if shape is None:
+        shape = [1]
+    k = _gen.next_key()
+    return Tensor(jax.random.normal(k, _shape(shape), jnp.float32) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    k = _gen.next_key() if not seed else jax.random.key(seed)
+    return Tensor(jax.random.uniform(k, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    k = _gen.next_key()
+    return Tensor(jax.random.randint(k, _shape(shape), low, high,
+                                     _dt(dtype or "int64", default="int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64"):
+    k = _gen.next_key()
+    return Tensor(jax.random.permutation(k, int(n)).astype(_dt(dtype, "int64")))
+
+
+def bernoulli(x):
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    k = _gen.next_key()
+    return Tensor(jax.random.bernoulli(k, d).astype(d.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    k = _gen.next_key()
+    logits = jnp.log(jnp.maximum(d, 1e-30))
+    if d.ndim == 1:
+        out = jax.random.choice(k, d.shape[0], (num_samples,),
+                                replace=replacement, p=d / d.sum())
+        return Tensor(out.astype(LONG))
+    outs = []
+    for i in range(d.shape[0]):
+        k, sub = jax.random.split(k)
+        outs.append(jax.random.choice(sub, d.shape[1], (num_samples,),
+                                      replace=replacement,
+                                      p=d[i] / d[i].sum()))
+    return Tensor(jnp.stack(outs).astype(LONG))
+
+
+def poisson(x):
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    k = _gen.next_key()
+    return Tensor(jax.random.poisson(k, d).astype(d.dtype))
+
+
+def exponential_(x, lam=1.0):
+    k = _gen.next_key()
+    x._data = jax.random.exponential(k, tuple(x.shape), x.data.dtype) / lam
+    x._version += 1
+    return x
+
+
+def clone_detached(x):
+    return Tensor(x.data)

@@ -1,0 +1,237 @@
+"""Linear algebra ops.
+
+matmul/bmm/einsum are MXU territory: kept as single lax.dot_general calls so XLA
+tiles them onto the 128x128 systolic array (reference equivalents:
+phi/kernels/impl/matmul_kernel_impl.h over cuBLAS; funcs/blas). Decompositions
+(svd/qr/...) delegate to jnp.linalg (CPU/host lowering where TPU lacks them, as
+the reference delegates to cuSolver)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._registry import op
+from ._common import LONG
+from paddle_tpu.core import flags
+
+
+def _precision():
+    p = flags.flag("matmul_precision")
+    return None if p == "default" else p
+
+
+@op
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y, precision=_precision())
+
+
+@op
+def bmm(x, y):
+    return jnp.matmul(x, y, precision=_precision())
+
+
+@op
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@op
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@op
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@op
+def mv(x, vec):
+    return jnp.matmul(x, vec, precision=_precision())
+
+
+@op
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y, precision=_precision())
+
+
+@op
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands, precision=_precision())
+
+
+@op
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (list, tuple))
+                               else None, axis=axis if isinstance(axis, int)
+                               else tuple(axis), keepdims=keepdim)
+    if p == float("inf") or p == "inf":
+        ordv = jnp.inf
+    elif p == float("-inf") or p == "-inf":
+        ordv = -jnp.inf
+    else:
+        ordv = p
+    if axis is None:
+        return jnp.linalg.norm(jnp.ravel(x), ord=ordv, keepdims=keepdim)
+    return jnp.linalg.norm(x, ord=ordv,
+                           axis=axis if isinstance(axis, int) else tuple(axis),
+                           keepdims=keepdim)
+
+
+@op
+def dist(x, y, p=2.0):
+    d = x - y
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+@op
+def cross(x, y, axis=None):
+    return jnp.cross(x, y, axis=-1 if axis is None else int(axis))
+
+
+@op
+def cdist(x, y, p=2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1) + 0.0)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1), 1.0 / p)
+
+
+@op
+def histogram(x, bins=100, min=0, max=0):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=rng)
+    return hist.astype(LONG)
+
+
+@op
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+# -- decompositions / solvers --------------------------------------------------
+@op
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@op
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@op
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@op
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@op
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+@op
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@op
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@op
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@op
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol).astype(LONG)
+
+
+@op
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@op
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@op
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@op
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@op
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@op
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@op
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@op
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, int(n))
+
+
+@op
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs, precision=_precision())
+
+
+@op
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+
+@op
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@op
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)

@@ -1,0 +1,436 @@
+"""Shape/layout manipulation ops (reference: phi/kernels/*/concat_kernel,
+split, transpose, reshape (zero-copy there, zero-copy here via XLA bitcast),
+gather/scatter family, pad, tile/expand; Python surface
+python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._registry import op
+from ._common import LONG
+from paddle_tpu.core.tensor import Tensor
+
+
+def _ints(v):
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return [int(x.item()) if isinstance(x, Tensor) else int(x) for x in v]
+
+
+@op
+def cast(x, dtype):
+    from paddle_tpu.core.dtype import convert_dtype
+    return x.astype(convert_dtype(dtype).np_dtype)
+
+
+@op
+def assign(x):
+    return jnp.array(x, copy=True)
+
+
+@op
+def reshape(x, shape):
+    shape = [int(s) for s in shape]
+    return jnp.reshape(x, shape)
+
+
+@op
+def transpose(x, perm):
+    return jnp.transpose(x, [int(p) for p in perm])
+
+
+@op(name="t")
+def t_(x):
+    return x.T
+
+
+@op
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@op
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, int(axis1), int(axis2))
+
+
+@op
+def concat(xs, axis=0):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return jnp.concatenate(xs, axis=axis)
+
+
+@op
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=int(axis))
+
+
+@op
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = []
+    total = x.shape[axis]
+    known = builtins_sum(s for s in num_or_sections if s >= 0)
+    sizes = [s if s >= 0 else total - known for s in num_or_sections]
+    offs = np.cumsum([0] + sizes)
+    return tuple(jax.lax.slice_in_dim(x, int(offs[i]), int(offs[i + 1]),
+                                      axis=axis)
+                 for i in range(len(sizes)))
+
+
+builtins_sum = sum
+
+
+@op
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, int(chunks), axis=int(axis)))
+
+
+@op
+def unbind(x, axis=0):
+    axis = int(axis)
+    return tuple(jnp.squeeze(s, axis)
+                 for s in jnp.split(x, x.shape[axis], axis=axis))
+
+
+@op
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        ax = tuple(a for a in (int(a) for a in axis) if x.shape[a] == 1)
+        return jnp.squeeze(x, ax) if ax else x
+    axis = int(axis)
+    return jnp.squeeze(x, axis) if x.shape[axis] == 1 else x
+
+
+@op
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(int(v) for v in axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, int(axis))
+
+
+@op
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    start = start_axis % nd if nd else 0
+    stop = stop_axis % nd if nd else 0
+    new_shape = (x.shape[:start]
+                 + (int(np.prod(x.shape[start:stop + 1]) or 1),)
+                 + x.shape[stop + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+@op
+def tile(x, repeat_times):
+    return jnp.tile(x, _ints(repeat_times))
+
+
+@op
+def expand(x, shape):
+    shape = [int(s) for s in shape]
+    # -1 entries keep the original dim (paddle semantics)
+    full = []
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(x.shape[i - offset])
+        else:
+            full.append(s)
+    return jnp.broadcast_to(x, full)
+
+
+@op
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, [int(s) for s in shape])
+
+
+@op
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@op
+def broadcast_tensors(xs):
+    return tuple(jnp.broadcast_arrays(*xs))
+
+
+@op
+def flip(x, axis):
+    return jnp.flip(x, axis if isinstance(axis, int) else tuple(axis))
+
+
+@op
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts,
+                    axis=axis if axis is None or isinstance(axis, int)
+                    else tuple(axis))
+
+
+@op
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k, axes)
+
+
+@op
+def gather(x, index, axis=0):
+    # paddle gather accepts index of shape [N] or [N, 1]
+    if hasattr(index, "ndim") and index.ndim == 2 and index.shape[1] == 1:
+        index = jnp.reshape(index, (-1,))
+    return jnp.take(x, index, axis=int(axis))
+
+
+@op
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@op
+def take_along_axis(x, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(x.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(x, indices, axis=int(axis))
+
+
+@op
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if not hasattr(values, "shape") or values.shape != indices.shape:
+        values = jnp.broadcast_to(values, indices.shape)
+    axis = int(axis)
+    dims = [jnp.arange(s) for s in indices.shape]
+    grids = jnp.meshgrid(*dims, indexing="ij")
+    grids[axis] = indices
+    idx = tuple(grids)
+    if reduce == "assign":
+        return x.at[idx].set(values)
+    if reduce in ("add", "sum"):
+        return x.at[idx].add(values)
+    if reduce in ("mul", "multiply"):
+        return x.at[idx].multiply(values)
+    raise ValueError(f"unsupported reduce {reduce!r}")
+
+
+@op
+def scatter(x, index, updates, overwrite=True):
+    index = jnp.reshape(index, (-1,))
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@op
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+@op
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros([int(s) for s in shape], updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+@op
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=int(axis))
+
+
+@op
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@op
+def index_add(x, index, axis, value):
+    axis = int(axis)
+    xm = jnp.moveaxis(x, axis, 0)
+    vm = jnp.moveaxis(value, axis, 0)
+    out = xm.at[index].add(vm)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@op
+def masked_select(x, mask):
+    # dynamic output shape — host-side op; not jittable (documented limitation,
+    # same as the reference's masked_select requiring a D2H sync)
+    return x[mask]
+
+
+@op
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@op
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.stack(jnp.nonzero(condition), axis=-1).astype(LONG)
+    return jnp.where(condition, x, y)
+
+
+@op
+def nonzero(x, as_tuple=False):
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(n.astype(LONG) for n in nz)
+    return jnp.stack(nz, axis=-1).astype(LONG)
+
+
+@op
+def tril(x, diagonal=0):
+    return jnp.tril(x, int(diagonal))
+
+
+@op
+def triu(x, diagonal=0):
+    return jnp.triu(x, int(diagonal))
+
+
+@op
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = _ints(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle F.pad convention: pad applies to last len(pad)//2 spatial dims,
+        # ordered from the last dim backwards, honoring data_format
+        cfg = [(0, 0)] * nd
+        npairs = len(pad) // 2
+        if data_format.endswith("C"):  # NHWC-like: spatial dims before channel
+            dims = list(range(1, 1 + npairs))
+        else:  # NCHW-like: spatial dims after channel
+            dims = list(range(nd - npairs, nd))
+        for i, d in enumerate(dims):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@op
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@op
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=int(axis))
+    if descending:
+        out = jnp.flip(out, axis=int(axis))
+    return out
+
+
+@op
+def argsort(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=int(axis))
+    if descending:
+        out = jnp.flip(out, axis=int(axis))
+    return out.astype(LONG)
+
+
+@op
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else LONG)
+
+
+@op
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, x,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else LONG)
+
+
+@op
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, int(num_classes), dtype=jnp.float32)
+
+
+@op
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    # dynamic shape — host-side like the reference's unique kernel
+    res = jnp.unique(x, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+@op
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    vals = jnp.asarray(np.unique(np.asarray(x)))
+    return vals
+
+
+@op
+def slice(x, axes, starts, ends):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(_ints(axes) if not isinstance(axes, int) else [axes],
+                          _ints(starts) if not isinstance(starts, int) else [starts],
+                          _ints(ends) if not isinstance(ends, int) else [ends]):
+        idx[ax] = jnp.s_[st:en]
+    return x[tuple(idx)]
+
+
+@op
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sr in zip(_ints(axes), _ints(starts), _ints(ends),
+                              _ints(strides)):
+        idx[ax] = jnp.s_[st:en:sr]
+    return x[tuple(idx)]
+
+
+@op
+def crop(x, shape, offsets):
+    shape = _ints(shape)
+    offsets = _ints(offsets)
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+@op
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset, int(axis1), int(axis2))
+
+
+@op
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@op
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@op
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@op
+def numel(x):
+    return jnp.asarray(np.prod(x.shape) if x.shape else 1, LONG)
+
+
+@op
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    lo, hi = shard_id * size, (shard_id + 1) * size
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, ignore_value)

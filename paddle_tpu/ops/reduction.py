@@ -1,0 +1,175 @@
+"""Reduction & search ops (reference: phi/kernels/*/reduce_*, arg_min_max, top_k,
+kthvalue, mode; the reference's elaborate reduce machinery in
+phi/kernels/funcs/reduce_function.h collapses to XLA reduce ops which tile onto
+the VPU natively)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._registry import op
+from ._common import LONG
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@op
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@op
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@op
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=dtype, keepdims=keepdim)
+
+
+@op(name="max")
+def max_(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op(name="min")
+def min_(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@op
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@op
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=None if axis is None else int(axis),
+                     keepdims=keepdim)
+    return out.astype(jax.dtypes.canonicalize_dtype(jnp.dtype(str(dtype))))
+
+
+@op
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=None if axis is None else int(axis),
+                     keepdims=keepdim)
+    return out.astype(jax.dtypes.canonicalize_dtype(jnp.dtype(str(dtype))))
+
+
+@op(name="all")
+def all_(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op(name="any")
+def any_(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@op
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim).astype(
+        LONG)
+
+
+@op
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    axis = int(axis)
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    if axis != -1 and axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(LONG)
+
+
+@op
+def kthvalue(x, k, axis=-1, keepdim=False):
+    s = jnp.sort(x, axis=axis)
+    si = jnp.argsort(x, axis=axis)
+    vals = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(si, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(LONG)
+
+
+@op
+def mode(x, axis=-1, keepdim=False):
+    # O(n^2) pairwise-count formulation — static shapes, VPU-friendly, and fine
+    # for the small trailing dims this op is used with.
+    ax = axis if axis >= 0 else x.ndim + axis
+    xm = jnp.moveaxis(x, ax, -1)
+    counts = jnp.sum(xm[..., :, None] == xm[..., None, :], axis=-1)
+    # break count ties toward the largest value (paddle returns the last max)
+    order = jnp.argsort(xm, axis=-1)
+    xs = jnp.take_along_axis(xm, order, axis=-1)
+    cs = jnp.take_along_axis(counts, order, axis=-1)
+    best = jnp.argmax(cs + jnp.arange(cs.shape[-1]) * 0, axis=-1,
+                      keepdims=True)
+    vals = jnp.take_along_axis(xs, best, axis=-1)
+    idx = jnp.argmax((xm == vals).astype(jnp.int32)
+                     * jnp.arange(1, xm.shape[-1] + 1), axis=-1, keepdims=True)
+    vals_out = jnp.moveaxis(vals, -1, ax)
+    idx_out = jnp.moveaxis(idx, -1, ax)
+    if not keepdim:
+        vals_out = jnp.squeeze(vals_out, ax)
+        idx_out = jnp.squeeze(idx_out, ax)
+    return vals_out, idx_out.astype(LONG)

@@ -1,0 +1,46 @@
+"""Test config: force an 8-virtual-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4) with the TPU-build
+improvement called out there: SPMD code paths are testable single-process on a
+virtual host mesh, which the reference (needing 2 real GPUs + NCCL subprocess
+spawning) cannot do.
+
+The sandbox may boot python with a TPU-tunnel PJRT plugin pre-registered
+(JAX_PLATFORMS=axon) via sitecustomize; unit tests must never touch the real
+chip, so we hard-override to the CPU platform and deregister any non-CPU
+backend factory before the first backend initialization.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name != "cpu":
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.default_backend() == "cpu", "tests must run on the CPU platform"
+assert jax.device_count() == 8, "tests expect an 8-device virtual mesh"
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    import paddle_tpu as pt
+    pt.seed(2024)
+    np.random.seed(2024)
+    # exact f32 matmuls for numeric oracles (TPU runs keep the bf16 MXU default)
+    pt.set_flags({"matmul_precision": "highest"})
+    yield

@@ -1,0 +1,214 @@
+"""Autograd tape tests, incl. numeric-gradient checks in the style of the
+reference's OpTest.check_grad (op_test.py:2261 — analytic vs finite difference).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar f at numpy point x."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op, x_np, analytic_fn=None, rtol=1e-2, atol=1e-3):
+    x = pt.to_tensor(x_np, stop_gradient=False)
+    y = op(x).sum()
+    y.backward()
+    num = numeric_grad(lambda v: float(op(pt.to_tensor(v)).sum().numpy()), x_np)
+    np.testing.assert_allclose(x.grad.numpy(), num, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("op_name", [
+    "exp", "log", "sqrt", "tanh", "sigmoid", "sin", "cos", "square", "abs",
+    "rsqrt", "log1p", "erf",
+])
+def test_unary_numeric_grad(op_name):
+    x_np = (np.random.rand(3, 4).astype(np.float32) * 0.8 + 0.2)
+    check_grad(getattr(pt, op_name), x_np)
+
+
+def test_chain_rule():
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x  # y = x^3, dy/dx = 3x^2 = 12
+    y.backward()
+    np.testing.assert_allclose(float(x.grad.numpy()), 12.0, rtol=1e-6)
+
+
+def test_grad_accumulation():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    # diamond: z = (x*2) + (x*3); dz/dx = 5
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    z = (a + b).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_matmul_grad():
+    A = np.random.rand(3, 4).astype(np.float32)
+    B = np.random.rand(4, 5).astype(np.float32)
+    a = pt.to_tensor(A, stop_gradient=False)
+    b = pt.to_tensor(B, stop_gradient=False)
+    (a @ b).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 5)) @ B.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               A.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = pt.to_tensor([2.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = (x * 2).detach() * x
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only through 2nd factor
+
+
+def test_no_grad_context():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    with pt.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_no_grad_decorator():
+    @pt.no_grad()
+    def f(t):
+        return t * 2
+
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    assert f(x).stop_gradient
+
+
+def test_multi_output_op_grad():
+    x_np = np.random.rand(2, 6).astype(np.float32)
+    x = pt.to_tensor(x_np, stop_gradient=False)
+    vals, idx = pt.topk(x, 3)
+    vals.sum().backward()
+    # grad is 1 at top-3 positions per row
+    expect = np.zeros_like(x_np)
+    for r in range(2):
+        expect[r, np.argsort(-x_np[r])[:3]] = 1.0
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+    assert idx.stop_gradient  # int output not differentiable
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        (x * 2).backward()
+    (x * 2).backward(pt.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_backward_frees_graph_unless_retained():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward(retain_graph=False)  # still works (graph retained from before)
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_tensor_register_hook():
+    x = pt.to_tensor([1.0, 1.0], stop_gradient=False)
+    calls = []
+
+    def double_hook(g):
+        calls.append(1)
+        return g * 2
+
+    x.register_hook(double_hook)
+    (x * 3).sum().backward()
+    assert calls
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_grad_api():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    (gx,) = pt.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [4.0])
+    assert x.grad is None  # .grad untouched by paddle.grad
+
+
+def test_grad_allow_unused():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    u = pt.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        pt.grad((x * 2).sum(), [x, u])
+    x.clear_grad()
+    gx, gu = pt.grad((x * 2).sum(), [x, u], allow_unused=True)
+    assert gu is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_broadcast_grad():
+    x = pt.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = pt.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    ((x + b) * 2).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [6.0] * 4)  # summed over bcast
+
+
+def test_pylayer():
+    import paddle_tpu.autograd as ag
+
+    class Double(ag.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy * 2
+
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [2.0, 4.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_getitem_grad():
+    x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                     stop_gradient=False)
+    x[0].sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1, 1], [0, 0, 0]])
+
+
+def test_check_nan_inf_flag():
+    pt.set_flags({"check_nan_inf": True})
+    try:
+        x = pt.to_tensor([1.0], stop_gradient=False)
+        with pytest.raises(FloatingPointError):
+            pt.log(x - 1.0) * 1.0  # log(0) = -inf
+    finally:
+        pt.set_flags({"check_nan_inf": False})

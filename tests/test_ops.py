@@ -1,0 +1,256 @@
+"""Op correctness against numpy oracles (OpTest style, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def rnd(*shape, dtype=np.float32):
+    return np.random.rand(*shape).astype(dtype)
+
+
+class TestMath:
+    def test_binary_broadcast(self):
+        a, b = rnd(3, 1, 4), rnd(2, 1)
+        np.testing.assert_allclose(
+            pt.add(pt.to_tensor(a), pt.to_tensor(b)).numpy(), a + b, rtol=1e-6)
+
+    def test_scale(self):
+        x = rnd(3)
+        np.testing.assert_allclose(
+            pt.scale(pt.to_tensor(x), 2.0, 1.0).numpy(), x * 2 + 1, rtol=1e-6)
+        np.testing.assert_allclose(
+            pt.scale(pt.to_tensor(x), 2.0, 1.0, bias_after_scale=False).numpy(),
+            (x + 1) * 2, rtol=1e-6)
+
+    def test_clip(self):
+        x = np.array([-2.0, 0.5, 3.0], np.float32)
+        np.testing.assert_allclose(
+            pt.clip(pt.to_tensor(x), 0.0, 1.0).numpy(), [0, 0.5, 1])
+
+    def test_cumsum(self):
+        x = rnd(2, 3)
+        np.testing.assert_allclose(pt.cumsum(pt.to_tensor(x), 1).numpy(),
+                                   np.cumsum(x, 1), rtol=1e-6)
+        np.testing.assert_allclose(pt.cumsum(pt.to_tensor(x)).numpy(),
+                                   np.cumsum(x), rtol=1e-6)
+
+    def test_remainder_floordiv(self):
+        a = np.array([7.0, -7.0], np.float32)
+        b = np.array([3.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            pt.remainder(pt.to_tensor(a), pt.to_tensor(b)).numpy(),
+            np.remainder(a, b))
+        np.testing.assert_allclose(
+            pt.floor_divide(pt.to_tensor(a), pt.to_tensor(b)).numpy(),
+            np.floor_divide(a, b))
+
+
+class TestReduction:
+    def test_sum_axes(self):
+        x = rnd(2, 3, 4)
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(pt.sum(t).numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(pt.sum(t, axis=1).numpy(), x.sum(1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.sum(t, axis=[0, 2], keepdim=True).numpy(),
+            x.sum((0, 2), keepdims=True), rtol=1e-5)
+
+    def test_mean_std_var(self):
+        x = rnd(4, 5)
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(pt.mean(t, 0).numpy(), x.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(pt.std(t).numpy(), x.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(pt.var(t, unbiased=False).numpy(),
+                                   x.var(), rtol=1e-5)
+
+    def test_argmax_argmin(self):
+        x = rnd(3, 5)
+        t = pt.to_tensor(x)
+        np.testing.assert_array_equal(pt.argmax(t, 1).numpy(), x.argmax(1))
+        np.testing.assert_array_equal(pt.argmin(t, 0).numpy(), x.argmin(0))
+        # x64 is disabled on TPU: "int64" results are stored 32-bit
+        assert pt.argmax(t, 1).dtype in (pt.int64, pt.int32)
+
+    def test_topk(self):
+        x = rnd(2, 8)
+        v, i = pt.topk(pt.to_tensor(x), 3)
+        expect = np.sort(x, 1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(v.numpy(), expect, rtol=1e-6)
+        v2, _ = pt.topk(pt.to_tensor(x), 3, largest=False)
+        np.testing.assert_allclose(v2.numpy(), np.sort(x, 1)[:, :3], rtol=1e-6)
+
+    def test_logsumexp(self):
+        x = rnd(3, 4)
+        np.testing.assert_allclose(
+            pt.logsumexp(pt.to_tensor(x), 1).numpy(),
+            np.log(np.exp(x).sum(1)), rtol=1e-4)
+
+    def test_all_any(self):
+        x = np.array([[True, False], [True, True]])
+        t = pt.to_tensor(x)
+        np.testing.assert_array_equal(pt.ops.OPS["all"](t, axis=1).numpy(),
+                                      x.all(1))
+        np.testing.assert_array_equal(pt.ops.OPS["any"](t, axis=0).numpy(),
+                                      x.any(0))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = rnd(2, 3, 4)
+        t = pt.to_tensor(x)
+        assert pt.reshape(t, [4, 6]).shape == [4, 6]
+        np.testing.assert_allclose(
+            pt.transpose(t, [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+
+    def test_concat_stack_split(self):
+        a, b = rnd(2, 3), rnd(2, 3)
+        np.testing.assert_allclose(
+            pt.concat([pt.to_tensor(a), pt.to_tensor(b)], 1).numpy(),
+            np.concatenate([a, b], 1))
+        np.testing.assert_allclose(
+            pt.stack([pt.to_tensor(a), pt.to_tensor(b)], 0).numpy(),
+            np.stack([a, b]))
+        parts = pt.split(pt.to_tensor(rnd(6, 2)), 3)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = pt.split(pt.to_tensor(rnd(7, 2)), [2, -1, 3])
+        assert [p.shape[0] for p in parts] == [2, 2, 3]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = rnd(1, 3, 1, 4)
+        t = pt.to_tensor(x)
+        assert pt.squeeze(t).shape == [3, 4]
+        assert pt.squeeze(t, 0).shape == [3, 1, 4]
+        assert pt.unsqueeze(pt.to_tensor(rnd(3)), [0, 2]).shape == [1, 3, 1]
+        assert pt.flatten(pt.to_tensor(rnd(2, 3, 4)), 1, 2).shape == [2, 12]
+
+    def test_gather_scatter(self):
+        x = rnd(5, 3)
+        idx = np.array([0, 3])
+        np.testing.assert_allclose(
+            pt.gather(pt.to_tensor(x), pt.to_tensor(idx)).numpy(), x[idx])
+        upd = rnd(2, 3)
+        out = pt.scatter(pt.to_tensor(x), pt.to_tensor(idx),
+                         pt.to_tensor(upd)).numpy()
+        expect = x.copy(); expect[idx] = upd
+        np.testing.assert_allclose(out, expect)
+
+    def test_gather_nd(self):
+        x = rnd(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]])
+        np.testing.assert_allclose(
+            pt.gather_nd(pt.to_tensor(x), pt.to_tensor(idx)).numpy(),
+            x[[0, 2], [1, 3]])
+
+    def test_where_nonzero(self):
+        c = np.array([[True, False], [False, True]])
+        a, b = rnd(2, 2), rnd(2, 2)
+        np.testing.assert_allclose(
+            pt.where(pt.to_tensor(c), pt.to_tensor(a), pt.to_tensor(b)).numpy(),
+            np.where(c, a, b))
+        nz = pt.nonzero(pt.to_tensor(c)).numpy()
+        np.testing.assert_array_equal(nz, np.stack(np.nonzero(c), -1))
+
+    def test_pad(self):
+        x = rnd(2, 3)
+        out = pt.ops.OPS["pad"](pt.to_tensor(x), [1, 1, 2, 2]).numpy()
+        assert out.shape == (4, 7)
+
+    def test_tril_triu(self):
+        x = rnd(4, 4)
+        np.testing.assert_allclose(pt.tril(pt.to_tensor(x)).numpy(),
+                                   np.tril(x))
+        np.testing.assert_allclose(pt.triu(pt.to_tensor(x), 1).numpy(),
+                                   np.triu(x, 1))
+
+    def test_tile_expand(self):
+        x = rnd(1, 3)
+        assert pt.tile(pt.to_tensor(x), [2, 2]).shape == [2, 6]
+        assert pt.expand(pt.to_tensor(x), [4, 3]).shape == [4, 3]
+        assert pt.expand(pt.to_tensor(x), [4, -1]).shape == [4, 3]
+
+    def test_sort_argsort(self):
+        x = rnd(3, 5)
+        np.testing.assert_allclose(pt.sort(pt.to_tensor(x), 1).numpy(),
+                                   np.sort(x, 1))
+        np.testing.assert_array_equal(pt.argsort(pt.to_tensor(x), 1).numpy(),
+                                      np.argsort(x, 1))
+
+    def test_one_hot(self):
+        x = np.array([0, 2, 1])
+        oh = pt.one_hot(pt.to_tensor(x), 3).numpy()
+        np.testing.assert_allclose(oh, np.eye(3)[x])
+
+    def test_take_put_along_axis(self):
+        x = rnd(3, 4)
+        idx = np.array([[1], [0], [2]])
+        np.testing.assert_allclose(
+            pt.take_along_axis(pt.to_tensor(x), pt.to_tensor(idx), 1,
+                               broadcast=False).numpy(),
+            np.take_along_axis(x, idx, 1))
+        out = pt.put_along_axis(pt.to_tensor(x), pt.to_tensor(idx),
+                                9.0, 1).numpy()
+        expect = x.copy()
+        np.put_along_axis(expect, idx, 9.0, 1)
+        np.testing.assert_allclose(out, expect)
+
+    def test_flip_roll(self):
+        x = rnd(3, 4)
+        np.testing.assert_allclose(pt.flip(pt.to_tensor(x), 0).numpy(),
+                                   x[::-1])
+        np.testing.assert_allclose(pt.roll(pt.to_tensor(x), 1, 1).numpy(),
+                                   np.roll(x, 1, 1))
+
+
+class TestLinalg:
+    def test_matmul_transpose_flags(self):
+        a, b = rnd(3, 4), rnd(5, 4)
+        np.testing.assert_allclose(
+            pt.matmul(pt.to_tensor(a), pt.to_tensor(b),
+                      transpose_y=True).numpy(), a @ b.T, rtol=1e-5)
+        np.testing.assert_allclose(
+            pt.matmul(pt.to_tensor(a.T), pt.to_tensor(b.T),
+                      transpose_x=True).numpy(), a @ b.T, rtol=1e-5)
+
+    def test_bmm(self):
+        a, b = rnd(2, 3, 4), rnd(2, 4, 5)
+        np.testing.assert_allclose(pt.bmm(pt.to_tensor(a),
+                                          pt.to_tensor(b)).numpy(),
+                                   a @ b, rtol=1e-5)
+
+    def test_einsum(self):
+        a, b = rnd(3, 4), rnd(4, 5)
+        np.testing.assert_allclose(
+            pt.einsum("ij,jk->ik", pt.to_tensor(a), pt.to_tensor(b)).numpy(),
+            a @ b, rtol=1e-5)
+
+    def test_norm(self):
+        x = rnd(3, 4)
+        np.testing.assert_allclose(pt.norm(pt.to_tensor(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(pt.norm(pt.to_tensor(x), p=1, axis=1).numpy(),
+                                   np.abs(x).sum(1), rtol=1e-5)
+
+    def test_solve_inverse_det(self):
+        a = rnd(3, 3) + np.eye(3, dtype=np.float32) * 3
+        b = rnd(3, 2)
+        np.testing.assert_allclose(
+            pt.solve(pt.to_tensor(a), pt.to_tensor(b)).numpy(),
+            np.linalg.solve(a, b), rtol=1e-4)
+        np.testing.assert_allclose(pt.inverse(pt.to_tensor(a)).numpy(),
+                                   np.linalg.inv(a), rtol=1e-4)
+        np.testing.assert_allclose(pt.det(pt.to_tensor(a)).numpy(),
+                                   np.linalg.det(a), rtol=1e-4)
+
+    def test_svd_qr_cholesky(self):
+        a = rnd(4, 3)
+        u, s, vt = pt.svd(pt.to_tensor(a))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vt.numpy(), a, rtol=1e-4, atol=1e-5)
+        q, r = pt.qr(pt.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4,
+                                   atol=1e-5)
+        spd = a.T @ a + np.eye(3, dtype=np.float32)
+        L = pt.cholesky(pt.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, rtol=1e-4)

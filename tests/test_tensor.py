@@ -1,0 +1,140 @@
+"""Tensor basics: creation, properties, conversion, indexing, inplace.
+
+Oracle style follows the reference's OpTest (numpy expectations;
+python/paddle/fluid/tests/unittests/op_test.py:326).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_to_tensor_defaults():
+    t = pt.to_tensor([1.0, 2.0, 3.0])
+    assert t.shape == [3]
+    assert t.dtype == pt.float32
+    assert t.stop_gradient is True
+    np.testing.assert_allclose(t.numpy(), [1, 2, 3])
+
+
+def test_to_tensor_int_dtype():
+    t = pt.to_tensor([1, 2, 3])
+    assert t.dtype == pt.int64 or t.dtype == pt.int32
+    t2 = pt.to_tensor(np.arange(4, dtype=np.int32))
+    assert t2.dtype == pt.int32
+
+
+def test_dtype_cast():
+    t = pt.to_tensor([1.5, 2.5])
+    i = t.astype("int32")
+    assert i.dtype == pt.int32
+    b = t.astype(pt.bfloat16)
+    assert b.dtype == pt.bfloat16
+
+
+def test_creation_ops():
+    assert pt.zeros([2, 3]).shape == [2, 3]
+    assert pt.ones([4]).numpy().sum() == 4
+    f = pt.full([2, 2], 7.0)
+    np.testing.assert_allclose(f.numpy(), np.full((2, 2), 7.0))
+    a = pt.arange(10)
+    np.testing.assert_array_equal(a.numpy(), np.arange(10))
+    e = pt.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    ln = pt.linspace(0, 1, 5)
+    np.testing.assert_allclose(ln.numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_random_reproducible():
+    pt.seed(7)
+    a = pt.randn([4, 4]).numpy()
+    pt.seed(7)
+    b = pt.randn([4, 4]).numpy()
+    np.testing.assert_array_equal(a, b)
+    c = pt.randn([4, 4]).numpy()
+    assert not np.array_equal(b, c)
+
+
+def test_arithmetic_dunders():
+    x = pt.to_tensor([1.0, 2.0])
+    y = pt.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((x + y).numpy(), [4, 6])
+    np.testing.assert_allclose((x - y).numpy(), [-2, -2])
+    np.testing.assert_allclose((x * y).numpy(), [3, 8])
+    np.testing.assert_allclose((y / x).numpy(), [3, 2])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 + x).numpy(), [3, 4])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2])
+    np.testing.assert_allclose(abs(pt.to_tensor([-1.0, 2.0])).numpy(), [1, 2])
+
+
+def test_comparisons():
+    x = pt.to_tensor([1.0, 2.0, 3.0])
+    y = pt.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((x < y).numpy(), [True, False, False])
+    np.testing.assert_array_equal((x == y).numpy(), [False, True, False])
+    np.testing.assert_array_equal((x >= y).numpy(), [False, True, True])
+
+
+def test_indexing():
+    x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    idx = pt.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    x = pt.zeros([3, 3])
+    x[1, 1] = 5.0
+    assert x.numpy()[1, 1] == 5.0
+    x[0] = pt.ones([3])
+    np.testing.assert_allclose(x.numpy()[0], [1, 1, 1])
+
+
+def test_inplace_mutation():
+    x = pt.ones([2, 2])
+    v0 = x.inplace_version
+    x.zero_()
+    assert x.numpy().sum() == 0
+    assert x.inplace_version == v0 + 1
+    x.fill_(3.0)
+    np.testing.assert_allclose(x.numpy(), np.full((2, 2), 3.0))
+    x.set_value(np.eye(2))
+    np.testing.assert_allclose(x.numpy(), np.eye(2))
+
+
+def test_item_and_scalars():
+    s = pt.to_tensor(3.5)
+    assert s.item() == 3.5
+    assert float(s) == 3.5
+    assert int(pt.to_tensor(7)) == 7
+    assert s.size == 1
+    assert s.ndim == 0
+
+
+def test_detach_clone():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    c = x.clone()
+    assert not np.shares_memory(c.numpy(), x.numpy())
+
+
+def test_repr_smoke():
+    r = repr(pt.to_tensor([1.0, 2.0], stop_gradient=False))
+    assert "Tensor" in r and "stop_gradient=False" in r
+
+
+def test_numpy_interop():
+    x = pt.to_tensor([[1.0, 2.0]])
+    assert np.asarray(x).shape == (1, 2)
+    assert len(x) == 1
+
+
+def test_parameter():
+    p = pt.Parameter(np.zeros((2, 2), np.float32))
+    assert not p.stop_gradient
+    assert p.persistable
+    assert p.trainable
