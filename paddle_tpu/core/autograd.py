@@ -190,13 +190,25 @@ def _zeros_like_aval(aval):
     return np.zeros(shape, jax.dtypes.float0)
 
 
-def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             grad_map: Optional[dict] = None,
+             taps: Optional[dict] = None):
     """Run the tape backward from ``tensors`` (paddle.autograd.backward parity).
 
     BFS with in-degree counting, mirroring the reference RunBackward
     (paddle/fluid/eager/backward.cc:104): dependency counts are computed by a DFS
     over the subgraph reachable from the roots, then nodes execute once all their
-    consumers have contributed cotangents.
+    consumers have contributed cotangents. Root nodes that are themselves
+    consumed by other roots (``backward([z, y])`` with ``z = f(y)``) are
+    deferred until their consumers have run, matching the reference's
+    re-queue-on-nonzero-in-degree check.
+
+    When ``grad_map`` is given (the ``paddle.grad`` path), leaf gradients are
+    collected into it keyed by ``id(leaf)`` instead of being written to
+    ``Tensor.grad`` — so ``grad()`` never pollutes parameter ``.grad`` fields.
+    ``taps`` maps ``id(tensor) -> (node, slot)`` for *intermediate* tensors
+    whose accumulated cotangent should also be captured into ``grad_map``
+    (the reference's GeneralGrad input-watching, eager/general_grad.h).
     """
     from .tensor import Tensor
 
@@ -260,7 +272,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                 indeg[id(p)] = indeg.get(id(p), 0) + 1
                 stack.append(p)
 
-    ready = [n for n in dict.fromkeys(roots)]
+    ready = [n for n in dict.fromkeys(roots) if indeg.get(id(n), 0) == 0]
     processed = set()
     while ready:
         node = ready.pop()
@@ -270,6 +282,10 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
         h = holders.pop(node, None)
         if h is None:
             h = [None] * node.n_outputs
+        if taps:
+            for tid, (tn, slot) in taps.items():
+                if tn is node and h[slot] is not None and grad_map is not None:
+                    grad_map[tid] = h[slot]
         cots = tuple(
             h[i] if h[i] is not None else _zeros_like_aval(node.out_avals[i])
             for i in range(node.n_outputs))
@@ -299,7 +315,10 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                 if indeg[id(p)] == 0:
                     ready.append(p)
     for t, g in list(pending_leaf.values()):
-        _write_leaf_grad(t, g)
+        if grad_map is not None:
+            grad_map[id(t)] = _run_leaf_hooks(t, g)
+        else:
+            _write_leaf_grad(t, g)
 
 
 def _vjp_multi(node):
@@ -310,12 +329,18 @@ def _is_float0(g):
     return hasattr(g, "dtype") and g.dtype == jax.dtypes.float0
 
 
-def _write_leaf_grad(t, g):
+def _run_leaf_hooks(t, g):
     from .tensor import Tensor
     for hook in t._hooks:
         out = hook(Tensor(g, stop_gradient=True))
         if out is not None:
             g = out.data if isinstance(out, Tensor) else out
+    return g
+
+
+def _write_leaf_grad(t, g):
+    from .tensor import Tensor
+    g = _run_leaf_hooks(t, g)
     if t.grad is None:
         t.grad = Tensor(g, stop_gradient=True)
     else:
@@ -326,8 +351,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
          create_graph=False, allow_unused=False):
     """paddle.grad parity (first order; reference: eager/general_grad.h).
 
-    Runs backward on a copy of the leaf-accumulation targets so that ``.grad``
-    fields of the model are not polluted, and returns grads w.r.t. ``inputs``.
+    Leaf grads are collected into a side map during the backward walk, so no
+    ``.grad`` field anywhere in the model is touched.
     """
     from .tensor import Tensor
     if create_graph:
@@ -340,18 +365,17 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     if isinstance(outputs, Tensor):
         outputs = [outputs]
 
-    saved = [(t, t.grad) for t in inputs]
-    for t in inputs:
-        t.grad = None
-    # ensure leaves are watchable even if stop_gradient was set after trace
-    backward(outputs, grad_outputs, retain_graph=retain_graph)
+    gmap: dict = {}
+    taps = {id(t): (t._grad_node, t._out_idx)
+            for t in inputs if t._grad_node is not None}
+    backward(outputs, grad_outputs, retain_graph=retain_graph, grad_map=gmap,
+             taps=taps)
     results = []
-    for (t, old) in saved:
-        g = t.grad
+    for t in inputs:
+        g = gmap.get(id(t))
         if g is None and not allow_unused:
             raise RuntimeError(
                 "one of the input tensors received no gradient; pass "
                 "allow_unused=True to get None instead")
-        results.append(g)
-        t.grad = old
+        results.append(Tensor(g, stop_gradient=True) if g is not None else None)
     return results

@@ -126,14 +126,34 @@ class Tensor:
                       stop_gradient=self.stop_gradient)
 
     def to(self, *args, **kwargs):
-        t = self
-        for a in list(args) + list(kwargs.values()):
-            if isinstance(a, (str, DType)) and not isinstance(a, str) or (
-                    isinstance(a, str) and a in ("float32", "float16", "bfloat16",
-                                                 "float64", "int32", "int64")):
-                t = t.astype(a)
+        dtype = kwargs.pop("dtype", None)
+        device = kwargs.pop("device", None) or kwargs.pop("place", None)
+        kwargs.pop("blocking", None)
+        for a in args:
+            if isinstance(a, DType):
+                dtype = a
             elif isinstance(a, str):
-                pass  # device strings: single-device eager; sharding via dist API
+                try:
+                    dtype = convert_dtype(a)
+                except (KeyError, ValueError, TypeError):
+                    device = a
+            elif isinstance(a, Tensor):
+                dtype = a.dtype
+        t = self
+        if device is not None:
+            plat, _, idx = str(device).partition(":")
+            plat = {"xpu": "tpu"}.get(plat, plat)
+            try:
+                devs = jax.devices(plat)
+            except RuntimeError as e:
+                raise ValueError(f"unknown device '{device}': {e}") from None
+            d = devs[int(idx)] if idx else devs[0]
+            # routed through the tape (identity vjp) so transfers mid-graph
+            # keep gradients flowing to upstream leaves
+            t = _ag.apply_op(lambda v: jax.device_put(v, d), t,
+                             op_name="device_put")
+        if dtype is not None:
+            t = t.astype(dtype)
         return t
 
     def pin_memory(self):

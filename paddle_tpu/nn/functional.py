@@ -225,7 +225,13 @@ def embedding(x, weight, padding_idx=None, sparse=False):
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train"):
-    if not training or p == 0.0:
+    if not training:
+        # downscale_in_infer scales by (1-p) at inference; upscale_in_train
+        # is identity at eval (python/paddle/nn/functional/common.py dropout).
+        if mode == "downscale_in_infer" and p > 0.0:
+            return apply_op(lambda v: v * (1.0 - p), x, op_name="dropout")
+        return x if isinstance(x, Tensor) else Tensor(x)
+    if p == 0.0:
         return x if isinstance(x, Tensor) else Tensor(x)
     key = _gen.next_key()
 
@@ -512,14 +518,24 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups,
         lhs_spec = "NC" + "DHW"[3 - nd:]
     # paddle weight layout: [out_c, in_c/groups, *k] (conv) or
     # [in_c, out_c/groups, *k] (conv_transpose)
-    rhs_spec = ("IO" if transpose else "OI") + "DHW"[3 - nd:]
+    rhs_spec = "OI" + "DHW"[3 - nd:]
     out_spec = lhs_spec
-    dn = jax.lax.conv_dimension_numbers(
-        x.shape if not isinstance(x, Tensor) else tuple(x.shape),
-        tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
+    k_spatial = tuple(int(s) for s in weight.shape[2:])
 
     if isinstance(padding, str):
-        pad = padding.upper()  # "SAME" / "VALID"
+        p_str = padding.upper()  # "SAME" / "VALID"
+        if transpose:
+            # explicit pads: VALID = 0; SAME makes output = input * stride
+            if p_str == "VALID":
+                pad = [(0, 0)] * nd
+            else:
+                pad = []
+                for i in range(nd):
+                    tot = max(dilation[i] * (k_spatial[i] - 1) + 1 - stride[i],
+                              0)
+                    pad.append((tot // 2, tot - tot // 2))
+        else:
+            pad = p_str
     else:
         p = _norm_tuple(padding, nd) if not (
             isinstance(padding, (list, tuple)) and len(padding) == 2 * nd) \
@@ -531,18 +547,32 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups,
 
     def f(v, w, *b):
         if transpose:
-            out = jax.lax.conv_transpose(
-                v, w, stride, pad if not isinstance(pad, str) else pad,
-                rhs_dilation=dilation, dimension_numbers=dn,
-                transpose_kernel=False)
-            if output_padding:
-                op_ = _norm_tuple(output_padding, nd)
-                pads = [(0, 0)] * v.ndim
-                for i, o_ in enumerate(op_):
-                    spatial_axis = (1 + i) if channel_last else (2 + i)
-                    pads[spatial_axis] = (0, int(o_))
-                out = jnp.pad(out, pads)
+            # Gradient-of-conv semantics (paddle conv_transpose): output size
+            # (in-1)*s - p_lo - p_hi + d*(k-1) + 1 + output_padding. Lower as
+            # an input-dilated conv with the spatially-flipped, OI-swapped
+            # kernel: lax pads on the dilated input are d*(k-1) - p, and
+            # output_padding extends the high side.
+            in_c = w.shape[0]
+            ocg = w.shape[1]
+            w2 = jnp.reshape(w, (groups, in_c // groups, ocg) + k_spatial)
+            w2 = jnp.swapaxes(w2, 1, 2)
+            w2 = jnp.reshape(w2, (groups * ocg, in_c // groups) + k_spatial)
+            w2 = jnp.flip(w2, axis=tuple(range(2, 2 + nd)))
+            opad = _norm_tuple(output_padding, nd)
+            adj = [(dilation[i] * (k_spatial[i] - 1) - pad[i][0],
+                    dilation[i] * (k_spatial[i] - 1) - pad[i][1] + opad[i])
+                   for i in range(nd)]
+            dn_t = jax.lax.conv_dimension_numbers(
+                tuple(v.shape), tuple(w2.shape),
+                (lhs_spec, rhs_spec, out_spec))
+            out = jax.lax.conv_general_dilated(
+                v, w2, (1,) * nd, adj, lhs_dilation=stride,
+                rhs_dilation=dilation, dimension_numbers=dn_t,
+                feature_group_count=groups)
         else:
+            dn = jax.lax.conv_dimension_numbers(
+                tuple(v.shape), tuple(w.shape),
+                (lhs_spec, rhs_spec, out_spec))
             out = jax.lax.conv_general_dilated(
                 v, w, stride, pad, rhs_dilation=dilation,
                 dimension_numbers=dn, feature_group_count=groups)
@@ -606,21 +636,34 @@ def _pool_nd(x, kernel_size, stride, padding, nd, reducer, init, data_format,
     st = _norm_tuple(stride if stride is not None else kernel_size, nd)
     pd = _norm_tuple(padding, nd)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
-    if channel_last:
-        window = (1,) + ks + (1,)
-        strides = (1,) + st + (1,)
-        pads = ((0, 0),) + tuple((p, p) for p in pd) + ((0, 0),)
-    else:
-        window = (1, 1) + ks
-        strides = (1, 1) + st
-        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pd)
+    spatial0 = 1 if channel_last else 2
 
     def f(v):
+        # ceil_mode: extend the high-side pad so the last partial window is
+        # kept; the extension is treated as padding (excluded from avg counts
+        # when exclusive), matching paddle's pool2d ceil semantics.
+        extra = [0] * nd
+        if ceil_mode:
+            for i in range(nd):
+                in_sz = v.shape[spatial0 + i]
+                span = in_sz + 2 * pd[i] - ks[i]
+                out_ceil = -(-span // st[i]) + 1
+                extra[i] = max(
+                    (out_ceil - 1) * st[i] + ks[i] - (in_sz + 2 * pd[i]), 0)
+        sp_pads = tuple((pd[i], pd[i] + extra[i]) for i in range(nd))
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            pads = ((0, 0),) + sp_pads + ((0, 0),)
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            pads = ((0, 0), (0, 0)) + sp_pads
         if reducer == "max":
             return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max, window,
                                          strides, pads)
         s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pads)
-        if exclusive and any(p > 0 for p in pd):
+        if exclusive and (any(p > 0 for p in pd) or any(e > 0 for e in extra)):
             ones = jnp.ones_like(v)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                         strides, pads)
@@ -1078,13 +1121,21 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
                  + jnp.exp(a_shift2 - m))
             new = m + jnp.log(jnp.maximum(s, 1e-30))
             emit = jnp.take_along_axis(lp_t, ext, axis=-1)
-            return new + emit, None
+            new = new + emit
+            return new, new
 
-        alpha_T, _ = jax.lax.scan(step, alpha0, lp[1:])
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        # [T, B, S] alpha per timestep; read each sample's alpha at its own
+        # final frame t = input_lengths[b] - 1 (padded frames past the true
+        # length must not contribute — warpctc honors per-sample lengths).
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        aT = jnp.take_along_axis(
+            all_alphas, t_idx[None, :, None].astype(jnp.int32),
+            axis=0)[0]  # [B, S]
         # gather final two states at position 2*label_len-1 and 2*label_len
         idx_last = 2 * lbl_len
         idx_prev = jnp.maximum(idx_last - 1, 0)
-        aT = alpha_T
         a_last = jnp.take_along_axis(aT, idx_last[:, None], axis=1)[:, 0]
         a_prev = jnp.take_along_axis(aT, idx_prev[:, None], axis=1)[:, 0]
         m = jnp.maximum(a_last, a_prev)

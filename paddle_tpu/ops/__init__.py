@@ -75,9 +75,6 @@ def monkey_patch_tensor():
         fn = OPS.get(name)
         if fn is None:
             continue
-        if name in ("all", "any", "max", "min", "sum", "t"):
-            # avoid clobbering python builtins semantics where already defined
-            pass
         setattr(Tensor, name, fn)
 
     # aliases matching paddle method names

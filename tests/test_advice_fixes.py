@@ -1,0 +1,181 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+Oracles: torch (CPU build, baked into the image) for conv/ctc/pool semantics —
+the same role numpy oracles play in the reference's OpTest (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+# ---------------- autograd: multi-root backward in-degree ---------------------
+def test_backward_multi_root_dependent_outputs():
+    # z = y*y with y = 3x; backward([z, y]) must give dz/dx + dy/dx = (1 + 2*y)*3 = 21
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = x * 3.0
+    z = y * y
+    pt.autograd.backward([z, y], [None, None])
+    np.testing.assert_allclose(x.grad.numpy(), [21.0], rtol=1e-6)
+
+
+def test_backward_multi_root_reverse_order():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = x * 3.0
+    z = y * y
+    pt.autograd.backward([y, z], [None, None])
+    np.testing.assert_allclose(x.grad.numpy(), [21.0], rtol=1e-6)
+
+
+def test_grad_does_not_pollute_other_leaves():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    w = pt.to_tensor([5.0], stop_gradient=False)
+    y = w * x
+    (gx,) = pt.grad(y, [x])
+    np.testing.assert_allclose(gx.numpy(), [5.0])
+    assert w.grad is None, ".grad of non-requested leaves must stay untouched"
+    assert x.grad is None
+
+
+# ---------------- conv_transpose ---------------------------------------------
+@pytest.mark.parametrize("stride,padding,output_padding,dilation", [
+    (1, 0, 0, 1),
+    (2, 1, 0, 1),
+    (2, 1, 1, 1),
+    (1, 2, 0, 2),
+    (3, 0, 2, 1),
+])
+def test_conv2d_transpose_matches_torch(stride, padding, output_padding,
+                                        dilation):
+    if output_padding >= max(stride, dilation):
+        pytest.skip("invalid combination")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 5, 5)).astype(np.float32)
+    w = rng.standard_normal((3, 4, 3, 3)).astype(np.float32)  # [in, out, kh, kw]
+    b = rng.standard_normal((4,)).astype(np.float32)
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=stride,
+        padding=padding, output_padding=output_padding, dilation=dilation)
+    out = F.conv2d_transpose(pt.to_tensor(x), pt.to_tensor(w), pt.to_tensor(b),
+                             stride=stride, padding=padding,
+                             output_padding=output_padding, dilation=dilation)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_default_expands():
+    # ADVICE repro: k=3, s=1, p=0 must expand 5x5 -> 7x7 (was shrinking to 2x2)
+    x = pt.ones([1, 1, 5, 5])
+    w = pt.ones([1, 1, 3, 3])
+    out = F.conv2d_transpose(x, w)
+    assert out.shape == [1, 1, 7, 7]
+
+
+def test_conv2d_transpose_groups():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 4, 6, 6)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)  # groups=2: out=6
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1, groups=2)
+    out = F.conv2d_transpose(pt.to_tensor(x), pt.to_tensor(w), stride=2,
+                             padding=1, groups=2)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_conv1d_and_conv2d_match_torch():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 3, 9)).astype(np.float32)
+    w = rng.standard_normal((5, 3, 3)).astype(np.float32)
+    ref = torch.nn.functional.conv1d(torch.tensor(x), torch.tensor(w),
+                                     stride=2, padding=1)
+    out = F.conv1d(pt.to_tensor(x), pt.to_tensor(w), stride=2, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+    x2 = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    w2 = rng.standard_normal((6, 1, 3, 3)).astype(np.float32)  # groups=3
+    ref2 = torch.nn.functional.conv2d(torch.tensor(x2), torch.tensor(w2),
+                                      padding=1, groups=3)
+    out2 = F.conv2d(pt.to_tensor(x2), pt.to_tensor(w2), padding=1, groups=3)
+    np.testing.assert_allclose(out2.numpy(), ref2.numpy(), rtol=1e-4, atol=1e-4)
+
+
+# ---------------- ctc_loss ----------------------------------------------------
+def test_ctc_loss_honors_input_lengths():
+    rng = np.random.default_rng(3)
+    T, B, C, L = 12, 3, 6, 4
+    logits = rng.standard_normal((T, B, C)).astype(np.float32)
+    log_probs = torch.log_softmax(torch.tensor(logits), dim=-1)
+    labels = rng.integers(1, C, size=(B, L)).astype(np.int64)
+    in_len = np.array([12, 7, 9], dtype=np.int64)
+    lbl_len = np.array([4, 2, 3], dtype=np.int64)
+    ref = torch.nn.functional.ctc_loss(
+        log_probs, torch.tensor(labels), torch.tensor(in_len),
+        torch.tensor(lbl_len), blank=0, reduction="none")
+    got = F.ctc_loss(pt.to_tensor(log_probs.numpy()), pt.to_tensor(labels),
+                     pt.to_tensor(in_len), pt.to_tensor(lbl_len), blank=0,
+                     reduction="none")
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+# ---------------- dropout / pool ----------------------------------------------
+def test_dropout_downscale_in_infer_eval_scales():
+    x = pt.ones([4])
+    out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), [0.5] * 4)
+    out2 = F.dropout(x, p=0.5, training=False, mode="upscale_in_train")
+    np.testing.assert_allclose(out2.numpy(), [1.0] * 4)
+
+
+@pytest.mark.parametrize("ceil_mode", [False, True])
+def test_max_pool2d_ceil_mode(ceil_mode):
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((1, 2, 7, 7)).astype(np.float32)
+    ref = torch.nn.functional.max_pool2d(torch.tensor(x), 3, stride=2,
+                                         padding=1, ceil_mode=ceil_mode)
+    out = F.max_pool2d(pt.to_tensor(x), 3, stride=2, padding=1,
+                       ceil_mode=ceil_mode)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("ceil_mode", [False, True])
+def test_avg_pool2d_ceil_mode(ceil_mode):
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((1, 2, 7, 7)).astype(np.float32)
+    # paddle exclusive=True == torch count_include_pad=False
+    ref = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 3, stride=2, padding=1, ceil_mode=ceil_mode,
+        count_include_pad=False)
+    out = F.avg_pool2d(pt.to_tensor(x), 3, stride=2, padding=1,
+                       ceil_mode=ceil_mode, exclusive=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+# ---------------- Tensor.to ---------------------------------------------------
+def test_tensor_to_dtype_and_device():
+    x = pt.to_tensor([1.0, 2.0])
+    y = x.to("float16")
+    assert y.dtype.name == "float16"
+    z = x.to("cpu")
+    assert z.place.startswith("cpu")
+    with pytest.raises(ValueError):
+        x.to("cuda")
+
+
+def test_grad_wrt_intermediate_tensor():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = x * 3.0
+    loss = y * y
+    (gy,) = pt.grad(loss, [y])
+    np.testing.assert_allclose(gy.numpy(), [12.0])  # 2*y = 12
+    assert x.grad is None and y.grad is None
+
+
+def test_tensor_to_dtype_aliases_and_grad_flow():
+    t = pt.to_tensor([1.0])
+    assert t.to("half").dtype.name == "float16"
+    # .to(device) mid-graph must not detach the tape
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    z = (x * 3.0).to("cpu")
+    (z * z).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [36.0])
