@@ -1,1 +1,14 @@
-"""paddle.nn parity namespace (populated in nn/layer.py etc.)."""
+"""paddle.nn parity namespace (reference: ``python/paddle/nn/``).
+
+Wires the Layer base, containers, initializers, grad-clip strategies, the
+functional library, and the layer zoo into the public API surface.
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer_base import Layer  # noqa: F401
+from .containers import (  # noqa: F401
+    Sequential, LayerList, LayerDict, ParameterList,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)

@@ -1,0 +1,143 @@
+"""Gradient clipping strategies.
+
+Parity with the reference's ``python/paddle/nn/clip.py`` (``ClipGradByValue``,
+``ClipGradByNorm``, ``ClipGradByGlobalNorm``). Clips operate on a list of
+``(param, grad)`` pairs, exactly like the reference's ``_dygraph_clip`` hooks
+that the ``Optimizer`` invokes before the update rule.
+
+TPU note: global-norm clip is a single fused reduction over all grads — XLA
+fuses the squared-norm accumulation into one program when run under jit, which
+replaces the reference's ``ClipGradByGlobalNorm`` multi-kernel sum.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+    def _clip(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    """Clip every gradient elementwise into [min, max].
+
+    Reference: ``python/paddle/nn/clip.py`` ClipGradByValue.
+    """
+
+    def __init__(self, max, min=None):
+        super().__init__()
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or p.stop_gradient:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g.data, self.min, self.max),
+                                  stop_gradient=True)))
+        return out
+
+    def __repr__(self):
+        return f"ClipGradByValue(min={self.min}, max={self.max})"
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Rescale each gradient independently so its own L2 norm <= clip_norm."""
+
+    def __init__(self, clip_norm):
+        super().__init__()
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or p.stop_gradient:
+                out.append((p, g))
+                continue
+            a = g.data
+            norm = jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32))))
+            scale = jnp.where(norm > self.clip_norm,
+                              self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor(a * scale.astype(a.dtype),
+                                  stop_gradient=True)))
+        return out
+
+    def __repr__(self):
+        return f"ClipGradByNorm(clip_norm={self.clip_norm})"
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Rescale all gradients jointly so the global L2 norm <= clip_norm.
+
+    Matches the reference semantics (``ClipGradByGlobalNorm._dygraph_clip``):
+    ``scale = clip_norm / max(global_norm, clip_norm)`` applied to every grad.
+    The norm accumulation runs in float32 regardless of grad dtype (the
+    reference promotes fp16 grads the same way).
+    """
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        super().__init__()
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _clip(self, params_grads):
+        sq = []
+        for p, g in params_grads:
+            if g is None or p.stop_gradient:
+                continue
+            sq.append(jnp.sum(jnp.square(g.data.astype(jnp.float32))))
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or p.stop_gradient:
+                out.append((p, g))
+                continue
+            a = g.data
+            out.append((p, Tensor(a * scale.astype(a.dtype),
+                                  stop_gradient=True)))
+        return out
+
+    def __repr__(self):
+        return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style utility (reference: ``paddle.nn.utils.clip_grad_norm_``).
+
+    Clips ``.grad`` of ``parameters`` in place; returns the total norm.
+    """
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g.data)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("the total norm for gradients is non-finite")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(p.grad.data * scale.astype(p.grad.data.dtype),
+                            stop_gradient=True)
+    return Tensor(total)

@@ -648,6 +648,11 @@ def _pool_nd(x, kernel_size, stride, padding, nd, reducer, init, data_format,
                 in_sz = v.shape[spatial0 + i]
                 span = in_sz + 2 * pd[i] - ks[i]
                 out_ceil = -(-span // st[i]) + 1
+                # torch/paddle clamp: the last window must start inside
+                # input + pad_lo, else it is dropped (no phantom all-pad
+                # window)
+                if (out_ceil - 1) * st[i] >= in_sz + pd[i]:
+                    out_ceil -= 1
                 extra[i] = max(
                     (out_ceil - 1) * st[i] + ks[i] - (in_sz + 2 * pd[i]), 0)
         sp_pads = tuple((pd[i], pd[i] + extra[i]) for i in range(nd))

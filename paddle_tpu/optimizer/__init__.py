@@ -1,1 +1,19 @@
-"""paddle.optimizer parity namespace."""
+"""paddle.optimizer parity namespace (reference: ``python/paddle/optimizer/``).
+
+Every optimizer's update rule is a pure-array function shared by the eager
+``step()`` path and the jitted train step (see ``optimizer.py`` module doc).
+"""
+from .optimizer import Optimizer  # noqa: F401
+from .sgd import SGD  # noqa: F401
+from .momentum import Momentum  # noqa: F401
+from .adam import Adam  # noqa: F401
+from .adamw import AdamW  # noqa: F401
+from .adagrad import Adagrad  # noqa: F401
+from .rmsprop import RMSProp  # noqa: F401
+from .adadelta import Adadelta  # noqa: F401
+from .adamax import Adamax  # noqa: F401
+from .lamb import Lamb  # noqa: F401
+from . import lr  # noqa: F401
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "RMSProp", "Adadelta", "Adamax", "Lamb", "lr"]

@@ -1,0 +1,43 @@
+"""Adadelta (reference: ``paddle/phi/kernels/impl/adadelta_kernel_impl.h`` —
+note the kernel applies no learning rate, matching the original paper)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Adadelta"]
+
+
+class Adadelta(Optimizer):
+    """asg = rho * asg + (1 - rho) * g^2
+    update = -sqrt((asu + eps) / (asg + eps)) * g
+    asu = rho * asu + (1 - rho) * update^2
+    param += update
+    """
+
+    _group_opts = ("rho", "epsilon")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho = float(rho)
+        self._epsilon = float(epsilon)
+
+    def _create_state(self, p):
+        dt = jnp.float32 if self._needs_master(p) else p.data.dtype
+        return {"avg_squared_grad": jnp.zeros(p.data.shape, dt),
+                "avg_squared_update": jnp.zeros(p.data.shape, dt)}
+
+    def _update(self, param, grad, state, lr, weight_decay=0.0, rho=0.95,
+                epsilon=1e-6):
+        g = grad.astype(param.dtype)
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        update = -jnp.sqrt(
+            (state["avg_squared_update"] + epsilon) / (asg + epsilon)) * g
+        asu = rho * state["avg_squared_update"] + (1 - rho) * update * update
+        ns = dict(state)
+        ns.update(avg_squared_grad=asg, avg_squared_update=asu)
+        return param + update, ns

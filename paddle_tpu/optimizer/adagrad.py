@@ -1,0 +1,36 @@
+"""Adagrad (reference: ``paddle/phi/kernels/impl/adagrad_kernel_impl.h``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Adagrad"]
+
+
+class Adagrad(Optimizer):
+    """moment += grad^2; param -= lr * grad / (sqrt(moment) + eps)."""
+
+    _group_opts = ("epsilon",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = float(epsilon)
+        self._initial_accumulator_value = float(initial_accumulator_value)
+
+    def _create_state(self, p):
+        dt = jnp.float32 if self._needs_master(p) else p.data.dtype
+        return {"moment": jnp.full(p.data.shape,
+                                   self._initial_accumulator_value, dt)}
+
+    def _update(self, param, grad, state, lr, weight_decay=0.0, epsilon=1e-6):
+        g = grad.astype(param.dtype)
+        moment = state["moment"] + g * g
+        new_p = param - lr * g / (jnp.sqrt(moment) + epsilon)
+        ns = dict(state)
+        ns["moment"] = moment
+        return new_p, ns

@@ -1,0 +1,42 @@
+"""Adamax (reference: ``paddle/phi/kernels/impl/adamax_kernel_impl.h``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Adamax"]
+
+
+class Adamax(Optimizer):
+    """m = b1*m + (1-b1)*g; u = max(|g|, b2*u + eps);
+    param -= lr / (1 - b1^t) * m / u
+    """
+
+    _group_opts = ("beta1", "beta2", "epsilon")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _create_state(self, p):
+        dt = jnp.float32 if self._needs_master(p) else p.data.dtype
+        return {"moment": jnp.zeros(p.data.shape, dt),
+                "inf_norm": jnp.zeros(p.data.shape, dt),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr, weight_decay=0.0, beta1=0.9,
+                beta2=0.999, epsilon=1e-8):
+        g = grad.astype(param.dtype)
+        m = beta1 * state["moment"] + (1 - beta1) * g
+        u = jnp.maximum(jnp.abs(g), beta2 * state["inf_norm"] + epsilon)
+        b1p = state["beta1_pow"] * beta1
+        new_p = param - (lr / (1 - b1p)).astype(param.dtype) * m / u
+        ns = dict(state)
+        ns.update(moment=m, inf_norm=u, beta1_pow=b1p)
+        return new_p, ns

@@ -1,0 +1,60 @@
+"""LAMB (reference: ``python/paddle/optimizer/lamb.py`` +
+``paddle/phi/kernels/funcs/lamb_functors.h``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Lamb"]
+
+
+class Lamb(Optimizer):
+    """Adam moments + layerwise trust ratio::
+
+        r = m_unbiased / (sqrt(v_unbiased) + eps) + lamb_wd * param
+        ratio = ||param|| / ||r||   (1 where either norm is 0)
+        param -= lr * ratio * r
+    """
+
+    _group_opts = ("beta1", "beta2", "epsilon", "lamb_weight_decay")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+        self._lamb_weight_decay = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_state(self, p):
+        dt = jnp.float32 if self._needs_master(p) else p.data.dtype
+        return {"moment1": jnp.zeros(p.data.shape, dt),
+                "moment2": jnp.zeros(p.data.shape, dt),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, param, grad, state, lr, weight_decay=0.0, beta1=0.9,
+                beta2=0.999, epsilon=1e-6, lamb_weight_decay=0.01):
+        if self._exclude_fn is not None and \
+                self._exclude_fn(getattr(self, "_cur_param", None)):
+            lamb_weight_decay = 0.0
+        g = grad.astype(param.dtype)
+        m = beta1 * state["moment1"] + (1 - beta1) * g
+        v = beta2 * state["moment2"] + (1 - beta2) * g * g
+        b1p = state["beta1_pow"] * beta1
+        b2p = state["beta2_pow"] * beta2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + epsilon) + lamb_weight_decay * param
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(param.astype(jnp.float32))))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r.astype(jnp.float32))))
+        ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        new_p = param - (lr * ratio).astype(param.dtype) * r
+        ns = dict(state)
+        ns.update(moment1=m, moment2=v, beta1_pow=b1p, beta2_pow=b2p)
+        return new_p, ns

@@ -1,0 +1,41 @@
+"""Momentum SGD (reference: ``python/paddle/optimizer/momentum.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Momentum"]
+
+
+class Momentum(Optimizer):
+    """velocity = mu * velocity + grad;
+    param -= lr * (grad + mu * velocity) if nesterov else lr * velocity.
+    """
+
+    _group_opts = ("momentum",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = float(momentum)
+        self._use_nesterov = use_nesterov
+
+    def _create_state(self, p):
+        return {"velocity": jnp.zeros(p.data.shape, self._acc_dtype(p))}
+
+    def _acc_dtype(self, p):
+        return jnp.float32 if self._needs_master(p) else p.data.dtype
+
+    def _update(self, param, grad, state, lr, weight_decay=0.0, momentum=0.9):
+        g = grad.astype(param.dtype)
+        v = momentum * state["velocity"] + g
+        if self._use_nesterov:
+            new_p = param - lr * (g + momentum * v)
+        else:
+            new_p = param - lr * v
+        ns = dict(state)
+        ns["velocity"] = v
+        return new_p, ns

@@ -1,0 +1,239 @@
+"""Optimizer base class.
+
+Parity with the reference's ``python/paddle/optimizer/optimizer.py``: parameter
+groups, float-or-LRScheduler learning rate, weight-decay regularization,
+grad-clip strategies, accumulator state with ``state_dict``/``set_state_dict``.
+
+TPU redesign: each optimizer's update rule is a *pure function*
+``_update(param, grad, state, lr, **group_opts) -> (new_param, new_state)`` over
+jax arrays, so the identical rule serves both the eager ``step()`` path and the
+fully-jitted train step (``paddle_tpu.jit`` traces ``_update`` straight into the
+compiled program — the analog of the reference's fused optimizer kernels,
+e.g. ``paddle/phi/kernels/gpu/adam_kernel.cu``, without hand-writing any).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.regularizer import L2Decay, WeightDecayRegularizer
+from . import lr as lr_mod
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    # subclasses list per-group hyperparameter names (beyond learning_rate /
+    # weight_decay) that _update receives as keyword args
+    _group_opts: Sequence[str] = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode: pass "
+                "model.parameters() (the reference's global-parameter static "
+                "mode has no analog here)")
+        self._lr = learning_rate
+        self._grad_clip = grad_clip
+        self._name = name
+        self._multi_precision = multi_precision
+        self._decoupled_decay = False  # AdamW overrides
+        self.regularization = self._make_decay(weight_decay)
+
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            self._param_groups = []
+            for g in params:
+                group = dict(g)
+                group["params"] = list(group["params"])
+                if "weight_decay" in group:
+                    group["weight_decay"] = self._make_decay(
+                        group["weight_decay"])
+                self._param_groups.append(group)
+        else:
+            self._param_groups = [{"params": params}]
+        for g in self._param_groups:
+            for p in g["params"]:
+                if not isinstance(p, Tensor):
+                    raise TypeError(
+                        f"optimizer parameters must be Tensors, got {type(p)}")
+
+        # accumulator state: id(param) -> {name: jnp array}; a parallel ref
+        # list keeps ids stable for the optimizer's lifetime
+        self._state: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+
+    # -- decay/lr plumbing -----------------------------------------------------
+    @staticmethod
+    def _make_decay(weight_decay):
+        if weight_decay is None:
+            return None
+        if isinstance(weight_decay, WeightDecayRegularizer):
+            return weight_decay
+        return L2Decay(float(weight_decay))
+
+    def get_lr(self) -> float:
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, value: float):
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is an LRScheduler; "
+                "call scheduler.step() instead (reference raises the same)")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler: lr_mod.LRScheduler):
+        self._lr = scheduler
+
+    # -- accumulators ----------------------------------------------------------
+    def _ensure_state(self, p: Tensor) -> Dict[str, jnp.ndarray]:
+        s = self._state.get(id(p))
+        if s is None:
+            s = self._create_state(p)
+            if self._needs_master(p):
+                s["master_weight"] = p.data.astype(jnp.float32)
+            self._state[id(p)] = s
+        return s
+
+    def _needs_master(self, p: Tensor) -> bool:
+        return self._multi_precision and p.data.dtype in (
+            jnp.bfloat16, jnp.float16)
+
+    def _create_state(self, p: Tensor) -> Dict[str, jnp.ndarray]:
+        """Per-parameter accumulator init (subclass hook)."""
+        return {}
+
+    # -- the update ------------------------------------------------------------
+    def _update(self, param, grad, state, lr, **opts):
+        """Pure update rule over jax arrays: returns (new_param, new_state)."""
+        raise NotImplementedError
+
+    def _group_kwargs(self, group) -> dict:
+        kw = {}
+        for name in self._group_opts:
+            if name in group:
+                kw[name] = group[name]
+            else:
+                kw[name] = getattr(self, "_" + name)
+        return kw
+
+    @property
+    def _parameter_list(self) -> List[Tensor]:
+        return [p for g in self._param_groups for p in g["params"]]
+
+    def step(self):
+        """Apply one update to every parameter that has a gradient.
+
+        Mirrors the reference dygraph ``Optimizer.step`` →
+        ``_apply_optimize``: collect (param, grad), run grad-clip, fold
+        regularization into the grad, then the rule.
+        """
+        self._step_count += 1
+        for group in self._param_groups:
+            params_grads = [(p, p.grad) for p in group["params"]
+                            if not p.stop_gradient and p.grad is not None]
+            if not params_grads:
+                continue
+            if self._grad_clip is not None:
+                params_grads = self._grad_clip(params_grads)
+            lr = group.get("learning_rate", 1.0)
+            if isinstance(lr, lr_mod.LRScheduler):
+                lr = lr()
+            lr = lr * self.get_lr() if "learning_rate" in group else \
+                self.get_lr()
+            decay = group.get("weight_decay", self.regularization)
+            kw = self._group_kwargs(group)
+            for p, g in params_grads:
+                state = self._ensure_state(p)
+                g_arr = g.data.astype(jnp.float32) if "master_weight" in state \
+                    else g.data
+                p_arr = state.get("master_weight", p.data)
+                if decay is not None and not self._decoupled_decay:
+                    g_arr = decay(p_arr, g_arr)
+                dcoeff = self._decay_coeff_for(p, decay) \
+                    if self._decoupled_decay else 0.0
+                self._cur_param = p  # visible to per-param rule hooks (Lamb)
+                new_p, new_state = self._update(
+                    p_arr, g_arr, state, self._param_lr(p, lr),
+                    weight_decay=dcoeff, **kw)
+                if "master_weight" in state:
+                    new_state["master_weight"] = new_p
+                    new_p = new_p.astype(p.data.dtype)
+                p._data = new_p
+                p._version += 1
+                self._state[id(p)] = new_state
+
+    def _decay_coeff_for(self, p: Tensor, decay) -> float:
+        """Decoupled-decay coefficient for one param (AdamW hook)."""
+        return decay.coeff if decay is not None else 0.0
+
+    def _param_lr(self, p: Tensor, lr: float) -> float:
+        """Per-parameter LR scaling (AdamW lr_ratio hook)."""
+        return lr
+
+    def clear_grad(self, set_to_zero: bool = True):
+        """Reset gradients. Paddle-parity default ``set_to_zero=True`` keeps a
+        zero tensor in ``.grad`` (accumulation semantics); ``False`` drops the
+        storage entirely."""
+        for p in self._parameter_list:
+            if set_to_zero:
+                if p.grad is not None:
+                    p.grad = Tensor(jnp.zeros_like(p.grad.data),
+                                    stop_gradient=True)
+            else:
+                p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Dygraph parity: backward + step (reference Optimizer.minimize)."""
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    # -- (de)serialization -----------------------------------------------------
+    def _param_key(self, idx: int, p: Tensor) -> str:
+        return p.name if p.name else f"param_{idx}"
+
+    def state_dict(self) -> dict:
+        sd: dict = {}
+        for idx, p in enumerate(self._parameter_list):
+            s = self._state.get(id(p))
+            if not s:
+                continue
+            key = self._param_key(idx, p)
+            for name, arr in s.items():
+                sd[f"{key}.{name}"] = Tensor(arr) if hasattr(arr, "dtype") \
+                    else arr
+        sd["@step_count"] = self._step_count
+        if isinstance(self._lr, lr_mod.LRScheduler):
+            sd["LR_Scheduler"] = self._lr.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict: dict):
+        sd = dict(state_dict)
+        self._step_count = int(sd.pop("@step_count", self._step_count))
+        lr_state = sd.pop("LR_Scheduler", None)
+        if lr_state is not None and isinstance(self._lr, lr_mod.LRScheduler):
+            self._lr.set_state_dict(dict(lr_state))
+        by_param: Dict[str, dict] = {}
+        for full, v in sd.items():
+            key, _, name = full.rpartition(".")
+            by_param.setdefault(key, {})[name] = \
+                v.data if isinstance(v, Tensor) else jnp.asarray(v)
+        for idx, p in enumerate(self._parameter_list):
+            key = self._param_key(idx, p)
+            if key in by_param:
+                self._state[id(p)] = by_param[key]
+
+    load_state_dict = set_state_dict
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(lr={self.get_lr()}, "
+                f"params={len(self._parameter_list)})")

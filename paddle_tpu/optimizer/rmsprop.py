@@ -1,0 +1,56 @@
+"""RMSProp (reference: ``paddle/phi/kernels/impl/rmsprop_kernel_impl.h``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["RMSProp"]
+
+
+class RMSProp(Optimizer):
+    """Uncentered::
+
+        ms = rho * ms + (1 - rho) * g^2
+        mom = momentum * mom + lr * g / sqrt(ms + eps)
+        param -= mom
+
+    Centered replaces the denominator with ``sqrt(ms - mg^2 + eps)`` where
+    ``mg = rho * mg + (1 - rho) * g``.
+    """
+
+    _group_opts = ("rho", "epsilon", "momentum")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho = float(rho)
+        self._epsilon = float(epsilon)
+        self._momentum = float(momentum)
+        self._centered = centered
+
+    def _create_state(self, p):
+        dt = jnp.float32 if self._needs_master(p) else p.data.dtype
+        s = {"mean_square": jnp.zeros(p.data.shape, dt),
+             "momentum_acc": jnp.zeros(p.data.shape, dt)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(p.data.shape, dt)
+        return s
+
+    def _update(self, param, grad, state, lr, weight_decay=0.0, rho=0.95,
+                epsilon=1e-6, momentum=0.0):
+        g = grad.astype(param.dtype)
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        ns = dict(state)
+        ns["mean_square"] = ms
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            ns["mean_grad"] = mg
+            denom = ms - mg * mg + epsilon
+        else:
+            denom = ms + epsilon
+        mom = momentum * state["momentum_acc"] + lr * g / jnp.sqrt(denom)
+        ns["momentum_acc"] = mom
+        return param - mom, ns

@@ -1,0 +1,14 @@
+"""SGD (reference: ``python/paddle/optimizer/sgd.py``)."""
+from __future__ import annotations
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD"]
+
+
+class SGD(Optimizer):
+    """param = param - lr * grad."""
+
+    def _update(self, param, grad, state, lr, weight_decay=0.0):
+        new_p = param - lr * grad.astype(param.dtype)
+        return new_p, dict(state)

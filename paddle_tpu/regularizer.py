@@ -1,0 +1,38 @@
+"""Weight-decay regularizers (reference: ``python/paddle/regularizer.py``).
+
+In the reference these append a regularization op to the grad before the
+optimizer update; here they are pure functions the optimizer folds into the
+gradient (XLA fuses the axpy into the update kernel under jit).
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param_arr, grad_arr):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param)."""
+
+    def __call__(self, param_arr, grad_arr):
+        import jax.numpy as jnp
+        return grad_arr + self.coeff * jnp.sign(param_arr).astype(grad_arr.dtype)
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param."""
+
+    def __call__(self, param_arr, grad_arr):
+        return grad_arr + self.coeff * param_arr.astype(grad_arr.dtype)
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"
