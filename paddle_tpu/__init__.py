@@ -30,6 +30,8 @@ from paddle_tpu.ops import *  # noqa: F401,F403
 from paddle_tpu import nn  # noqa: F401
 from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import autograd  # noqa: F401
+from paddle_tpu import regularizer  # noqa: F401
+from paddle_tpu.param_attr import ParamAttr  # noqa: F401
 
 bool = bool_  # paddle.bool
 

@@ -12,3 +12,6 @@ from .containers import (  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )
+from .layer import *  # noqa: F401,F403  (the layer zoo)
+from . import layer  # noqa: F401
+from .utils import clip_grad_norm_  # noqa: F401

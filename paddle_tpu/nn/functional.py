@@ -38,7 +38,8 @@ __all__ = [
     "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
     "conv3d_transpose", "max_pool1d", "max_pool2d", "max_pool3d",
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "adaptive_avg_pool1d",
-    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool2d",
+    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool2d", "adaptive_max_pool3d",
     # attention
     "scaled_dot_product_attention", "flash_attention",
     # losses
@@ -721,16 +722,34 @@ def _adaptive_pool(x, output_size, nd, mode, data_format):
         out = v
         for i, o in enumerate(out_sz):
             ax = spatial_start + i
-            in_sz = v.shape[ax]
-            if in_sz % o != 0:
-                raise NotImplementedError(
-                    "adaptive pool requires divisible sizes on TPU "
-                    f"(got {in_sz}->{o}); pad/crop first")
-            k = in_sz // o
-            new_shape = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
-            r = jnp.reshape(out, new_shape)
-            out = jnp.max(r, axis=ax + 1) if mode == "max" \
-                else jnp.mean(r, axis=ax + 1)
+            in_sz = out.shape[ax]
+            if in_sz % o == 0:
+                # uniform windows: reshape + reduce (maps to one XLA reduce)
+                k = in_sz // o
+                new_shape = out.shape[:ax] + (o, k) + out.shape[ax + 1:]
+                r = jnp.reshape(out, new_shape)
+                out = jnp.max(r, axis=ax + 1) if mode == "max" \
+                    else jnp.mean(r, axis=ax + 1)
+            else:
+                # non-uniform windows (torch/paddle rule: window i spans
+                # [floor(i*in/o), ceil((i+1)*in/o))): contract along the axis
+                # with a per-output-row membership mask — small dense [o, in]
+                # matmul, MXU-friendly, static shapes
+                starts = (np.arange(o) * in_sz) // o
+                ends = -((-(np.arange(o) + 1) * in_sz) // o)
+                idx = np.arange(in_sz)
+                member = (idx[None, :] >= starts[:, None]) & \
+                         (idx[None, :] < ends[:, None])
+                moved = jnp.moveaxis(out, ax, -1)
+                if mode == "max":
+                    masked = jnp.where(
+                        jnp.asarray(member), moved[..., None, :],
+                        jnp.asarray(-jnp.inf, moved.dtype))
+                    red = jnp.max(masked, axis=-1)
+                else:
+                    w = member / member.sum(axis=1, keepdims=True)
+                    red = moved @ jnp.asarray(w, moved.dtype).T
+                out = jnp.moveaxis(red, -1, ax)
         return out
     return apply_op(f, x, op_name=f"adaptive_{mode}_pool{nd}d")
 
@@ -747,8 +766,27 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
     return _adaptive_pool(x, output_size, 3, "avg", data_format)
 
 
-def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+def _no_mask(return_mask):
+    if return_mask:
+        raise NotImplementedError(
+            "return_mask=True (argmax indices) is not implemented; "
+            "silently dropping it would corrupt tuple-unpacking callers")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, data_format="NCL"):
+    _no_mask(return_mask)
+    return _adaptive_pool(x, output_size, 1, "max", data_format)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, data_format="NCHW"):
+    _no_mask(return_mask)
     return _adaptive_pool(x, output_size, 2, "max", data_format)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False,
+                        data_format="NCDHW"):
+    _no_mask(return_mask)
+    return _adaptive_pool(x, output_size, 3, "max", data_format)
 
 
 # =========================== resize / shuffle ================================
@@ -1078,10 +1116,14 @@ def cosine_embedding_loss(input1, input2, label, margin=0.0,
 
 
 def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
-                        reduction="mean"):
+                        epsilon=1e-6, swap=False, reduction="mean"):
     def f(a, pos, neg):
-        dp = jnp.linalg.norm(a - pos, ord=p, axis=-1)
-        dn = jnp.linalg.norm(a - neg, ord=p, axis=-1)
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            # paddle/torch swap: also consider positive-negative distance
+            dpn = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dpn)
         return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
     return apply_op(f, input, positive, negative,
                     op_name="triplet_margin_loss")

@@ -1,0 +1,313 @@
+"""Common Layer classes (reference: ``python/paddle/nn/layer/common.py``):
+Linear, Embedding, dropout/padding/upsample wrappers, Identity, Flatten,
+Unfold/Fold, Bilinear, distance layers.
+"""
+from __future__ import annotations
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = [
+    "Linear", "Embedding", "Identity", "Flatten", "Dropout", "Dropout2D",
+    "Dropout3D", "AlphaDropout", "Upsample", "UpsamplingNearest2D",
+    "UpsamplingBilinear2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
+    "Bilinear", "CosineSimilarity", "PairwiseDistance", "Unfold", "Fold",
+    "PixelShuffle", "PixelUnshuffle", "ChannelShuffle", "LabelSmooth",
+]
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Linear(Layer):
+    """y = x @ W + b with W of shape [in_features, out_features]
+    (reference: common.py Linear — note paddle stores W untransposed, unlike
+    torch; matmul maps straight onto the MXU in bf16)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return (f"in_features={self._in_features}, "
+                f"out_features={self._out_features}, "
+                f"bias={self.bias is not None}")
+
+
+class Embedding(Layer):
+    """Token lookup (reference: common.py Embedding). On TPU the lookup is an
+    XLA gather; with a mesh the table shards on the vocab axis (see
+    ``paddle_tpu.distributed.fleet.mpu.VocabParallelEmbedding``)."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        if padding_idx is not None and padding_idx < 0:
+            padding_idx += num_embeddings
+        self._padding_idx = padding_idx
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal() if (
+                weight_attr is None or weight_attr.initializer is None)
+            else None)
+        if padding_idx is not None:
+            import jax.numpy as jnp
+            self.weight._data = self.weight._data.at[padding_idx].set(0.0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+    def extra_repr(self):
+        return f"{self._num_embeddings}, {self._embedding_dim}"
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._start_axis = start_axis
+        self._stop_axis = stop_axis
+
+    def forward(self, x):
+        from paddle_tpu import ops
+        return ops.flatten(x, self._start_axis, self._stop_axis)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self._p, self._axis, self._mode = p, axis, mode
+
+    def forward(self, x):
+        return F.dropout(x, self._p, axis=self._axis, training=self.training,
+                         mode=self._mode)
+
+    def extra_repr(self):
+        return f"p={self._p}, mode={self._mode}"
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self._p, self._data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout2d(x, self._p, training=self.training,
+                           data_format=self._data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self._p, self._data_format = p, data_format
+
+    def forward(self, x):
+        return F.dropout3d(x, self._p, training=self.training,
+                           data_format=self._data_format)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self._p, training=self.training)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._size = size
+        self._scale_factor = scale_factor
+        self._mode = mode
+        self._align_corners = align_corners
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self._size,
+                             scale_factor=self._scale_factor, mode=self._mode,
+                             align_corners=self._align_corners,
+                             data_format=self._data_format)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._size, self._scale_factor = size, scale_factor
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self._size,
+                             scale_factor=self._scale_factor, mode="nearest",
+                             data_format=self._data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._size, self._scale_factor = size, scale_factor
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=self._size,
+                             scale_factor=self._scale_factor, mode="bilinear",
+                             align_corners=True,
+                             data_format=self._data_format)
+
+
+class _PadNd(Layer):
+    _nd = 2
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format=None, name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * self._nd)
+        self._padding = list(padding)
+        self._mode = mode
+        self._value = value
+        self._data_format = data_format or \
+            {1: "NCL", 2: "NCHW", 3: "NCDHW"}[self._nd]
+
+    def forward(self, x):
+        from paddle_tpu import ops
+        return ops.pad(x, self._padding, mode=self._mode, value=self._value,
+                       data_format=self._data_format)
+
+    def extra_repr(self):
+        return f"padding={self._padding}, mode={self._mode}"
+
+
+class Pad1D(_PadNd):
+    _nd = 1
+
+
+class Pad2D(_PadNd):
+    _nd = 2
+
+
+class Pad3D(_PadNd):
+    _nd = 3
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        self.weight = self.create_parameter(
+            shape=[out_features, in1_features, in2_features], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[1, out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x1, x2):
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self._axis, self._eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self._axis, eps=self._eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self._p, self._epsilon, self._keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self._p, self._epsilon,
+                                   self._keepdim)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self._args
+        return F.unfold(x, k, s, p, d)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        o, k, s, p, d = self._args
+        return F.fold(x, o, k, s, p, d)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._factor, self._data_format = upscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self._factor, self._data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self._factor, self._data_format = downscale_factor, data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self._factor, self._data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self._groups, self._data_format = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self._groups, self._data_format)
+
+
+class LabelSmooth(Layer):
+    def __init__(self, epsilon=0.1, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+
+    def forward(self, label):
+        return F.label_smooth(label, epsilon=self._epsilon)

@@ -1,0 +1,131 @@
+"""Convolution Layer classes (reference: ``python/paddle/nn/layer/conv.py``).
+
+Weight layout is paddle's ``[out_channels, in_channels/groups, *kernel]``
+(transpose convs: ``[in_channels, out_channels/groups, *kernel]``); the
+functional lowering emits ``lax.conv_general_dilated`` which XLA tiles onto
+the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose"]
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+class _ConvNd(Layer):
+    _nd = 2
+    _transpose = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 output_padding=0):
+        super().__init__()
+        nd = self._nd
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _ntuple(kernel_size, nd)
+        self._stride = _ntuple(stride, nd)
+        self._padding = padding
+        self._dilation = _ntuple(dilation, nd)
+        self._groups = groups
+        self._padding_mode = padding_mode
+        self._output_padding = output_padding
+        self._data_format = data_format or \
+            {1: "NCL", 2: "NCHW", 3: "NCDHW"}[nd]
+
+        if self._transpose:
+            w_shape = [in_channels, out_channels // groups,
+                       *self._kernel_size]
+        else:
+            w_shape = [out_channels, in_channels // groups,
+                       *self._kernel_size]
+        # paddle conv default init: Normal(0, sqrt(2/(fan_in*filter_elems)))
+        # approximated by KaimingNormal on fan_in (same variance family)
+        fan_in = in_channels // groups * int(np.prod(self._kernel_size))
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        default = I.Normal(0.0, np.sqrt(2.0 / max(fan_in, 1)))
+        self.weight = self.create_parameter(
+            shape=w_shape, attr=weight_attr, default_initializer=default
+            if weight_attr is None or weight_attr.initializer is None
+            else None)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, stride={self._stride}, "
+                f"padding={self._padding}")
+
+
+class Conv1D(_ConvNd):
+    _nd = 1
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class Conv2D(_ConvNd):
+    _nd = 2
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class Conv3D(_ConvNd):
+    _nd = 3
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    _nd = 1
+    _transpose = True
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._dilation, self._groups,
+                                  self._data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    _nd = 2
+    _transpose = True
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._dilation, self._groups,
+                                  self._data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    _nd = 3
+    _transpose = True
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._dilation, self._groups,
+                                  self._data_format)

@@ -1,0 +1,274 @@
+"""Normalization Layer classes (reference: ``python/paddle/nn/layer/norm.py``).
+
+BatchNorm keeps running stats as non-trainable buffers updated in train mode
+(matching the reference's ``_BatchNormBase``); under a sharded data mesh the
+batch statistics reduce over the global batch automatically because the mean /
+variance reductions compile into XLA collectives — which is why
+``SyncBatchNorm`` is the same computation here (no NCCL sync kernel needed).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = ["LayerNorm", "RMSNorm", "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+           "BatchNorm3D", "SyncBatchNorm", "GroupNorm", "InstanceNorm1D",
+           "InstanceNorm2D", "InstanceNorm3D", "LocalResponseNorm",
+           "SpectralNorm"]
+
+
+class LayerNorm(Layer):
+    """(reference: norm.py LayerNorm over the trailing ``normalized_shape``)."""
+
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        self.weight = None if weight_attr is False else self.create_parameter(
+            shape=self._normalized_shape, attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=self._normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """Root-mean-square norm — the LLM-era workhorse. The reference snapshot
+    lacks it as a layer (PaddleNLP composes it); included as a first-class
+    layer for the Llama/ERNIE recipes (BASELINE.md configs)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            shape=[hidden_size], attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self._epsilon)
+
+
+class _BatchNormBase(Layer):
+    _nd = 2  # expected spatial rank + 2 == input ndim (1D accepts 2/3)
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        self.weight = None if weight_attr is False else self.create_parameter(
+            shape=[num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[num_features], attr=bias_attr, is_bias=True)
+        self.register_buffer(
+            "_mean", Tensor(np.zeros(num_features, np.float32)))
+        self.register_buffer(
+            "_variance", Tensor(np.ones(num_features, np.float32)))
+
+    def forward(self, x):
+        training = self.training and not (self._use_global_stats is True)
+        return F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format)
+
+    def extra_repr(self):
+        return (f"num_features={self._num_features}, "
+                f"momentum={self._momentum}, epsilon={self._epsilon}")
+
+
+class BatchNorm1D(_BatchNormBase):
+    _nd = 1
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats, name)
+
+
+class BatchNorm2D(_BatchNormBase):
+    _nd = 2
+
+
+class BatchNorm3D(_BatchNormBase):
+    _nd = 3
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats, name)
+
+
+class BatchNorm(_BatchNormBase):
+    """Dimension-agnostic alias (reference keeps paddle.nn.BatchNorm)."""
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm (reference: norm.py SyncBatchNorm backed by
+    a NCCL allreduce kernel). On a GSPMD mesh the plain batch_norm reductions
+    already span the sharded batch axis inside one XLA program, so the compute
+    is identical; the class exists for API parity and ``convert_sync_batchnorm``.
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and \
+                not isinstance(layer, SyncBatchNorm):
+            out = SyncBatchNorm(layer._num_features, layer._momentum,
+                                layer._epsilon,
+                                data_format=layer._data_format)
+            if layer.weight is not None:
+                out.weight.set_value(layer.weight)
+            if layer.bias is not None:
+                out.bias.set_value(layer.bias)
+            out._mean.set_value(layer._mean)
+            out._variance.set_value(layer._variance)
+        for name, sub in list(layer._sub_layers.items()):
+            out._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return out
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        self.weight = None if weight_attr is False else self.create_parameter(
+            shape=[num_channels], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[num_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._num_groups, self.weight, self.bias,
+                            self._epsilon, self._data_format)
+
+    def extra_repr(self):
+        return (f"num_groups={self._num_groups}, "
+                f"num_channels={self._num_channels}")
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        if weight_attr is False:
+            self.scale = None
+        else:
+            self.scale = self.create_parameter(
+                shape=[num_features], attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[num_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.scale, self.bias, self._epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (size, alpha, beta, k)
+        self._data_format = data_format
+
+    def forward(self, x):
+        size, alpha, beta, k = self._args
+        return F.local_response_norm(x, size, alpha, beta, k,
+                                     self._data_format)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight tensor via power iteration
+    (reference: norm.py SpectralNorm, ``spectral_norm`` op)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        import jax.numpy as jnp
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            shape=[h], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            shape=[w], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.autograd import apply_op
+
+        dim, eps, iters = self._dim, self._epsilon, self._power_iters
+        u0, v0 = self.weight_u.data, self.weight_v.data
+
+        def f(w):
+            wm = jnp.moveaxis(w, dim, 0)
+            mat = wm.reshape(wm.shape[0], -1)
+            u, v = u0, v0
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            # u/v persist across forwards (the reference updates the
+            # buffers each call so power iteration converges over steps)
+            return w / sigma, jax.lax.stop_gradient(u), \
+                jax.lax.stop_gradient(v)
+        out, u_new, v_new = apply_op(f, weight, op_name="spectral_norm")
+        self.weight_u.set_value(u_new.data)
+        self.weight_v.set_value(v_new.data)
+        return out

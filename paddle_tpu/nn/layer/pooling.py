@@ -1,0 +1,137 @@
+"""Pooling Layer classes (reference: ``python/paddle/nn/layer/pooling.py``)."""
+from __future__ import annotations
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+           "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D"]
+
+
+class _PoolNd(Layer):
+    _fn = None
+    _default_fmt = "NCHW"
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, data_format=None, return_mask=False,
+                 name=None):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+        self._exclusive = exclusive
+        self._data_format = data_format or self._default_fmt
+        if return_mask:
+            raise NotImplementedError(
+                "return_mask=True (argmax indices) is not implemented")
+
+    def extra_repr(self):
+        return (f"kernel_size={self._kernel_size}, stride={self._stride}, "
+                f"padding={self._padding}")
+
+
+class MaxPool1D(_PoolNd):
+    _default_fmt = "NCL"
+
+    def forward(self, x):
+        return F.max_pool1d(x, self._kernel_size, self._stride, self._padding,
+                            self._ceil_mode, self._data_format)
+
+
+class MaxPool2D(_PoolNd):
+    def forward(self, x):
+        return F.max_pool2d(x, self._kernel_size, self._stride, self._padding,
+                            self._ceil_mode, self._data_format)
+
+
+class MaxPool3D(_PoolNd):
+    _default_fmt = "NCDHW"
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, self._stride, self._padding,
+                            self._ceil_mode, self._data_format)
+
+
+class AvgPool1D(_PoolNd):
+    _default_fmt = "NCL"
+
+    def forward(self, x):
+        return F.avg_pool1d(x, self._kernel_size, self._stride, self._padding,
+                            self._exclusive, self._ceil_mode,
+                            self._data_format)
+
+
+class AvgPool2D(_PoolNd):
+    def forward(self, x):
+        return F.avg_pool2d(x, self._kernel_size, self._stride, self._padding,
+                            self._exclusive, self._ceil_mode,
+                            self._data_format)
+
+
+class AvgPool3D(_PoolNd):
+    _default_fmt = "NCDHW"
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self._kernel_size, self._stride, self._padding,
+                            self._exclusive, self._ceil_mode,
+                            self._data_format)
+
+
+class _AdaptivePoolNd(Layer):
+    _default_fmt = "NCHW"
+
+    def __init__(self, output_size, return_mask=False, data_format=None,
+                 name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._data_format = data_format or self._default_fmt
+        if return_mask:
+            raise NotImplementedError(
+                "return_mask=True (argmax indices) is not implemented")
+
+    def extra_repr(self):
+        return f"output_size={self._output_size}"
+
+
+class AdaptiveAvgPool1D(_AdaptivePoolNd):
+    _default_fmt = "NCL"
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self._output_size, self._data_format)
+
+
+class AdaptiveAvgPool2D(_AdaptivePoolNd):
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self._output_size, self._data_format)
+
+
+class AdaptiveAvgPool3D(_AdaptivePoolNd):
+    _default_fmt = "NCDHW"
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self._output_size, self._data_format)
+
+
+class AdaptiveMaxPool1D(_AdaptivePoolNd):
+    _default_fmt = "NCL"
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._output_size,
+                                     data_format=self._data_format)
+
+
+class AdaptiveMaxPool2D(_AdaptivePoolNd):
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self._output_size,
+                                     data_format=self._data_format)
+
+
+class AdaptiveMaxPool3D(_AdaptivePoolNd):
+    _default_fmt = "NCDHW"
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._output_size,
+                                     data_format=self._data_format)

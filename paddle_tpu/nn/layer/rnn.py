@@ -1,0 +1,430 @@
+"""Recurrent layers (reference: ``python/paddle/nn/layer/rnn.py``:
+SimpleRNNCell/LSTMCell/GRUCell + RNN/BiRNN wrappers + SimpleRNN/LSTM/GRU).
+
+TPU redesign: the reference runs the time loop per-op in Python (dygraph) or
+via a C++ cudnn kernel; here each cell defines a *pure array step function*
+and the sequence wrapper lowers the whole loop to one ``jax.lax.scan`` inside
+a single taped op — compiled control flow, no Python-loop unrolling, exactly
+what XLA wants on TPU.
+
+Gate math matches the reference exactly:
+  LSTM (rnn.py LSTMCell.forward): gates split [i, f, c, o];
+      c' = f*c + i*tanh(g_c); h' = o*tanh(c')
+  GRU (rnn.py GRUCell.forward): splits [r, z, c];
+      c = tanh(x_c + r*h_c); h' = (h - c)*z + c
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0):
+        from paddle_tpu import ops
+        b = batch_ref.shape[0]
+        shapes = self.state_shape
+        if isinstance(shapes, tuple):
+            return tuple(
+                ops.full([b] + list(s), init_value, dtype or "float32")
+                for s in shapes)
+        return ops.full([b] + list(shapes), init_value, dtype or "float32")
+
+
+def _make_cell_params(layer, input_size, hidden_size, n_gates,
+                      weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                      bias_hh_attr):
+    std = 1.0 / math.sqrt(hidden_size)
+    u = I.Uniform(-std, std)
+    mk = layer.create_parameter
+    layer.weight_ih = mk([n_gates * hidden_size, input_size],
+                         attr=ParamAttr._to_attr(weight_ih_attr),
+                         default_initializer=u)
+    layer.weight_hh = mk([n_gates * hidden_size, hidden_size],
+                         attr=ParamAttr._to_attr(weight_hh_attr),
+                         default_initializer=u)
+    bih = ParamAttr._to_attr(bias_ih_attr)
+    bhh = ParamAttr._to_attr(bias_hh_attr)
+    layer.bias_ih = None if bih is False else mk(
+        [n_gates * hidden_size], attr=bih, default_initializer=u)
+    layer.bias_hh = None if bhh is False else mk(
+        [n_gates * hidden_size], attr=bhh, default_initializer=u)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _make_cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def pure_step(self):
+        import jax.numpy as jnp
+        act = jnp.tanh if self.activation == "tanh" else \
+            (lambda v: jnp.maximum(v, 0))
+
+        def step(params, x, state):
+            wih, whh, bih, bhh = params
+            g = x @ wih.T + state @ whh.T
+            if bih is not None:
+                g = g + bih
+            if bhh is not None:
+                g = g + bhh
+            h = act(g)
+            return h, h
+        return step
+
+    def _params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        step = self.pure_step()
+        live = [p for p in self._params() if p is not None]
+        mask = [p is not None for p in self._params()]
+
+        def f(x, h, *ps):
+            it = iter(ps)
+            params = tuple(next(it) if m else None for m in mask)
+            return step(params, x, h)
+        out, new_h = apply_op(f, inputs, states, *live, op_name="rnn_cell")
+        return out, new_h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _make_cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return ([self.hidden_size], [self.hidden_size])
+
+    def pure_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        def step(params, x, state):
+            wih, whh, bih, bhh = params
+            h, c = state
+            g = x @ wih.T + h @ whh.T
+            if bih is not None:
+                g = g + bih
+            if bhh is not None:
+                g = g + bhh
+            i, f_, gc, o = jnp.split(g, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f_ = jax.nn.sigmoid(f_)
+            o = jax.nn.sigmoid(o)
+            c2 = f_ * c + i * jnp.tanh(gc)
+            h2 = o * jnp.tanh(c2)
+            return h2, (h2, c2)
+        return step
+
+    def _params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        step = self.pure_step()
+        live = [p for p in self._params() if p is not None]
+        mask = [p is not None for p in self._params()]
+
+        def f(x, h, c, *ps):
+            it = iter(ps)
+            params = tuple(next(it) if m else None for m in mask)
+            out, (h2, c2) = step(params, x, (h, c))
+            return out, h2, c2
+        out, h2, c2 = apply_op(f, inputs, states[0], states[1], *live,
+                               op_name="lstm_cell")
+        return out, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _make_cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def pure_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        def step(params, x, state):
+            wih, whh, bih, bhh = params
+            xg = x @ wih.T
+            if bih is not None:
+                xg = xg + bih
+            hg = state @ whh.T
+            if bhh is not None:
+                hg = hg + bhh
+            x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+            h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(x_r + h_r)
+            z = jax.nn.sigmoid(x_z + h_z)
+            c = jnp.tanh(x_c + r * h_c)
+            h = (state - c) * z + c
+            return h, h
+        return step
+
+    _params = SimpleRNNCell._params
+    forward = SimpleRNNCell.forward
+
+
+def _scan_rnn(cell, inputs, initial_states, sequence_length=None,
+              is_reverse=False, time_major=False):
+    """Run ``cell`` over the time axis with one lax.scan (single taped op).
+
+    ``sequence_length`` (paddle parity): steps at t >= length keep the
+    previous state and emit zero outputs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    step = cell.pure_step()
+    tuple_state = isinstance(initial_states, tuple)
+    states = initial_states if tuple_state else (initial_states,)
+    live = [p for p in cell._params() if p is not None]
+    mask = [p is not None for p in cell._params()]
+    seq_args = [sequence_length] if sequence_length is not None else []
+
+    def f(x, *rest):
+        n_state = len(states)
+        st = rest[:n_state]
+        idx = n_state
+        if sequence_length is not None:
+            seqlen = rest[idx]
+            idx += 1
+        ps = rest[idx:]
+        it = iter(ps)
+        params = tuple(next(it) if m else None for m in mask)
+
+        xt = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, D]
+        T = xt.shape[0]
+        if is_reverse:
+            xt = jnp.flip(xt, 0)
+
+        def body(carry, scan_in):
+            t, x_t = scan_in
+            state_in = carry if len(carry) > 1 else carry
+            s = state_in if len(states) > 1 else state_in[0]
+            out, new_s = step(params, x_t, s)
+            new_tuple = new_s if isinstance(new_s, tuple) else (new_s,)
+            if sequence_length is not None:
+                tt = (T - 1 - t) if is_reverse else t
+                keep = (tt < seqlen)[:, None]
+                new_tuple = tuple(
+                    jnp.where(keep, ns, cs)
+                    for ns, cs in zip(new_tuple, carry))
+                out = jnp.where(keep, out, jnp.zeros_like(out))
+            return new_tuple, out
+
+        init = tuple(s for s in st)
+        carry, outs = jax.lax.scan(body, init,
+                                   (jnp.arange(T), xt))
+        if is_reverse:
+            outs = jnp.flip(outs, 0)
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return (outs,) + carry
+
+    res = apply_op(f, inputs, *states, *seq_args, *live,
+                   op_name=f"rnn_scan_{type(cell).__name__}")
+    outs = res[0]
+    final = res[1:]
+    final_state = tuple(final) if tuple_state else final[0]
+    return outs, final_state
+
+
+class RNN(Layer):
+    """Apply an RNNCell over a sequence (reference: rnn.py RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if initial_states is None:
+            batch_ref = inputs if not self.time_major else \
+                inputs.transpose([1, 0, 2])
+            initial_states = self.cell.get_initial_states(batch_ref)
+        return _scan_rnn(self.cell, inputs, initial_states, sequence_length,
+                         self.is_reverse, self.time_major)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu import ops
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        if states_fw is None:
+            batch_ref = inputs if not self.time_major else \
+                inputs.transpose([1, 0, 2])
+            states_fw = self.cell_fw.get_initial_states(batch_ref)
+            states_bw = self.cell_bw.get_initial_states(batch_ref)
+        out_fw, fin_fw = _scan_rnn(self.cell_fw, inputs, states_fw,
+                                   sequence_length, False, self.time_major)
+        out_bw, fin_bw = _scan_rnn(self.cell_bw, inputs, states_bw,
+                                   sequence_length, True, self.time_major)
+        outputs = ops.concat([out_fw, out_bw], axis=-1)
+        return outputs, (fin_fw, fin_bw)
+
+
+class _RNNBase(Layer):
+    """Stacked (optionally bidirectional) recurrent net
+    (reference: rnn.py _RNNBase→SimpleRNN/LSTM/GRU)."""
+
+    _cell_cls = None
+    _n_states = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None, **cell_kwargs):
+        super().__init__()
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        from paddle_tpu.nn.containers import LayerList
+        attrs = dict(weight_ih_attr=weight_ih_attr,
+                     weight_hh_attr=weight_hh_attr,
+                     bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        self._cells = LayerList()
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 else \
+                hidden_size * self.num_directions
+            for _ in range(self.num_directions):
+                self._cells.append(
+                    self._cell_cls(in_sz, hidden_size, **cell_kwargs, **attrs))
+
+    def _cell_at(self, layer_i, direction):
+        return self._cells[layer_i * self.num_directions + direction]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from paddle_tpu import ops
+        batch_ref = inputs if not self.time_major else \
+            inputs.transpose([1, 0, 2])
+
+        n_total = self.num_layers * self.num_directions
+        if initial_states is None:
+            init_per_cell = [self._cell_at(0, 0).get_initial_states(batch_ref)
+                             for _ in range(n_total)]
+        else:
+            # paddle passes [num_layers*num_directions, batch, hidden] (per
+            # state element for LSTM a tuple of two such stacks)
+            def unstack(s):
+                return [s[i] for i in range(n_total)]
+            if self._n_states == 2:
+                h0, c0 = initial_states
+                init_per_cell = [(h, c) for h, c in
+                                 zip(unstack(h0), unstack(c0))]
+            else:
+                init_per_cell = unstack(initial_states)
+
+        out = inputs
+        finals = []
+        for layer_i in range(self.num_layers):
+            if layer_i > 0 and self.dropout > 0:
+                out = F.dropout(out, self.dropout, training=self.training)
+            if self.num_directions == 1:
+                cell = self._cell_at(layer_i, 0)
+                out, fin = _scan_rnn(cell, out,
+                                     init_per_cell[layer_i], sequence_length,
+                                     False, self.time_major)
+                finals.append(fin)
+            else:
+                cf = self._cell_at(layer_i, 0)
+                cb = self._cell_at(layer_i, 1)
+                o_f, f_f = _scan_rnn(cf, out,
+                                     init_per_cell[2 * layer_i],
+                                     sequence_length, False, self.time_major)
+                o_b, f_b = _scan_rnn(cb, out,
+                                     init_per_cell[2 * layer_i + 1],
+                                     sequence_length, True, self.time_major)
+                out = ops.concat([o_f, o_b], axis=-1)
+                finals.extend([f_f, f_b])
+
+        if self._n_states == 2:
+            h = ops.stack([f[0] for f in finals], axis=0)
+            c = ops.stack([f[1] for f in finals], axis=0)
+            final_states = (h, c)
+        else:
+            final_states = ops.stack(finals, axis=0)
+        return out, final_states
+
+
+class SimpleRNN(_RNNBase):
+    _cell_cls = SimpleRNNCell
+    _n_states = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr, name,
+                         activation=activation)
+
+
+class LSTM(_RNNBase):
+    _cell_cls = LSTMCell
+    _n_states = 2
+
+
+class GRU(_RNNBase):
+    _cell_cls = GRUCell
+    _n_states = 1

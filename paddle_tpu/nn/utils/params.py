@@ -1,0 +1,21 @@
+"""Parameter flatten/unflatten utilities (reference:
+``python/paddle/nn/utils/transform_parameters.py``)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["parameters_to_vector", "vector_to_parameters"]
+
+
+def parameters_to_vector(parameters, name=None):
+    from paddle_tpu import ops
+    return ops.concat([ops.reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        chunk = vec[offset:offset + n]
+        p.set_value(np.asarray(chunk.data).reshape(p.shape))
+        offset += n

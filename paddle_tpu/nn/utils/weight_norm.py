@@ -1,0 +1,56 @@
+"""Weight normalization hook (reference:
+``python/paddle/nn/utils/weight_norm_hook.py``): reparameterize a layer's
+weight as ``g * v / ||v||``, recomputed on every forward."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except(v, dim):
+    import jax.numpy as jnp
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(a for a in range(v.ndim) if a != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    w = getattr(layer, name)
+    import jax.numpy as jnp
+    g0 = np.asarray(_norm_except(w.data, dim))
+    layer.add_parameter(name + "_g", Parameter(g0))
+    layer.add_parameter(name + "_v", Parameter(np.asarray(w.data)))
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        g = lyr._parameters[name + "_g"]
+        v = lyr._parameters[name + "_v"]
+        w_ = apply_op(lambda gg, vv: gg * vv / _norm_except(vv, dim), g, v,
+                      op_name="weight_norm")
+        # place the recomputed weight where forward() looks it up
+        lyr._buffers[name] = w_
+        return None
+
+    layer._weight_norm_hook = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_dim = dim
+    layer.register_buffer(name, None, persistable=False)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    dim = getattr(layer, "_weight_norm_dim", 0)
+    w = apply_op(lambda gg, vv: gg * vv / _norm_except(vv, dim), g, v,
+                 op_name="weight_norm")
+    layer._buffers.pop(name, None)
+    layer.add_parameter(name, Parameter(np.asarray(w.data)))
+    if hasattr(layer, "_weight_norm_hook"):
+        layer._weight_norm_hook.remove()
+    return layer

@@ -308,10 +308,12 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
         # ordered from the last dim backwards, honoring data_format
         cfg = [(0, 0)] * nd
         npairs = len(pad) // 2
-        if data_format.endswith("C"):  # NHWC-like: spatial dims before channel
-            dims = list(range(1, 1 + npairs))
-        else:  # NCHW-like: spatial dims after channel
-            dims = list(range(nd - npairs, nd))
+        # paddle order [left, right, top, bottom, front, back]: the first
+        # pair pads the LAST spatial dim, walking backwards
+        if data_format.endswith("C"):  # NHWC-like: spatial dims 1..nd-2
+            dims = list(range(nd - 2, nd - 2 - npairs, -1))
+        else:  # NCHW-like: spatial dims 2..nd-1
+            dims = list(range(nd - 1, nd - 1 - npairs, -1))
         for i, d in enumerate(dims):
             cfg[d] = (pad[2 * i], pad[2 * i + 1])
     jmode = {"constant": "constant", "reflect": "reflect",

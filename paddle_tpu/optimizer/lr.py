@@ -225,11 +225,18 @@ class MultiplicativeDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        # pure in last_epoch: recompose the product so repeated get_lr()
-        # calls and epoch jumps (step(epoch=N)) are stable
-        cur = self.base_lr
-        for e in range(1, self.last_epoch + 1):
+        # pure in last_epoch (repeated get_lr() calls and epoch jumps are
+        # stable) with an O(1) running product for the sequential-step case
+        cached_epoch, cached = getattr(self, "_prod_cache", (0, self.base_lr))
+        if self.last_epoch == cached_epoch:
+            return cached
+        if self.last_epoch > cached_epoch:
+            start, cur = cached_epoch, cached
+        else:  # backward jump: recompose from scratch
+            start, cur = 0, self.base_lr
+        for e in range(start + 1, self.last_epoch + 1):
             cur *= self.lr_lambda(e)
+        self._prod_cache = (self.last_epoch, cur)
         return cur
 
 

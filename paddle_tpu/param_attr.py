@@ -1,0 +1,34 @@
+"""ParamAttr — per-parameter construction attributes
+(reference: ``python/paddle/fluid/param_attr.py``): initializer, trainable
+flag, name, and regularizer hints consumed by ``Layer.create_parameter``.
+"""
+from __future__ import annotations
+
+__all__ = ["ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        """Normalize paddle's weight_attr/bias_attr union type:
+        None → default, False → "no parameter", Initializer → wrap, str → name.
+        """
+        if attr is None or isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # assume an initializer instance
+        return ParamAttr(initializer=attr)

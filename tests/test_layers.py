@@ -1,0 +1,448 @@
+"""Layer zoo tests: shape/grad checks per family, torch oracles for the
+stateful layers (RNN/BatchNorm), and an end-to-end transformer LM train."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(x):
+    return pt.to_tensor(np.asarray(x, dtype=np.float32))
+
+
+class TestCommon:
+    def test_linear_matches_manual(self):
+        m = nn.Linear(4, 3)
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        got = m(t(x)).numpy()
+        ref = x @ m.weight.numpy() + m.bias.numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_linear_no_bias(self):
+        m = nn.Linear(4, 3, bias_attr=False)
+        assert m.bias is None
+
+    def test_embedding_padding_idx(self):
+        m = nn.Embedding(10, 4, padding_idx=0)
+        assert np.all(m.weight.numpy()[0] == 0)
+        out = m(pt.to_tensor(np.array([[0, 3]], dtype=np.int64)))
+        assert np.all(out.numpy()[0, 0] == 0)
+
+    def test_flatten(self):
+        m = nn.Flatten()
+        out = m(t(np.zeros((2, 3, 4))))
+        assert out.shape == [2, 12]
+
+    def test_dropout_train_eval(self):
+        m = nn.Dropout(0.5)
+        x = t(np.ones((100, 100)))
+        m.eval()
+        np.testing.assert_allclose(m(x).numpy(), 1.0)
+        m.train()
+        y = m(x).numpy()
+        assert (y == 0).any() and not (y == 0).all()
+
+    def test_pad2d(self):
+        m = nn.Pad2D([1, 2, 3, 4])
+        out = m(t(np.zeros((1, 1, 5, 5))))
+        assert out.shape == [1, 1, 12, 8]
+
+    def test_upsample(self):
+        m = nn.Upsample(scale_factor=2, mode="nearest")
+        out = m(t(np.ones((1, 1, 3, 3))))
+        assert out.shape == [1, 1, 6, 6]
+
+    def test_identity(self):
+        x = t([1.0, 2.0])
+        assert nn.Identity()(x) is x
+
+
+class TestActivationLayers:
+    @pytest.mark.parametrize("cls,fn", [
+        (nn.ReLU, F.relu), (nn.GELU, F.gelu), (nn.Sigmoid, F.sigmoid),
+        (nn.Tanh, F.tanh), (nn.Silu, F.silu), (nn.Hardswish, F.hardswish),
+        (nn.Softplus, F.softplus), (nn.Mish, F.mish), (nn.ELU, F.elu),
+    ])
+    def test_matches_functional(self, cls, fn):
+        x = t(np.random.RandomState(0).randn(3, 4))
+        np.testing.assert_allclose(cls()(x).numpy(), fn(x).numpy(), rtol=1e-6)
+
+    def test_softmax_axis(self):
+        x = t(np.random.RandomState(0).randn(2, 5))
+        out = nn.Softmax()(x).numpy()
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_prelu_learnable(self):
+        m = nn.PReLU(num_parameters=1, init=0.3)
+        x = t([[-2.0, 4.0]])
+        np.testing.assert_allclose(m(x).numpy(), [[-0.6, 4.0]], rtol=1e-5)
+        (m(x).sum()).backward()
+        assert m.weight.grad is not None
+
+
+class TestConvLayers:
+    def test_conv2d_matches_torch(self):
+        rng = np.random.RandomState(0)
+        m = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        got = m(t(x)).numpy()
+        ref = torch.nn.functional.conv2d(
+            torch.tensor(x), torch.tensor(m.weight.numpy()),
+            torch.tensor(m.bias.numpy()), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_transpose_matches_torch(self):
+        rng = np.random.RandomState(1)
+        m = nn.Conv2DTranspose(4, 6, 3, stride=2, padding=1)
+        x = rng.randn(2, 4, 5, 5).astype(np.float32)
+        got = m(t(x)).numpy()
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(m.weight.numpy()),
+            torch.tensor(m.bias.numpy()), stride=2, padding=1).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv1d_grouped(self):
+        m = nn.Conv1D(4, 8, 3, groups=2)
+        out = m(t(np.random.randn(1, 4, 10)))
+        assert out.shape == [1, 8, 8]
+
+
+class TestNormLayers:
+    def test_layer_norm_matches_torch(self):
+        rng = np.random.RandomState(0)
+        m = nn.LayerNorm(6)
+        x = rng.randn(4, 6).astype(np.float32)
+        ref = torch.nn.functional.layer_norm(
+            torch.tensor(x), (6,), torch.tensor(m.weight.numpy()),
+            torch.tensor(m.bias.numpy())).numpy()
+        np.testing.assert_allclose(m(t(x)).numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rms_norm(self):
+        m = nn.RMSNorm(8)
+        x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+        got = m(t(x)).numpy()
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_batchnorm_updates_running_stats(self):
+        m = nn.BatchNorm2D(3)
+        x = t(np.random.RandomState(0).randn(4, 3, 5, 5) * 2 + 1)
+        before = m._mean.numpy().copy()
+        m.train()
+        m(x)
+        after = m._mean.numpy()
+        assert not np.allclose(before, after)
+
+    def test_batchnorm_eval_matches_torch(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 3, 5, 5).astype(np.float32)
+        m = nn.BatchNorm2D(3)
+        m.eval()
+        tm = torch.nn.BatchNorm2d(3).eval()
+        got = m(t(x)).numpy()
+        ref = tm(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_group_norm_matches_torch(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 6, 4, 4).astype(np.float32)
+        m = nn.GroupNorm(3, 6)
+        ref = torch.nn.functional.group_norm(
+            torch.tensor(x), 3, torch.tensor(m.weight.numpy()),
+            torch.tensor(m.bias.numpy())).numpy()
+        np.testing.assert_allclose(m(t(x)).numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_sync_batchnorm_convert(self):
+        model = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+        converted = nn.SyncBatchNorm.convert_sync_batchnorm(model)
+        assert isinstance(converted._sub_layers["1"], nn.SyncBatchNorm)
+
+
+class TestPoolingLayers:
+    def test_maxpool_layer(self):
+        m = nn.MaxPool2D(2)
+        out = m(t(np.random.randn(1, 1, 4, 4)))
+        assert out.shape == [1, 1, 2, 2]
+
+    def test_adaptive_avg_nondivisible(self):
+        m = nn.AdaptiveAvgPool2D((3, 3))
+        x = np.random.RandomState(0).randn(1, 2, 7, 7).astype(np.float32)
+        ref = torch.nn.functional.adaptive_avg_pool2d(
+            torch.tensor(x), (3, 3)).numpy()
+        np.testing.assert_allclose(m(t(x)).numpy(), ref, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestLossLayers:
+    def test_cross_entropy_layer(self):
+        logits = t(np.random.RandomState(0).randn(4, 10))
+        labels = pt.to_tensor(np.array([1, 3, 5, 7], dtype=np.int64))
+        loss = nn.CrossEntropyLoss()(logits, labels)
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits.numpy()), torch.tensor(labels.numpy()).long())
+        np.testing.assert_allclose(float(loss.numpy()), float(ref),
+                                   rtol=1e-5)
+
+    def test_mse_layer(self):
+        a, b = t([1.0, 2.0]), t([0.0, 0.0])
+        np.testing.assert_allclose(float(nn.MSELoss()(a, b).numpy()), 2.5)
+
+    def test_bce_with_logits(self):
+        x = t(np.random.RandomState(0).randn(8))
+        y = t((np.random.RandomState(1).rand(8) > 0.5).astype(np.float32))
+        got = nn.BCEWithLogitsLoss()(x, y)
+        ref = torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.tensor(x.numpy()), torch.tensor(y.numpy()))
+        np.testing.assert_allclose(float(got.numpy()), float(ref), rtol=1e-5)
+
+    def test_smooth_l1(self):
+        x = t(np.random.RandomState(0).randn(8))
+        y = t(np.random.RandomState(1).randn(8))
+        got = nn.SmoothL1Loss()(x, y)
+        ref = torch.nn.functional.smooth_l1_loss(
+            torch.tensor(x.numpy()), torch.tensor(y.numpy()))
+        np.testing.assert_allclose(float(got.numpy()), float(ref), rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_soft_margin_loss_reductions(self):
+        x = t(np.random.RandomState(0).randn(8))
+        y = t(np.sign(np.random.RandomState(1).randn(8)))
+        for red in ("mean", "sum", "none"):
+            got = nn.SoftMarginLoss(reduction=red)(x, y)
+            ref = torch.nn.functional.soft_margin_loss(
+                torch.tensor(x.numpy()), torch.tensor(y.numpy()),
+                reduction=red)
+            np.testing.assert_allclose(np.asarray(got.numpy()),
+                                       ref.numpy(), rtol=1e-5)
+
+    def test_weight_norm_roundtrip_dim1(self):
+        from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+        m = nn.Linear(4, 3)
+        before = m.weight.numpy().copy()
+        weight_norm(m, dim=1)
+        remove_weight_norm(m)
+        np.testing.assert_allclose(m.weight.numpy(), before, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_weight_norm_forward_consistent(self):
+        from paddle_tpu.nn.utils import weight_norm
+        m = nn.Linear(4, 3)
+        x = t(np.random.RandomState(0).randn(2, 4))
+        before = m(x).numpy()
+        weight_norm(m)
+        np.testing.assert_allclose(m(x).numpy(), before, rtol=1e-5,
+                                   atol=1e-6)
+        (m(x).sum()).backward()
+        assert m.weight_g.grad is not None and m.weight_v.grad is not None
+
+    def test_spectral_norm_converges(self):
+        m = nn.SpectralNorm([6, 4], power_iters=1)
+        w = t(np.random.RandomState(0).randn(6, 4))
+        u_before = m.weight_u.numpy().copy()
+        m(w)
+        assert not np.allclose(m.weight_u.numpy(), u_before)
+        for _ in range(50):
+            out = m(w)
+        # converged sigma: largest singular value of normalized output ~= 1
+        s = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+        np.testing.assert_allclose(s, 1.0, rtol=1e-3)
+
+    def test_return_mask_raises(self):
+        with pytest.raises(NotImplementedError):
+            F.adaptive_max_pool2d(t(np.zeros((1, 1, 4, 4))), 2,
+                                  return_mask=True)
+        with pytest.raises(NotImplementedError):
+            nn.MaxPool2D(2, return_mask=True)
+
+    def test_multiplicative_decay_stable_and_jumpable(self):
+        import paddle_tpu.optimizer as opt
+        s = opt.lr.MultiplicativeDecay(0.1, lambda e: 0.5)
+        s.step()
+        v1 = s.get_lr()
+        assert s.get_lr() == v1  # repeated calls do not drift
+        s.step()
+        assert abs(s.get_lr() - 0.1 * 0.25) < 1e-12
+        s.step(epoch=1)  # backward jump recomposes
+        assert abs(s.get_lr() - 0.05) < 1e-12
+
+
+class TestRNN:
+    def test_lstm_matches_torch(self):
+        rng = np.random.RandomState(0)
+        B, T, I, H = 2, 5, 4, 6
+        m = nn.LSTM(I, H)
+        tm = torch.nn.LSTM(I, H, batch_first=True)
+        # copy our weights into torch (same [4H, I] layout; gate order i,f,c,o
+        # in paddle vs i,f,g,o in torch — identical meaning)
+        sd = {
+            "weight_ih_l0": torch.tensor(m._cells[0].weight_ih.numpy()),
+            "weight_hh_l0": torch.tensor(m._cells[0].weight_hh.numpy()),
+            "bias_ih_l0": torch.tensor(m._cells[0].bias_ih.numpy()),
+            "bias_hh_l0": torch.tensor(m._cells[0].bias_hh.numpy()),
+        }
+        tm.load_state_dict(sd)
+        x = rng.randn(B, T, I).astype(np.float32)
+        out, (h, c) = m(t(x))
+        tout, (th, tc) = tm(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), tc.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gru_matches_torch(self):
+        rng = np.random.RandomState(0)
+        B, T, I, H = 2, 4, 3, 5
+        m = nn.GRU(I, H)
+        tm = torch.nn.GRU(I, H, batch_first=True)
+        sd = {
+            "weight_ih_l0": torch.tensor(m._cells[0].weight_ih.numpy()),
+            "weight_hh_l0": torch.tensor(m._cells[0].weight_hh.numpy()),
+            "bias_ih_l0": torch.tensor(m._cells[0].bias_ih.numpy()),
+            "bias_hh_l0": torch.tensor(m._cells[0].bias_hh.numpy()),
+        }
+        tm.load_state_dict(sd)
+        x = rng.randn(B, T, I).astype(np.float32)
+        out, h = m(t(x))
+        tout, th = tm(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_sequence_length_masks(self):
+        m = nn.LSTM(3, 4)
+        x = t(np.random.RandomState(0).randn(2, 6, 3))
+        out, (h, c) = m(x, sequence_length=pt.to_tensor(
+            np.array([6, 3], dtype=np.int32)))
+        # outputs past the length are zero for sample 1
+        assert np.all(out.numpy()[1, 3:] == 0)
+        assert not np.all(out.numpy()[1, :3] == 0)
+        # final state of sample 1 equals state at t=3 (run truncated input)
+        out2, (h2, _) = m(t(x.numpy()[1:2, :3]))
+        np.testing.assert_allclose(h.numpy()[0, 1], h2.numpy()[0, 0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_simple_rnn_cell_single_step(self):
+        cell = nn.SimpleRNNCell(4, 5)
+        x = t(np.random.RandomState(0).randn(3, 4))
+        out, h = cell(x)
+        assert out.shape == [3, 5]
+        ref = np.tanh(
+            x.numpy() @ cell.weight_ih.numpy().T + cell.bias_ih.numpy() +
+            np.zeros((3, 5)) @ cell.weight_hh.numpy().T +
+            cell.bias_hh.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_birnn_wrapper(self):
+        fw, bw = nn.GRUCell(3, 4), nn.GRUCell(3, 4)
+        m = nn.BiRNN(fw, bw)
+        out, (ff, fb) = m(t(np.random.randn(2, 5, 3)))
+        assert out.shape == [2, 5, 8]
+
+    def test_rnn_backward_flows(self):
+        m = nn.LSTM(3, 4)
+        x = t(np.random.RandomState(0).randn(2, 5, 3))
+        out, _ = m(x)
+        out.mean().backward()
+        for p in m.parameters():
+            assert p.grad is not None
+            assert np.isfinite(p.grad.numpy()).all()
+
+
+class TestTransformer:
+    def test_encoder_layer_shapes_and_grad(self):
+        enc = nn.TransformerEncoderLayer(16, 4, 32)
+        enc.eval()
+        x = t(np.random.RandomState(0).randn(2, 5, 16))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+        out.mean().backward()
+        assert enc.linear1.weight.grad is not None
+
+    def test_encoder_stack_distinct_layers(self):
+        layer = nn.TransformerEncoderLayer(8, 2, 16)
+        enc = nn.TransformerEncoder(layer, 3)
+        assert len(list(enc.layers)) == 3
+        # clones share values initially but are distinct objects
+        p0 = enc.layers[0].linear1.weight
+        p1 = enc.layers[1].linear1.weight
+        assert p0 is not p1
+        np.testing.assert_allclose(p0.numpy(), p1.numpy())
+
+    def test_decoder_and_full_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                               num_decoder_layers=2, dim_feedforward=32)
+        model.eval()
+        src = t(np.random.RandomState(0).randn(2, 6, 16))
+        tgt = t(np.random.RandomState(1).randn(2, 4, 16))
+        mask = model.generate_square_subsequent_mask(4)
+        out = model(src, tgt, tgt_mask=mask)
+        assert out.shape == [2, 4, 16]
+
+    def test_causal_mask_blocks_future(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = np.random.RandomState(0).randn(1, 4, 8).astype(np.float32)
+        m = nn.Transformer(d_model=8, nhead=2).generate_square_subsequent_mask(4)
+        out1 = mha(t(x), attn_mask=m).numpy()
+        x2 = x.copy()
+        x2[0, 3] += 100.0  # perturb the last position only
+        out2 = mha(t(x2), attn_mask=m).numpy()
+        np.testing.assert_allclose(out1[0, :3], out2[0, :3], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_decoder_cache_incremental_matches_full(self):
+        dec_layer = nn.TransformerDecoderLayer(8, 2, 16)
+        dec = nn.TransformerDecoder(dec_layer, 2)
+        dec.eval()
+        memory = t(np.random.RandomState(0).randn(1, 5, 8))
+        tgt = np.random.RandomState(1).randn(1, 3, 8).astype(np.float32)
+        causal = nn.Transformer(d_model=8,
+                                nhead=2).generate_square_subsequent_mask(3)
+        full = dec(t(tgt), memory, tgt_mask=causal).numpy()
+        cache = dec.gen_cache(memory)
+        steps = []
+        for i in range(3):
+            out, cache = dec(t(tgt[:, i:i + 1]), memory, cache=cache)
+            steps.append(out.numpy())
+        inc = np.concatenate(steps, axis=1)
+        np.testing.assert_allclose(full, inc, rtol=1e-4, atol=1e-5)
+
+    def test_tiny_lm_trains(self):
+        # end-to-end: embedding -> encoder layer -> vocab head learns to
+        # predict a fixed next-token mapping (the VERDICT's "done" bar)
+        import paddle_tpu.optimizer as opt
+        rng = np.random.RandomState(0)
+        V, D, T, B = 17, 16, 6, 8
+        emb = nn.Embedding(V, D)
+        enc = nn.TransformerEncoderLayer(D, 4, 32, dropout=0.0)
+        head = nn.Linear(D, V)
+        params = (list(emb.parameters()) + list(enc.parameters()) +
+                  list(head.parameters()))
+        o = opt.AdamW(learning_rate=5e-3, parameters=params)
+        loss_fn = nn.CrossEntropyLoss()
+        perm = rng.permutation(V)  # fixed next-token rule
+        causal = nn.Transformer(
+            d_model=D, nhead=4).generate_square_subsequent_mask(T)
+
+        losses = []
+        for step in range(60):
+            toks = rng.randint(0, V, size=(B, T))
+            nxt = perm[toks]
+            h = enc(emb(pt.to_tensor(toks.astype(np.int64))),
+                    src_mask=causal)
+            logits = head(h)
+            loss = loss_fn(
+                pt.reshape(logits, [-1, V]),
+                pt.to_tensor(nxt.reshape(-1).astype(np.int64)))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
