@@ -1,0 +1,13 @@
+"""paddle.jit parity namespace — trace-based program capture for TPU
+(reference: ``python/paddle/jit/``; see api.py module doc for the seam map).
+"""
+from .api import (  # noqa: F401
+    to_static, StaticFunction, not_to_static, ignore_module,
+)
+from .functional import (  # noqa: F401
+    functional_call, functional_state, swap_state,
+)
+from .train_step import TrainStep  # noqa: F401
+
+__all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module",
+           "functional_call", "functional_state", "swap_state", "TrainStep"]

@@ -1,0 +1,156 @@
+"""to_static — compile a Layer or function into a cached XLA program.
+
+Reference seam: ``python/paddle/jit/api.py:221`` (``to_static`` →
+``StaticFunction`` with a per-input-spec program cache,
+``dy2static/program_translator.py:1252``). The TPU redesign needs no AST
+transpiler: jax re-traces the Python body per (structure, shape, dtype)
+signature and XLA compiles it; the cache here plays the role of the
+reference's ``ConcreteProgram`` cache (same shape as the CINN compile cache,
+``paddle/fluid/framework/paddle2cinn/cinn_cache_key.cc``).
+
+Semantics notes:
+  * On a Layer (or its bound forward), parameters and buffers enter the
+    compiled function as *runtime inputs*, so later in-place updates
+    (optimizer steps) are picked up without retracing.
+  * On a plain function, any Tensors it closes over are baked as constants
+    of the trace — pass them as arguments if they change.
+  * Randomness (dropout) is threaded through a per-call PRNG key derived
+    from the default generator, so compiled steps keep paddle's stateful
+    seed UX without baking a fixed mask.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+
+from paddle_tpu.core import generator as _gen
+from paddle_tpu.core.autograd import no_grad
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+from .functional import functional_state, swap_state
+
+__all__ = ["to_static", "StaticFunction", "ignore_module", "not_to_static"]
+
+
+def _sig_of(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+    sig = []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            sig.append(("T", tuple(leaf.shape), str(leaf.dtype.name)))
+        elif hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sig.append(("A", tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            sig.append(("P", repr(leaf)))
+    return treedef, tuple(sig)
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.data if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if hasattr(x, "dtype") and hasattr(x, "shape")
+        else x, tree)
+
+
+class StaticFunction:
+    """Callable wrapper with a compiled-executable cache per input signature
+    (the reference's StaticFunction, jit/api.py)."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 backend=None):
+        self._layer: Optional[Layer] = None
+        if isinstance(fn, Layer):
+            self._layer = fn
+            self._fn = fn.__call__  # through __call__ so fwd hooks run
+        elif hasattr(fn, "__self__") and isinstance(fn.__self__, Layer):
+            self._layer = fn.__self__
+            self._fn = fn.__self__.__call__
+        else:
+            self._fn = fn
+        self._cache = {}
+        functools.update_wrapper(self, fn if callable(fn) else self._fn)
+
+    def _compile(self, key, treedef, training):
+        layer = self._layer
+
+        if layer is not None:
+            def pure(state, rng_key, flat_args):
+                args = jax.tree_util.tree_unflatten(treedef, flat_args)
+                args = _wrap(args)
+                with no_grad(), _gen.rng_guard(rng_key), \
+                        swap_state(layer, state) as out_bufs:
+                    out = self._fn(*args[0], **args[1])
+                    out_arrays = _unwrap(out)
+                return out_arrays, out_bufs
+        else:
+            def pure(state, rng_key, flat_args):
+                args = jax.tree_util.tree_unflatten(treedef, flat_args)
+                args = _wrap(args)
+                with no_grad(), _gen.rng_guard(rng_key):
+                    out = self._fn(*args[0], **args[1])
+                return _unwrap(out), {}
+        return jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        treedef, sig = _sig_of((args, kwargs))
+        training = self._layer.training if self._layer is not None else False
+        # treedef participates in the key: same leaves in a different
+        # structure must not reuse a compiled closure
+        key = (treedef, sig, training)
+        if key not in self._cache:
+            self._cache[key] = self._compile(key, treedef, training)
+        compiled = self._cache[key]
+
+        if self._layer is not None:
+            train, frozen, buffers = functional_state(self._layer)
+            state = {**train, **frozen, **buffers}
+        else:
+            state = {}
+        flat_args, _ = jax.tree_util.tree_flatten(
+            _unwrap((args, kwargs)))
+        rng_key = _gen.next_key()
+        out_arrays, out_bufs = compiled(state, rng_key, flat_args)
+        if self._layer is not None and out_bufs:
+            # write updated running stats back into the layer (concrete now)
+            named = dict(self._layer.named_buffers())
+            for name, arr in out_bufs.items():
+                if name in named and named[name] is not None:
+                    named[name]._data = arr
+        return _wrap(out_arrays)
+
+    @property
+    def code_cache(self):
+        return self._cache
+
+    def clear_cache(self):
+        self._cache.clear()
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper: compile a Layer or function into cached XLA
+    programs (reference: paddle.jit.to_static, jit/api.py:221)."""
+    def wrap(fn):
+        return StaticFunction(fn, input_spec, build_strategy, backend)
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn=None):
+    """Marker parity (reference: paddle.jit.not_to_static). Since capture is
+    trace-based, unmarked helpers already run inline; this is the identity."""
+    return fn if fn is not None else (lambda f: f)
+
+
+def ignore_module(modules):
+    """Reference parity no-op: trace-based capture needs no module blacklist."""
+    return None

@@ -1,0 +1,72 @@
+"""Functional execution of Layers: run ``forward`` with parameter/buffer
+storage swapped for explicit (possibly traced) values.
+
+This is the TPU-native replacement for the reference's dy2static program
+capture (``python/paddle/jit/dy2static/program_translator.py``): instead of
+AST-transpiling Python into a Program IR, the Layer's own Python ``forward``
+*is* the trace function — jax traces it once per input signature and XLA
+compiles the whole step into one program.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Tuple
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["functional_call", "functional_state", "swap_state"]
+
+
+def functional_state(layer: Layer) -> Tuple[Dict, Dict, Dict]:
+    """Split a layer's state into (trainable params, frozen params, buffers)
+    as dicts of jax arrays keyed by qualified name."""
+    train, frozen, buffers = {}, {}, {}
+    for name, p in layer.named_parameters():
+        (frozen if p.stop_gradient else train)[name] = p.data
+    for name, b in layer.named_buffers():
+        if b is not None:
+            buffers[name] = b.data
+    return train, frozen, buffers
+
+
+@contextlib.contextmanager
+def swap_state(layer: Layer, values: Dict[str, object],
+               collect_buffers: bool = True):
+    """Temporarily replace parameter/buffer storage with ``values``.
+
+    Yields a dict that, after the with-body ran, holds the *post-forward*
+    buffer arrays (running stats written during the body — these are tracers
+    under jit and must leave the trace as outputs, never stay in storage).
+    """
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+    saved = {}
+    targets = {**params, **buffers}
+    for name, val in values.items():
+        t = targets.get(name)
+        if t is None:
+            raise KeyError(f"no parameter/buffer named {name!r}")
+        saved[name] = t._data
+        t._data = val
+    out_buffers = {}
+    try:
+        yield out_buffers
+        if collect_buffers:
+            for name, b in buffers.items():
+                if b is not None:
+                    out_buffers[name] = b._data
+    finally:
+        for name, val in saved.items():
+            targets[name]._data = val
+
+
+def functional_call(layer: Layer, params_and_buffers: Dict, *args, **kwargs):
+    """Call ``layer`` with its state replaced by ``params_and_buffers``
+    (name -> jax array or Tensor). Pure: the layer's own storage is restored
+    afterwards. Values may be jax tracers, which is what makes whole-model
+    jit possible."""
+    vals = {k: (v.data if isinstance(v, Tensor) else v)
+            for k, v in params_and_buffers.items()}
+    with swap_state(layer, vals, collect_buffers=False):
+        return layer(*args, **kwargs)

@@ -140,6 +140,10 @@ class Upsample(Layer):
                  align_corners=False, align_mode=0, data_format="NCHW",
                  name=None):
         super().__init__()
+        if align_mode not in (0, None):
+            raise NotImplementedError(
+                "align_mode=1 (src = dst*scale sampling) is not implemented; "
+                "only the default half-pixel-center mode (align_mode=0)")
         self._size = size
         self._scale_factor = scale_factor
         self._mode = mode

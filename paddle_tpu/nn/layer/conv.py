@@ -98,34 +98,48 @@ class Conv3D(_ConvNd):
                         self._data_format)
 
 
-class Conv1DTranspose(_ConvNd):
+class _ConvTransposeNd(_ConvNd):
+    _transpose = True
+
+    def _out_padding(self, x, output_size):
+        """Derive output_padding from a requested output_size (paddle
+        semantics: output_size must lie in [default, default + stride))."""
+        if output_size is None:
+            return self._output_padding
+        nd = self._nd
+        if isinstance(output_size, int):
+            output_size = [output_size] * nd
+        channel_last = self._data_format.endswith("C")
+        spatial0 = 1 if channel_last else 2
+        pad = _ntuple(self._padding, nd)
+        out_pad = []
+        for i in range(nd):
+            in_sz = x.shape[spatial0 + i]
+            default = (in_sz - 1) * self._stride[i] - 2 * pad[i] + \
+                self._dilation[i] * (self._kernel_size[i] - 1) + 1
+            extra = int(output_size[i]) - default
+            if not (0 <= extra < self._stride[i]) and extra != 0:
+                raise ValueError(
+                    f"output_size[{i}]={output_size[i]} out of the valid "
+                    f"range [{default}, {default + self._stride[i]})")
+            out_pad.append(extra)
+        return out_pad
+
+    def forward(self, x, output_size=None):
+        fn = {1: F.conv1d_transpose, 2: F.conv2d_transpose,
+              3: F.conv3d_transpose}[self._nd]
+        return fn(x, self.weight, self.bias, self._stride, self._padding,
+                  self._out_padding(x, output_size), self._dilation,
+                  self._groups, self._data_format)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
     _nd = 1
-    _transpose = True
-
-    def forward(self, x, output_size=None):
-        return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding,
-                                  self._dilation, self._groups,
-                                  self._data_format)
 
 
-class Conv2DTranspose(_ConvNd):
+class Conv2DTranspose(_ConvTransposeNd):
     _nd = 2
-    _transpose = True
-
-    def forward(self, x, output_size=None):
-        return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding,
-                                  self._dilation, self._groups,
-                                  self._data_format)
 
 
-class Conv3DTranspose(_ConvNd):
+class Conv3DTranspose(_ConvTransposeNd):
     _nd = 3
-    _transpose = True
-
-    def forward(self, x, output_size=None):
-        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, self._output_padding,
-                                  self._dilation, self._groups,
-                                  self._data_format)

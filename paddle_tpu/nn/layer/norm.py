@@ -233,18 +233,17 @@ class SpectralNorm(Layer):
     def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
                  name=None, dtype="float32"):
         super().__init__()
-        import jax.numpy as jnp
         self._dim = dim
         self._power_iters = power_iters
         self._epsilon = epsilon
         h = weight_shape[dim]
         w = int(np.prod(weight_shape)) // h
-        self.weight_u = self.create_parameter(
-            shape=[h], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
-        self.weight_u.stop_gradient = True
-        self.weight_v = self.create_parameter(
-            shape=[w], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
-        self.weight_v.stop_gradient = True
+        # power-iteration state lives in buffers (not params) so the jit
+        # path exports/writes it back through swap_state like running stats
+        self.register_buffer("weight_u",
+                             Tensor(I.Normal(0.0, 1.0)([h], dtype)))
+        self.register_buffer("weight_v",
+                             Tensor(I.Normal(0.0, 1.0)([w], dtype)))
 
     def forward(self, weight):
         import jax

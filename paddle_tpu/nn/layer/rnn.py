@@ -240,8 +240,7 @@ def _scan_rnn(cell, inputs, initial_states, sequence_length=None,
 
         def body(carry, scan_in):
             t, x_t = scan_in
-            state_in = carry if len(carry) > 1 else carry
-            s = state_in if len(states) > 1 else state_in[0]
+            s = carry if len(states) > 1 else carry[0]
             out, new_s = step(params, x_t, s)
             new_tuple = new_s if isinstance(new_s, tuple) else (new_s,)
             if sequence_length is not None:

@@ -72,13 +72,16 @@ class MultiHeadAttention(Layer):
 
     def gen_cache(self, key, value=None, type=None):
         """Reference: MultiHeadAttention.gen_cache — StaticCache pre-projects
-        enc-dec keys/values; Cache holds growing self-attention k/v."""
-        if type == MultiHeadAttention.StaticCache or (
-                type is None and value is not None):
+        enc-dec keys/values; Cache holds growing self-attention k/v (seeded
+        verbatim from (key, value) when both are given, empty otherwise)."""
+        if type == MultiHeadAttention.StaticCache:
             k = self._split_heads(self.k_proj(key))
             v = self._split_heads(self.v_proj(value if value is not None
                                               else key))
             return MultiHeadAttention.StaticCache(k, v)
+        if value is not None:
+            # already-projected seed tensors, paddle case 3
+            return MultiHeadAttention.Cache(key, value)
         # empty growing cache seeded from batch size of `key`
         b = key.shape[0]
         z = ops.zeros([b, 0, self.num_heads, self.head_dim],
@@ -113,7 +116,7 @@ class MultiHeadAttention(Layer):
         outs = [out]
         if self.need_weights:
             outs.append(weights)
-        if cache is not None and isinstance(cache, MultiHeadAttention.Cache):
+        if cache is not None:  # paddle returns the cache back for both kinds
             outs.append(cache)
         return out if len(outs) == 1 else tuple(outs)
 
@@ -276,7 +279,8 @@ class TransformerDecoderLayer(Layer):
             tgt = self.norm2(tgt)
         static = cache[1] if cache is not None else None
         if static is not None:
-            tgt = self.cross_attn(tgt, memory, memory, memory_mask, static)
+            tgt, static = self.cross_attn(tgt, memory, memory, memory_mask,
+                                          static)
         else:
             tgt = self.cross_attn(tgt, memory, memory, memory_mask)
         tgt = residual + self.dropout2(tgt)

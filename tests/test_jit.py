@@ -1,0 +1,194 @@
+"""paddle_tpu.jit tests: functional_call purity, to_static parity + caching,
+TrainStep equivalence with eager training, and a compiled-vs-eager speedup."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _mlp(seed=0):
+    pt.seed(seed)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def t(x):
+    return pt.to_tensor(np.asarray(x, dtype=np.float32))
+
+
+class TestFunctionalCall:
+    def test_matches_direct_and_is_pure(self):
+        m = _mlp()
+        x = t(np.random.RandomState(0).randn(4, 8))
+        direct = m(x).numpy()
+        params = {k: v for k, v in m.state_dict().items()}
+        out = pt.jit.functional_call(m, params, x)
+        np.testing.assert_allclose(out.numpy(), direct, rtol=1e-6)
+        # swapped values: different weights give different output, storage
+        # untouched afterwards
+        zeroed = {k: np.zeros_like(np.asarray(v.data))
+                  for k, v in params.items()}
+        out0 = pt.jit.functional_call(m, zeroed, x)
+        assert not np.allclose(out0.numpy(), direct)
+        np.testing.assert_allclose(m(x).numpy(), direct, rtol=1e-6)
+
+
+class TestToStatic:
+    def test_layer_parity(self):
+        m = _mlp()
+        x = t(np.random.RandomState(0).randn(4, 8))
+        eager = m(x).numpy()
+        sm = pt.jit.to_static(m)
+        np.testing.assert_allclose(sm(x).numpy(), eager, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_sees_param_updates_without_retrace(self):
+        m = _mlp()
+        x = t(np.random.RandomState(0).randn(4, 8))
+        sm = pt.jit.to_static(m)
+        out1 = sm(x).numpy()
+        n_compiled = len(sm.code_cache)
+        m[0].weight.set_value(m[0].weight.numpy() * 2.0)
+        out2 = sm(x).numpy()
+        assert not np.allclose(out1, out2)
+        assert len(sm.code_cache) == n_compiled  # no retrace
+
+    def test_cache_per_shape(self):
+        m = _mlp()
+        sm = pt.jit.to_static(m)
+        sm(t(np.zeros((2, 8))))
+        sm(t(np.zeros((2, 8))))
+        assert len(sm.code_cache) == 1
+        sm(t(np.zeros((5, 8))))
+        assert len(sm.code_cache) == 2
+
+    def test_plain_function(self):
+        @pt.jit.to_static
+        def f(a, b):
+            return pt.matmul(a, b) + 1.0
+        a = t(np.random.RandomState(0).randn(3, 4))
+        b = t(np.random.RandomState(1).randn(4, 2))
+        np.testing.assert_allclose(
+            f(a, b).numpy(), a.numpy() @ b.numpy() + 1.0, rtol=1e-5)
+
+    def test_batchnorm_buffers_update_under_jit(self):
+        m = nn.Sequential(nn.Linear(4, 6), nn.BatchNorm1D(6))
+        m.train()
+        sm = pt.jit.to_static(m)
+        before = m[1]._mean.numpy().copy()
+        sm(t(np.random.RandomState(0).randn(16, 4) * 3 + 2))
+        after = m[1]._mean.numpy()
+        assert not np.allclose(before, after)
+        assert np.isfinite(after).all()
+
+    def test_dropout_varies_across_calls(self):
+        m = nn.Dropout(0.5)
+        m.train()
+        sm = pt.jit.to_static(m)
+        x = t(np.ones((32, 32)))
+        y1 = sm(x).numpy()
+        y2 = sm(x).numpy()
+        assert (y1 == 0).any()
+        assert not np.array_equal(y1, y2)  # rng threads through, not baked
+
+
+class TestTrainStep:
+    def _data(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 8).astype(np.float32)
+        W = rng.randn(8, 4).astype(np.float32)
+        y = X @ W
+        return X, y
+
+    def test_matches_eager_training(self):
+        X, y = self._data()
+        loss_layer = nn.MSELoss()
+
+        def loss_fn(model, xb, yb):
+            return loss_layer(model(xb), yb)
+
+        # eager run
+        m1 = _mlp(seed=7)
+        o1 = opt.AdamW(learning_rate=0.01, parameters=m1.parameters(),
+                       grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        eager_losses = []
+        for _ in range(10):
+            loss = loss_fn(m1, t(X), t(y))
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+            eager_losses.append(float(loss.numpy()))
+
+        # compiled run (identical init via same seed)
+        m2 = _mlp(seed=7)
+        o2 = opt.AdamW(learning_rate=0.01, parameters=m2.parameters(),
+                       grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        step = pt.jit.TrainStep(m2, loss_fn, o2)
+        jit_losses = [float(step(t(X), t(y)).numpy()) for _ in range(10)]
+
+        np.testing.assert_allclose(jit_losses, eager_losses, rtol=1e-4,
+                                   atol=1e-6)
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_single_compile_across_steps(self):
+        X, y = self._data()
+        m = _mlp()
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = pt.jit.TrainStep(m, lambda mm, a, b: nn.MSELoss()(mm(a), b), o)
+        for _ in range(5):
+            step(t(X), t(y))
+        assert len(step._cache) == 1
+
+    def test_scheduler_lr_no_retrace(self):
+        X, y = self._data()
+        m = _mlp()
+        sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+        o = opt.SGD(learning_rate=sched, parameters=m.parameters())
+        step = pt.jit.TrainStep(m, lambda mm, a, b: nn.MSELoss()(mm(a), b), o)
+        for _ in range(3):
+            step(t(X), t(y))
+            sched.step()
+        assert len(step._cache) == 1
+
+    def test_momentum_state_advances(self):
+        X, y = self._data()
+        m = _mlp()
+        o = opt.Momentum(learning_rate=0.01, momentum=0.9,
+                         parameters=m.parameters())
+        step = pt.jit.TrainStep(m, lambda mm, a, b: nn.MSELoss()(mm(a), b), o)
+        step(t(X), t(y))
+        p0 = m[0].weight
+        v1 = np.asarray(o._state[id(p0)]["velocity"]).copy()
+        step(t(X), t(y))
+        v2 = np.asarray(o._state[id(p0)]["velocity"])
+        assert not np.allclose(v1, v2)
+
+    def test_compiled_beats_eager(self):
+        # soft speedup floor for CI stability; the >=10x claim is checked in
+        # the verify drive on a bigger model
+        X, y = self._data()
+        loss_fn = lambda mm, a, b: nn.MSELoss()(mm(a), b)
+        m1 = _mlp(seed=3)
+        o1 = opt.AdamW(learning_rate=1e-3, parameters=m1.parameters())
+        t0 = time.perf_counter()
+        for _ in range(30):
+            loss = loss_fn(m1, t(X), t(y))
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+        eager_t = time.perf_counter() - t0
+
+        m2 = _mlp(seed=3)
+        o2 = opt.AdamW(learning_rate=1e-3, parameters=m2.parameters())
+        step = pt.jit.TrainStep(m2, loss_fn, o2)
+        step(t(X), t(y))  # compile outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(30):
+            step(t(X), t(y))
+        jit_t = time.perf_counter() - t0
+        assert jit_t < eager_t, (jit_t, eager_t)
