@@ -1,0 +1,26 @@
+"""paddle.distributed parity namespace, TPU-native.
+
+Reference surface: ``python/paddle/distributed/`` (SURVEY.md §2.4/§2.5).
+Design (SURVEY.md §7): parallelism is sharding annotation over a named
+device mesh — collectives compile into XLA programs over ICI/DCN instead of
+runtime NCCL calls; per-rank semantics live inside :func:`spmd` regions.
+"""
+from .mesh import (  # noqa: F401
+    init_mesh, get_mesh, set_mesh, mesh_scope, ProcessMesh,
+)
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather, reduce,
+    reduce_scatter, broadcast, all_to_all, scatter, send, recv, barrier,
+    p2p_shift, spmd, shard_map, P,
+)
+from .sharding_api import (  # noqa: F401
+    Shard, Replicate, Partial, shard_tensor, reshard, named_sharding,
+    spec_of, with_sharding_constraint,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+)
+from .parallel import DataParallel  # noqa: F401

@@ -1,0 +1,368 @@
+"""Collective communication API.
+
+Parity surface: ``python/paddle/distributed/communication/`` (all_reduce,
+all_gather, reduce_scatter, broadcast, all_to_all, send/recv, barrier) and the
+C++ ProcessGroup family (SURVEY.md §2.4). TPU-native redesign: a collective is
+not a runtime call into NCCL — it is an *XLA op over a named mesh axis*
+(psum/all_gather/ppermute compiled onto ICI). Per-rank semantics (each rank
+holding different data) exist inside :func:`spmd` (shard_map) regions; that is
+where these functions are used, exactly as the reference uses them inside a
+rank's train script. The reference's process groups become :class:`Group`
+objects naming mesh axes.
+
+Example (loss-parity test pattern, SURVEY.md §4)::
+
+    mesh = dist.init_mesh({"dp": 8})
+
+    @dist.spmd(mesh=mesh, in_specs=P("dp"), out_specs=P())
+    def global_mean(local_batch):
+        s = dist.all_reduce(local_batch.sum(), group=dist.Group(("dp",)))
+        return s / total
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+from .mesh import get_mesh
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+           "all_gather", "all_gather_object", "reduce", "reduce_scatter",
+           "broadcast", "all_to_all", "scatter", "send", "recv", "barrier",
+           "spmd", "shard_map", "P"]
+
+from jax.sharding import PartitionSpec as P  # re-export for specs
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator = a tuple of mesh axis names (the reference's
+    ProcessGroup/ring-id, reduced to its essence on a mesh)."""
+
+    _registry = {}
+    _next_id = 0
+
+    def __init__(self, axes: Union[str, Sequence[str]], mesh=None):
+        self.axes: Tuple[str, ...] = (axes,) if isinstance(axes, str) \
+            else tuple(axes)
+        self._mesh = mesh
+
+    @property
+    def mesh(self):
+        return self._mesh or get_mesh()
+
+    @property
+    def nranks(self) -> int:
+        m = self.mesh
+        if m is None:
+            return 1
+        return int(np.prod([m.shape[a] for a in self.axes]))
+
+    @property
+    def axis_name(self):
+        return self.axes if len(self.axes) > 1 else self.axes[0]
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self.nranks})"
+
+
+def new_group(ranks=None, axes=None, mesh=None) -> Group:
+    """Create a communicator. On a mesh, groups are axis-aligned: pass
+    ``axes``; the reference's arbitrary rank lists have no XLA analog and
+    raise (paddle LLM recipes only ever build axis-aligned groups)."""
+    if axes is None:
+        m = mesh or get_mesh()
+        if ranks is not None and m is not None and \
+                len(ranks) != int(np.prod(list(m.shape.values()))):
+            raise NotImplementedError(
+                "arbitrary-rank groups are not representable as mesh axes; "
+                "pass axes=('dp',) etc.")
+        axes = tuple(m.axis_names) if m is not None else ("dp",)
+    g = Group(axes, mesh)
+    gid = Group._next_id
+    Group._next_id += 1
+    Group._registry[gid] = g
+    g.id = gid
+    return g
+
+
+def get_group(gid: int) -> Optional[Group]:
+    return Group._registry.get(gid)
+
+
+def _axes(group) -> Tuple[str, ...]:
+    if group is None:
+        m = get_mesh()
+        return tuple(m.axis_names) if m is not None else ()
+    if isinstance(group, Group):
+        return group.axes
+    if isinstance(group, str):
+        return (group,)
+    return tuple(group)
+
+
+def _in_mapped_context(axes) -> bool:
+    """True when the named axes are bound (i.e. we are inside shard_map)."""
+    import jax
+    try:
+        for a in axes:
+            jax.lax.axis_size(a)
+        return True
+    except (NameError, Exception):
+        return False
+
+
+def _collective(fn, t, op_name):
+    if isinstance(t, Tensor):
+        return apply_op(fn, t, op_name=op_name)
+    return fn(t)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce across the group; every rank gets the result
+    (reference: communication/all_reduce.py → ProcessGroup::AllReduce)."""
+    import jax
+    axes = _axes(group)
+    if not axes or not _in_mapped_context(axes):
+        if group is None or Group(axes).nranks == 1:
+            return tensor  # single-rank: identity, matching paddle
+        raise RuntimeError(
+            "per-rank collectives run inside dist.spmd/shard_map regions; "
+            "outside, arrays are global and all_reduce has no meaning")
+    red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+           ReduceOp.MIN: jax.lax.pmin, ReduceOp.AVG: jax.lax.pmean}
+    if op == ReduceOp.PROD:
+        def f(x):
+            import jax.numpy as jnp
+            logs = jax.lax.psum(jnp.log(jnp.abs(x)), axes)
+            sign = jax.lax.psum((x < 0).astype(jnp.int32), axes)
+            return jnp.exp(logs) * jnp.where(sign % 2 == 1, -1.0, 1.0)
+    else:
+        def f(x):
+            return red[op](x, axes)
+    return _collective(f, tensor, f"all_reduce_{op}")
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
+               axis=0):
+    """Gather shards from every rank (concatenated along ``axis``).
+
+    Supports both call shapes: paddle's ``all_gather(out_list, t)`` and the
+    functional ``out = all_gather(t)``.
+    """
+    import jax
+    out_list = None
+    if tensor is None:
+        t = tensor_or_list
+    else:
+        out_list, t = tensor_or_list, tensor
+    axes = _axes(group)
+    if not axes or not _in_mapped_context(axes):
+        if group is None or Group(axes).nranks == 1:
+            result = t
+        else:
+            raise RuntimeError("all_gather outside a dist.spmd region")
+    else:
+        def f(x):
+            return jax.lax.all_gather(x, axes, axis=axis, tiled=True)
+        result = _collective(f, t, "all_gather")
+    if out_list is not None:
+        n = Group(axes).nranks if axes else 1
+        from paddle_tpu import ops
+        out_list.extend(ops.split(result, n, axis=axis)
+                        if n > 1 else [result])
+        return None
+    return result
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Host-object gather (reference: communication/all_gather.py
+    all_gather_object). Single-controller SPMD has one host process per
+    slice; cross-process object gather goes through jax's host callback
+    mesh — for now the single-process case (tests, one-host jobs)."""
+    import jax
+    if jax.process_count() == 1:
+        object_list.append(obj)
+        return None
+    raise NotImplementedError(
+        "multi-host all_gather_object requires the DCN store (planned)")
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """psum then keep (XLA has no single-dst reduce cheaper than allreduce
+    on ICI; the reference's reduce is NCCL Reduce — result equal on dst,
+    undefined elsewhere; we return the reduced value everywhere)."""
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                   axis=0):
+    """Reduce + scatter shards (reference: communication/reduce_scatter.py).
+    Input per-rank shape [N, ...] -> output [N/world, ...]."""
+    import jax
+    axes = _axes(group)
+    if not axes or not _in_mapped_context(axes):
+        if group is None or Group(axes).nranks == 1:
+            return tensor
+        raise RuntimeError("reduce_scatter outside a dist.spmd region")
+
+    def f(x):
+        return jax.lax.psum_scatter(x, axes, scatter_dimension=axis,
+                                    tiled=True)
+    return _collective(f, tensor, "reduce_scatter")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Replicate src's value across the group. On a mesh this is a
+    collective-select: every rank takes rank ``src``'s shard."""
+    import jax
+    axes = _axes(group)
+    if not axes or not _in_mapped_context(axes):
+        if group is None or Group(axes).nranks == 1:
+            return tensor
+        raise RuntimeError("broadcast outside a dist.spmd region")
+
+    def f(x):
+        n = jax.lax.axis_size(axes[0] if len(axes) == 1 else axes)
+        g = jax.lax.all_gather(x, axes, axis=0)
+        return g[src]
+    return _collective(f, tensor, "broadcast")
+
+
+def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
+               sync_op=True, split_axis=0, concat_axis=0):
+    """All-to-all over the group (reference: communication/all_to_all.py →
+    the MoE dispatch primitive ``global_scatter``). Functional form: pass a
+    single tensor whose ``split_axis`` divides by world size."""
+    import jax
+    axes = _axes(group)
+    single = not isinstance(in_tensor_list, (list, tuple))
+    if not axes or not _in_mapped_context(axes):
+        if group is None or Group(axes).nranks == 1:
+            return in_tensor_list
+        raise RuntimeError("all_to_all outside a dist.spmd region")
+    axis_name = axes if len(axes) > 1 else axes[0]
+    if single:
+        def f(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                      concat_axis=concat_axis, tiled=True)
+        return _collective(f, in_tensor_list, "all_to_all")
+    # list form: stack -> all_to_all -> unstack into out_tensor_list
+    from paddle_tpu import ops
+    stacked = ops.stack(list(in_tensor_list), axis=0)
+
+    def f(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                                  tiled=False)
+    out = _collective(f, stacked, "all_to_all")
+    outs = [out[i] for i in range(len(in_tensor_list))]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(outs)
+        return None
+    return outs
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Take src's i-th shard on rank i (reference: communication/scatter)."""
+    import jax
+    axes = _axes(group)
+    if not axes or not _in_mapped_context(axes):
+        if group is None or Group(axes).nranks == 1:
+            return tensor
+        raise RuntimeError("scatter outside a dist.spmd region")
+
+    def f(x):
+        axis = axes[0] if len(axes) == 1 else axes
+        n = jax.lax.axis_size(axis)
+        g = jax.lax.all_gather(x, axes, axis=0)  # [n, *local]
+        i = jax.lax.axis_index(axis)
+        chunk = x.shape[0] // n
+        return jax.lax.dynamic_slice_in_dim(g[src], i * chunk, chunk, 0)
+    if tensor_list is not None:
+        from paddle_tpu import ops
+        tensor = ops.concat(list(tensor_list), axis=0)
+    return _collective(f, tensor, "scatter")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send — on a mesh this is a collective_permute (ppermute) to the
+    destination; pair with :func:`recv` in the same spmd program. The
+    reference's send_v2/recv_v2 (PP micro-batch transfer) maps to
+    :func:`p2p_shift` which is what the pipeline engine uses."""
+    raise NotImplementedError(
+        "raw send/recv have no XLA analog; use dist.p2p_shift (ppermute) "
+        "inside an spmd region — the PP engine does")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "raw send/recv have no XLA analog; use dist.p2p_shift (ppermute) "
+        "inside an spmd region — the PP engine does")
+
+
+def p2p_shift(tensor, group=None, shift: int = 1):
+    """Shift values along a mesh axis ring: rank i's data goes to rank
+    (i+shift) % n — the ICI-native form of send/recv used for pipeline
+    micro-batch handoff (reference: p2p_communication.py _p2p_helper)."""
+    import jax
+    axes = _axes(group)
+    axis = axes[0] if len(axes) == 1 else axes
+
+    def f(x):
+        n = jax.lax.axis_size(axis)
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return jax.lax.ppermute(x, axis, perm)
+    return _collective(f, tensor, "p2p_shift")
+
+
+def barrier(group=None):
+    """Device-level barriers are implicit in XLA program boundaries; this
+    synchronizes the host on outstanding work (paddle barrier blocks the
+    host the same way)."""
+    import jax
+    jax.effects_barrier()
+    return None
+
+
+def shard_map(fn, mesh=None, in_specs=None, out_specs=None,
+              check_rep=False):
+    """Thin wrapper over jax shard_map operating on Tensors."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    mesh = mesh or get_mesh()
+
+    def unwrap(x):
+        return x.data if isinstance(x, Tensor) else x
+
+    def run(*args):
+        import functools
+        inner = jax.shard_map(
+            lambda *a: jax.tree_util.tree_map(
+                unwrap, fn(*[Tensor(x) if hasattr(x, "dtype") else x
+                             for x in a]),
+                is_leaf=lambda v: isinstance(v, Tensor)),
+            mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_rep)
+        out = inner(*[unwrap(a) for a in args])
+        return jax.tree_util.tree_map(
+            lambda x: Tensor(x) if hasattr(x, "dtype") else x, out)
+    return run
+
+
+def spmd(fn=None, mesh=None, in_specs=None, out_specs=None):
+    """Decorator form of :func:`shard_map` — the region where per-rank
+    (paddle-style) collective semantics hold."""
+    def wrap(f):
+        return shard_map(f, mesh, in_specs, out_specs)
+    return wrap(fn) if fn is not None else wrap

@@ -1,0 +1,90 @@
+"""Process/environment bootstrap.
+
+Parity with the reference's ``init_parallel_env``
+(``python/paddle/distributed/parallel.py:919``: read PADDLE_TRAINER_* env,
+TCPStore rendezvous, default process group, barrier). On TPU the runtime
+(jax.distributed / PJRT) owns rendezvous: multi-host jobs call
+``jax.distributed.initialize`` with a coordinator address — the TCPStore
+analog — after which every host sees the global device set and SPMD programs
+span the slice. Single-process (incl. the 8-device CPU test mesh) needs no
+rendezvous at all.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .mesh import get_mesh, init_mesh
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv"]
+
+_initialized = {"done": False}
+
+
+def init_parallel_env(mesh_shape: Optional[dict] = None):
+    """Bootstrap distributed state and the default mesh.
+
+    Honors the reference's env-variable protocol where present
+    (PADDLE_TRAINER_ID → process index, PADDLE_MASTER/MASTER_ADDR →
+    coordinator) and maps it onto jax.distributed for multi-host TPU.
+    """
+    import jax
+
+    if _initialized["done"]:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    n_proc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    proc_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and n_proc > 1 and jax.process_count() == 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord}:{port}" if ":" not in coord
+            else coord,
+            num_processes=n_proc, process_id=proc_id)
+    if get_mesh() is None:
+        init_mesh(mesh_shape)
+    _initialized["done"] = True
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    """Host process index (reference: paddle.distributed.get_rank).
+
+    Under SPMD one process drives many devices; this is the *process* rank
+    (device-level rank only exists inside shard_map, via lax.axis_index).
+    """
+    import jax
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    """Total device count across the job (paddle world-size semantics map to
+    chips on TPU — each chip was a paddle "rank")."""
+    import jax
+    if group is not None and hasattr(group, "nranks"):
+        return group.nranks
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """Reference: ``python/paddle/fluid/dygraph/parallel.py`` ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

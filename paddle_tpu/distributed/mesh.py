@@ -1,0 +1,117 @@
+"""Device mesh construction and the global default mesh.
+
+TPU-native replacement for the reference's 4-D communicator topology
+(``python/paddle/distributed/fleet/base/topology.py:54`` CommunicateTopology
+building NCCL groups per axis): on TPU the mesh IS the communicator — XLA
+compiles collectives onto ICI along mesh axes, so "creating a process group
+per axis" becomes "naming a mesh axis".
+
+Canonical axis names (SURVEY.md §7): ``dp`` (data), ``pp`` (pipeline),
+``sharding`` (ZeRO), ``mp`` (tensor/model), ``sp`` (sequence/context).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["init_mesh", "get_mesh", "set_mesh", "mesh_scope", "ProcessMesh",
+           "DEFAULT_AXES"]
+
+DEFAULT_AXES = ("dp", "pp", "sharding", "mp", "sp")
+
+_state = {"mesh": None}
+
+
+def init_mesh(shape: Optional[Dict[str, int]] = None, devices=None):
+    """Build a ``jax.sharding.Mesh`` over the available devices.
+
+    ``shape`` maps axis name -> size, e.g. ``{"dp": 2, "mp": 4}``; axes
+    must multiply to the device count. With no shape, all devices go on
+    ``dp`` (pure data parallelism).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = {"dp": n}
+    sizes = list(shape.values())
+    if int(np.prod(sizes)) != n:
+        raise ValueError(
+            f"mesh shape {shape} does not cover {n} devices")
+    arr = np.array(devices).reshape(sizes)
+    mesh = Mesh(arr, tuple(shape.keys()))
+    _state["mesh"] = mesh
+    return mesh
+
+
+def get_mesh():
+    """The current default mesh (None until init_mesh/set_mesh)."""
+    return _state["mesh"]
+
+
+def set_mesh(mesh):
+    _state["mesh"] = mesh
+    return mesh
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh):
+    prev = _state["mesh"]
+    _state["mesh"] = mesh
+    try:
+        yield mesh
+    finally:
+        _state["mesh"] = prev
+
+
+class ProcessMesh:
+    """Auto-parallel style mesh descriptor (reference:
+    ``python/paddle/distributed/auto_parallel/process_mesh.py``): an N-D
+    array of global ranks plus dim names, convertible to a jax Mesh."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        self._array = np.asarray(mesh)
+        self._dim_names = list(dim_names) if dim_names is not None else \
+            [f"d{i}" for i in range(self._array.ndim)]
+
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [int(r) for r in self._array.flatten()]
+
+    def get_dim_size(self, name):
+        return self._array.shape[self._dim_names.index(name)]
+
+    def to_jax(self):
+        """Materialize as a jax Mesh (ranks index jax.devices())."""
+        import jax
+        from jax.sharding import Mesh
+        devs = np.array(jax.devices())[self._array]
+        return Mesh(devs, tuple(self._dim_names))
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            np.array_equal(self._array, other._array) and \
+            self._dim_names == other._dim_names
+
+    def __hash__(self):
+        return hash((self._array.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dims={self._dim_names})"

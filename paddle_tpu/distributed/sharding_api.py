@@ -1,0 +1,142 @@
+"""Tensor sharding annotations — the GSPMD front door.
+
+Parity with the reference's auto-parallel marking API
+(``python/paddle/distributed/auto_parallel/interface.py`` shard_tensor +
+``placement_type.py`` Shard/Replicate/Partial): a tensor is placed on the
+default mesh with a per-dim placement; XLA's sharding propagation (the analog
+of the reference's Completer, ``completion.py:920``) spreads the annotations
+through the program and inserts collectives — the Resharder's job — during
+compilation.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from paddle_tpu.core.tensor import Tensor
+from .mesh import get_mesh
+
+__all__ = ["Shard", "Replicate", "Partial", "shard_tensor", "reshard",
+           "named_sharding", "spec_of", "with_sharding_constraint"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard tensor dim ``dim`` across a mesh axis."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+
+class Partial(Placement):
+    """Pending-reduction placement (reference: Partial status). XLA tracks
+    partial sums internally; at the annotation surface it behaves as
+    Replicate and exists for API parity."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def _placements_to_spec(placements: Sequence, mesh, ndim: int):
+    """placements[i] describes MESH AXIS i (paddle convention): build the
+    per-tensor-dim PartitionSpec."""
+    from jax.sharding import PartitionSpec
+    dim_axes: List[Optional[object]] = [None] * ndim
+    for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.axis_names[axis_idx]
+            cur = dim_axes[pl.dim]
+            if cur is None:
+                dim_axes[pl.dim] = name
+            elif isinstance(cur, tuple):
+                dim_axes[pl.dim] = cur + (name,)
+            else:
+                dim_axes[pl.dim] = (cur, name)
+    return PartitionSpec(*dim_axes)
+
+
+def named_sharding(spec, mesh=None):
+    import jax
+    mesh = mesh or get_mesh()
+    return jax.sharding.NamedSharding(mesh, spec)
+
+
+def shard_tensor(x, mesh=None, placements=None, spec=None,
+                 stop_gradient=None):
+    """Place ``x`` on the mesh (reference: dist.shard_tensor).
+
+    Either paddle-style ``placements`` (one Placement per mesh axis) or a
+    jax ``PartitionSpec`` via ``spec``. Returns a Tensor whose storage is a
+    global sharded jax array; ``_sharding_spec`` records the spec for the
+    jit path (TrainStep propagates it into in/out_shardings).
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise RuntimeError("no default mesh; call dist.init_mesh first")
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if spec is None:
+        placements = placements or []
+        spec = _placements_to_spec(placements, mesh, t.ndim)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    arr = jax.device_put(t.data, sharding)
+    out = Tensor(arr, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient, name=t.name)
+    out._sharding_spec = spec
+    # in-place annotate Parameters so layers keep their identity
+    if isinstance(x, Tensor):
+        x._data = arr
+        x._sharding_spec = spec
+        return x
+    return out
+
+
+def reshard(x, mesh=None, placements=None, spec=None):
+    """Change a tensor's placement (reference: Resharder, reshard.py:2668 —
+    here a single device_put; XLA emits the transfer collectives)."""
+    return shard_tensor(x, mesh, placements, spec)
+
+
+def spec_of(t: Tensor):
+    """The PartitionSpec annotation of a tensor (fully-replicated if none)."""
+    from jax.sharding import PartitionSpec
+    s = getattr(t, "_sharding_spec", None)
+    return s if s is not None else PartitionSpec()
+
+
+def with_sharding_constraint(t, spec, mesh=None):
+    """In-trace sharding annotation (the compiler-visible hint — reference
+    analog: dist attrs on intermediate vars)."""
+    import jax
+    from paddle_tpu.core.autograd import apply_op
+    mesh = mesh or get_mesh()
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+    return apply_op(lambda v: jax.lax.with_sharding_constraint(v, sharding),
+                    t, op_name="sharding_constraint")

@@ -78,7 +78,7 @@ class StaticFunction:
         self._cache = {}
         functools.update_wrapper(self, fn if callable(fn) else self._fn)
 
-    def _compile(self, key, treedef, training):
+    def _compile(self, treedef):
         layer = self._layer
 
         if layer is not None:
@@ -106,7 +106,7 @@ class StaticFunction:
         # structure must not reuse a compiled closure
         key = (treedef, sig, training)
         if key not in self._cache:
-            self._cache[key] = self._compile(key, treedef, training)
+            self._cache[key] = self._compile(treedef)
         compiled = self._cache[key]
 
         if self._layer is not None:

@@ -41,12 +41,13 @@ def swap_state(layer: Layer, values: Dict[str, object],
     """
     params = dict(layer.named_parameters())
     buffers = dict(layer.named_buffers())
-    saved = {}
     targets = {**params, **buffers}
+    unknown = [n for n in values if n not in targets]
+    if unknown:  # validate before any swap so a typo cannot corrupt storage
+        raise KeyError(f"no parameter/buffer named {unknown}")
+    saved = {}
     for name, val in values.items():
-        t = targets.get(name)
-        if t is None:
-            raise KeyError(f"no parameter/buffer named {name!r}")
+        t = targets[name]
         saved[name] = t._data
         t._data = val
     out_buffers = {}

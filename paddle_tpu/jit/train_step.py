@@ -36,13 +36,33 @@ __all__ = ["TrainStep"]
 
 class TrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True):
+                 donate: bool = True, mesh=None, input_spec=None):
+        """``mesh``/``input_spec`` activate SPMD compilation: every batch
+        leaf is placed with ``input_spec`` (a PartitionSpec, default: shard
+        dim 0 on the mesh's ``dp`` axis; a ``DataParallel`` wrapper supplies
+        its ``batch_spec``), parameters keep their ``_sharding_spec``
+        annotations (replicated when unannotated — plain DP; sharded for
+        TP/ZeRO), and XLA inserts all gradient/activation collectives."""
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
         self._donate = donate
         self._cache = {}
+        from paddle_tpu.distributed.parallel import DataParallel
+        if mesh is None and isinstance(model, DataParallel):
+            mesh = model._mesh
+        if input_spec is None and isinstance(model, DataParallel):
+            input_spec = model.batch_spec
+        self._mesh = mesh
+        self._input_spec = input_spec
         self._params = {name: p for name, p in model.named_parameters()}
+        # only parameters handed to the optimizer are trained — params the
+        # user excluded (freeze-by-exclusion fine-tuning) stay frozen,
+        # matching eager step() semantics
+        self._opt_param_ids = {id(p) for p in optimizer._parameter_list}
+        self._group_index = {id(p): gi
+                             for gi, g in enumerate(optimizer._param_groups)
+                             for p in g["params"]}
         # Accumulators must exist before the first trace. Donated buffers
         # must be distinct: cloned layers (set_value's no-op astype) and
         # cached constants can silently share device buffers, which the
@@ -83,23 +103,21 @@ class TrainStep:
         clipped = clip(pairs)
         return {n: c.data for n, (_, c) in zip(names, clipped)}
 
-    def _update_pure(self, train, grads, states, lr):
+    def _update_pure(self, train, grads, states, group_lrs):
         """Apply the optimizer's pure rule per parameter (same code the eager
-        step() runs — see optimizer.py module doc)."""
+        step() runs — see optimizer.py module doc). ``group_lrs`` holds one
+        traced effective-LR scalar per param group (scheduler values are
+        resolved host-side each call, never baked into the trace)."""
         opt = self._opt
         new_train, new_states = {}, {}
-        group_of = {}
-        for group in opt._param_groups:
-            for p in group["params"]:
-                group_of[id(p)] = group
         for name, p_arr in train.items():
             p = self._params[name]
             g = grads[name]
             state = states[name]
-            group = group_of.get(id(p), opt._param_groups[0])
+            gi = self._group_index[id(p)]
+            group = opt._param_groups[gi]
             decay = group.get("weight_decay", opt.regularization)
-            glr = group.get("learning_rate", None)
-            eff_lr = lr * glr if glr is not None else lr
+            eff_lr = group_lrs[gi]
             if "master_weight" in state:
                 g = g.astype(jax.numpy.float32)
                 p_arr = state["master_weight"]
@@ -123,7 +141,8 @@ class TrainStep:
     def _compile(self, treedef):
         model, loss_fn = self._model, self._loss_fn
 
-        def pure(train, frozen, buffers, states, lr, rng_key, flat_batch):
+        def pure(train, frozen, buffers, states, group_lrs, rng_key,
+                 flat_batch):
             args = jax.tree_util.tree_unflatten(treedef, flat_batch)
             args = _wrap(args)
 
@@ -139,31 +158,105 @@ class TrainStep:
                 loss_of, has_aux=True)(train)
             grads = self._clip_pure(grads)
             new_train, new_states = self._update_pure(train, grads, states,
-                                                      lr)
+                                                      group_lrs)
             return loss_val, new_train, new_states, new_bufs
 
         donate = (0, 3) if self._donate else ()
-        return jax.jit(pure, donate_argnums=donate)
+        if self._mesh is None:
+            return jax.jit(pure, donate_argnums=donate)
+
+        # SPMD: per-argument shardings; GSPMD propagates through the step
+        # and emits the collectives (grad psum for DP, activation
+        # all-gathers for TP, ...)
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self._mesh
+
+        def ns(spec):
+            return NamedSharding(mesh, spec)
+
+        rep = ns(PartitionSpec())
+
+        def param_spec(name):
+            s = getattr(self._params[name], "_sharding_spec", None)
+            return ns(s) if s is not None else rep
+
+        train, frozen, buffers = self._split_state()
+        train_sh = {n: param_spec(n) for n in train}
+        frozen_sh = {n: param_spec(n) for n in frozen}
+        buf_sh = {n: rep for n in buffers}
+        states_sh = {}
+        for n in train:
+            p = self._params[n]
+            st = self._opt._ensure_state(p)
+            states_sh[n] = {
+                k: (param_spec(n) if getattr(v, "shape", None) ==
+                    p.data.shape else rep)
+                for k, v in st.items()}
+        in_spec = self._input_spec
+        if in_spec is None and "dp" in mesh.axis_names:
+            in_spec = PartitionSpec("dp")
+
+        def batch_sharding(arr):
+            if in_spec is None or not hasattr(arr, "ndim") or arr.ndim == 0:
+                return rep
+            return ns(in_spec)
+
+        flat_example, _ = jax.tree_util.tree_flatten(self._example_batch)
+        batch_sh = [batch_sharding(a) for a in flat_example]
+        lr_sh = [rep] * len(self._opt._param_groups)
+        in_shardings = (train_sh, frozen_sh, buf_sh, states_sh, lr_sh, rep,
+                        batch_sh)
+        out_shardings = (rep, train_sh, states_sh, buf_sh)
+        return jax.jit(pure, donate_argnums=donate,
+                       in_shardings=in_shardings,
+                       out_shardings=out_shardings)
+
+    def _split_state(self):
+        """(train, frozen, buffers) arrays — train restricted to params the
+        optimizer owns AND that are currently trainable."""
+        train, frozen, buffers = functional_state(self._model)
+        for name in list(train.keys()):
+            if id(self._params[name]) not in self._opt_param_ids:
+                frozen[name] = train.pop(name)
+        return train, frozen, buffers
+
+    def _group_lrs(self):
+        """Effective LR per param group, resolved host-side (mirrors eager
+        step(): group lr — scheduler or float — scales the optimizer lr)."""
+        from paddle_tpu.optimizer import lr as lr_mod
+        base = self._opt.get_lr()
+        out = []
+        for g in self._opt._param_groups:
+            glr = g.get("learning_rate")
+            if isinstance(glr, lr_mod.LRScheduler):
+                out.append(np.float32(glr() * base))
+            elif glr is not None:
+                out.append(np.float32(glr * base))
+            else:
+                out.append(np.float32(base))
+        return out
 
     # -- call -----------------------------------------------------------------
     def __call__(self, *args, **kwargs):
         model, opt = self._model, self._opt
         treedef, sig = _sig_of((args, kwargs))
-        key = (treedef, sig, model.training)
+        train, frozen, buffers = self._split_state()
+        # the trainable-name set keys the cache too: unfreezing a param
+        # changes the train pytree (and, under a mesh, the shardings)
+        key = (treedef, sig, model.training, tuple(sorted(train)))
         if key not in self._cache:
+            self._example_batch = _unwrap((args, kwargs))
             self._cache[key] = self._compile(treedef)
         compiled = self._cache[key]
 
-        train, frozen, buffers = functional_state(model)
-        states = {name: opt._state[id(p)]
-                  for name, p in self._params.items()
-                  if not p.stop_gradient}
+        states = {name: opt._ensure_state(self._params[name])
+                  for name in train}
         flat_batch, _ = jax.tree_util.tree_flatten(_unwrap((args, kwargs)))
-        lr = np.float32(opt.get_lr())
         rng_key = _gen.next_key()
 
         loss_val, new_train, new_states, new_bufs = compiled(
-            train, frozen, buffers, states, lr, rng_key, flat_batch)
+            train, frozen, buffers, states, self._group_lrs(), rng_key,
+            flat_batch)
 
         # write back (storage replacement — same semantics as eager step())
         opt._step_count += 1

@@ -101,6 +101,27 @@ class Conv3D(_ConvNd):
 class _ConvTransposeNd(_ConvNd):
     _transpose = True
 
+    def _pad_pairs(self):
+        """Normalize padding to per-dim (lo, hi) pairs for output-size math.
+        Handles int, per-dim ints, paddle's flat [lo0, hi0, lo1, hi1, ...]
+        and nested pair forms; string modes have no closed-form default."""
+        nd = self._nd
+        p = self._padding
+        if isinstance(p, str):
+            raise NotImplementedError(
+                f"output_size with padding={p!r} (string mode) is not "
+                "supported; pass explicit integer padding")
+        if isinstance(p, int):
+            return [(p, p)] * nd
+        p = list(p)
+        if len(p) == nd and all(isinstance(v, int) for v in p):
+            return [(v, v) for v in p]
+        if len(p) == 2 * nd and all(isinstance(v, int) for v in p):
+            return [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        if len(p) == nd:  # nested [[lo, hi], ...]
+            return [tuple(v) for v in p]
+        raise ValueError(f"cannot interpret padding {self._padding!r}")
+
     def _out_padding(self, x, output_size):
         """Derive output_padding from a requested output_size (paddle
         semantics: output_size must lie in [default, default + stride))."""
@@ -111,14 +132,15 @@ class _ConvTransposeNd(_ConvNd):
             output_size = [output_size] * nd
         channel_last = self._data_format.endswith("C")
         spatial0 = 1 if channel_last else 2
-        pad = _ntuple(self._padding, nd)
+        pairs = self._pad_pairs()
         out_pad = []
         for i in range(nd):
             in_sz = x.shape[spatial0 + i]
-            default = (in_sz - 1) * self._stride[i] - 2 * pad[i] + \
+            lo, hi = pairs[i]
+            default = (in_sz - 1) * self._stride[i] - (lo + hi) + \
                 self._dilation[i] * (self._kernel_size[i] - 1) + 1
             extra = int(output_size[i]) - default
-            if not (0 <= extra < self._stride[i]) and extra != 0:
+            if not 0 <= extra < self._stride[i]:
                 raise ValueError(
                     f"output_size[{i}]={output_size[i]} out of the valid "
                     f"range [{default}, {default + self._stride[i]})")

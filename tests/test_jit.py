@@ -168,6 +168,56 @@ class TestTrainStep:
         v2 = np.asarray(o._state[id(p0)]["velocity"])
         assert not np.allclose(v1, v2)
 
+    def test_excluded_params_stay_frozen(self):
+        # freeze-by-exclusion: only the head is given to the optimizer
+        X, y = self._data()
+        m = _mlp()
+        head_params = [m[2].weight, m[2].bias]
+        o = opt.SGD(learning_rate=0.1, parameters=head_params)
+        step = pt.jit.TrainStep(m, lambda mm, a, b: nn.MSELoss()(mm(a), b), o)
+        backbone_before = m[0].weight.numpy().copy()
+        head_before = m[2].weight.numpy().copy()
+        step(t(X), t(y))
+        np.testing.assert_allclose(m[0].weight.numpy(), backbone_before)
+        assert not np.allclose(m[2].weight.numpy(), head_before)
+
+    def test_group_lr_scheduler_threads(self):
+        X, y = self._data()
+        m = _mlp()
+        sched = opt.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+        o = opt.SGD(learning_rate=0.1, parameters=[
+            {"params": [m[0].weight, m[0].bias]},
+            {"params": [m[2].weight, m[2].bias], "learning_rate": sched},
+        ])
+        step = pt.jit.TrainStep(m, lambda mm, a, b: nn.MSELoss()(mm(a), b), o)
+        step(t(X), t(y))
+        w1 = m[2].weight.numpy().copy()
+        sched.step()  # group lr drops 10x; no retrace, new value threads in
+        step(t(X), t(y))
+        assert len(step._cache) == 1
+
+    def test_unfreeze_after_construction(self):
+        X, y = self._data()
+        m = _mlp()
+        m[0].weight.stop_gradient = True
+        o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = pt.jit.TrainStep(m, lambda mm, a, b: nn.MSELoss()(mm(a), b), o)
+        step(t(X), t(y))
+        frozen_w = m[0].weight.numpy().copy()
+        m[0].weight.stop_gradient = False  # progressive unfreeze
+        step(t(X), t(y))
+        assert not np.allclose(m[0].weight.numpy(), frozen_w)
+
+    def test_swap_state_typo_does_not_corrupt(self):
+        m = _mlp()
+        before = m[0].weight.numpy().copy()
+        with pytest.raises(KeyError):
+            pt.jit.functional_call(
+                m, {"0.weight": np.zeros((8, 32), np.float32),
+                    "bogus": np.zeros(3, np.float32)},
+                t(np.zeros((2, 8))))
+        np.testing.assert_allclose(m[0].weight.numpy(), before)
+
     def test_compiled_beats_eager(self):
         # soft speedup floor for CI stability; the >=10x claim is checked in
         # the verify drive on a bigger model
