@@ -1,0 +1,131 @@
+"""Benchmark: Llama-3-8B transformer layer, forward+backward, bf16.
+
+Measures tokens/sec and MFU on the available accelerator and prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config mirrors the BASELINE.md north star (Llama-3-8B: d_model=4096,
+n_heads=32, ffn=14336 SwiGLU, seq 2048); vs_baseline is measured MFU over
+the >=40% target. FLOP accounting: 6*N*tokens-style analytic count per
+block (2 MAC flops; backward = 2x forward).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops(device) -> float:
+    """bf16 peak per chip by device kind (public TPU specs)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = [
+        ("v6e", 918e12), ("trillium", 918e12),
+        ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+    ]
+    for key, val in table:
+        if key in kind:
+            return val
+    if "tpu" in kind:
+        return 275e12  # conservative default for unknown TPU
+    return 0.0  # CPU: MFU not meaningful
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.functional import functional_state, swap_state
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        D, H, DFF, S, B = 4096, 32, 14336, 2048, 8
+        steps, warmup = 20, 3
+    else:  # smoke config so the bench is runnable anywhere
+        D, H, DFF, S, B = 256, 4, 896, 256, 4
+        steps, warmup = 5, 2
+
+    pt.seed(0)
+
+    class Block(nn.Layer):
+        """One pre-norm Llama block: RMSNorm -> attn -> RMSNorm -> SwiGLU."""
+
+        def __init__(self):
+            super().__init__()
+            self.norm1 = nn.RMSNorm(D)
+            self.attn = nn.MultiHeadAttention(D, H)
+            self.norm2 = nn.RMSNorm(D)
+            self.gate = nn.Linear(D, DFF, bias_attr=False)
+            self.up = nn.Linear(D, DFF, bias_attr=False)
+            self.down = nn.Linear(DFF, D, bias_attr=False)
+
+        def forward(self, x, mask):
+            h = x + self.attn(self.norm1(x), attn_mask=mask)
+            z = self.norm2(h)
+            return h + self.down(
+                nn.functional.silu(self.gate(z)) * self.up(z))
+
+    model = Block()
+    model.eval()
+    model.bfloat16()
+
+    train, frozen, buffers = functional_state(model)
+    state = {**train, **frozen, **buffers}
+    mask = nn.Transformer.generate_square_subsequent_mask(S)
+    mask_arr = mask.data.astype(jnp.bfloat16)
+
+    def fwd(params, x):
+        with swap_state(model, params, collect_buffers=False):
+            out = model(pt.Tensor(x), pt.Tensor(mask_arr))
+        return jnp.sum(out.data.astype(jnp.float32))
+
+    grad_fn = jax.jit(jax.value_and_grad(fwd))
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, S, D), dtype=jnp.bfloat16)
+
+    for _ in range(warmup):
+        val, grads = grad_fn(state, x)
+    jax.block_until_ready((val, grads))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        val, grads = grad_fn(state, x)
+    jax.block_until_ready((val, grads))
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens = B * S
+    # analytic FLOPs per forward: projections 8*D^2/token (QKVO) +
+    # SwiGLU 6*D*DFF/token + attention 4*S*D/token (QK^T + AV)
+    fwd_flops = tokens * (8 * D * D + 6 * D * DFF) + 4 * B * S * S * D
+    train_flops = 3 * fwd_flops  # backward = 2x forward
+    achieved = train_flops / dt
+    tok_per_sec = tokens / dt
+
+    dev = jax.devices()[0]
+    peak = peak_flops(dev)
+    mfu = achieved / peak if peak else 0.0
+
+    if on_tpu and peak:
+        result = {"metric": "llama3_8b_layer_mfu_bf16",
+                  "value": round(mfu * 100, 2), "unit": "percent_mfu",
+                  "vs_baseline": round(mfu / 0.40, 3)}
+    else:
+        result = {"metric": "llama3_8b_layer_tokens_per_sec_cpu_smoke",
+                  "value": round(tok_per_sec, 1), "unit": "tokens/sec",
+                  "vs_baseline": 0.0}
+    extra = {"tokens_per_sec": round(tok_per_sec, 1),
+             "step_ms": round(dt * 1e3, 2),
+             "achieved_tflops": round(achieved / 1e12, 2),
+             "device": getattr(dev, "device_kind", str(dev)),
+             "config": {"d": D, "heads": H, "dff": DFF, "seq": S,
+                        "batch": B}}
+    print(json.dumps(result))
+    print(json.dumps(extra), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -118,7 +118,7 @@ def _in_mapped_context(axes) -> bool:
         for a in axes:
             jax.lax.axis_size(a)
         return True
-    except (NameError, Exception):
+    except NameError:  # jax's unbound-axis-name error
         return False
 
 
@@ -169,15 +169,15 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
     axes = _axes(group)
     if not axes or not _in_mapped_context(axes):
         if group is None or Group(axes).nranks == 1:
-            result = t
+            result, n = t, 1  # identity: the "gather" holds one copy
         else:
             raise RuntimeError("all_gather outside a dist.spmd region")
     else:
         def f(x):
             return jax.lax.all_gather(x, axes, axis=axis, tiled=True)
         result = _collective(f, t, "all_gather")
+        n = Group(axes).nranks
     if out_list is not None:
-        n = Group(axes).nranks if axes else 1
         from paddle_tpu import ops
         out_list.extend(ops.split(result, n, axis=axis)
                         if n > 1 else [result])

@@ -75,7 +75,12 @@ class ProcessMesh:
 
     def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
                  process_ids=None):
-        self._array = np.asarray(mesh)
+        if process_ids is not None:
+            # newer-paddle convention: `mesh` is the shape, process_ids the
+            # flattened rank assignment
+            self._array = np.asarray(process_ids).reshape(list(mesh))
+        else:
+            self._array = np.asarray(mesh)
         self._dim_names = list(dim_names) if dim_names is not None else \
             [f"d{i}" for i in range(self._array.ndim)]
 

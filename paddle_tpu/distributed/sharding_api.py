@@ -114,6 +114,8 @@ def shard_tensor(x, mesh=None, placements=None, spec=None,
     if isinstance(x, Tensor):
         x._data = arr
         x._sharding_spec = spec
+        if stop_gradient is not None:
+            x.stop_gradient = stop_gradient
         return x
     return out
 

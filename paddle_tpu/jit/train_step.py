@@ -245,7 +245,12 @@ class TrainStep:
         # changes the train pytree (and, under a mesh, the shardings)
         key = (treedef, sig, model.training, tuple(sorted(train)))
         if key not in self._cache:
-            self._example_batch = _unwrap((args, kwargs))
+            # only shapes/dtypes are needed for sharding decisions — never
+            # pin the concrete batch for the object's lifetime
+            self._example_batch = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                if hasattr(a, "shape") and hasattr(a, "dtype") else a,
+                _unwrap((args, kwargs)))
             self._cache[key] = self._compile(treedef)
         compiled = self._cache[key]
 

@@ -373,9 +373,12 @@ class Transformer(Layer):
         return self.decoder(tgt, memory, tgt_mask=tgt_mask,
                             memory_mask=memory_mask)
 
-    def generate_square_subsequent_mask(self, length):
+    @staticmethod
+    def generate_square_subsequent_mask(length):
         """Additive causal mask [length, length] (reference semantics: 0 on
-        and below the diagonal, -inf above)."""
+        and below the diagonal, -inf above). Static — callable without
+        building a Transformer (paddle's is an instance method that never
+        touches self)."""
         from paddle_tpu.core.tensor import Tensor
         import jax.numpy as jnp
         m = jnp.where(jnp.tril(jnp.ones((length, length), bool)), 0.0,

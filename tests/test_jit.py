@@ -190,11 +190,17 @@ class TestTrainStep:
             {"params": [m[2].weight, m[2].bias], "learning_rate": sched},
         ])
         step = pt.jit.TrainStep(m, lambda mm, a, b: nn.MSELoss()(mm(a), b), o)
+        w0 = m[2].weight.numpy().copy()
         step(t(X), t(y))
         w1 = m[2].weight.numpy().copy()
+        d1 = np.abs(w1 - w0).max()
         sched.step()  # group lr drops 10x; no retrace, new value threads in
         step(t(X), t(y))
+        d2 = np.abs(m[2].weight.numpy() - w1).max()
         assert len(step._cache) == 1
+        # the second update must be much smaller — proves the scheduler value
+        # threads into the compiled step instead of being baked at trace time
+        assert d2 < d1 * 0.3, (d1, d2)
 
     def test_unfreeze_after_construction(self):
         X, y = self._data()

@@ -389,7 +389,7 @@ class TestTransformer:
         mha = nn.MultiHeadAttention(8, 2)
         mha.eval()
         x = np.random.RandomState(0).randn(1, 4, 8).astype(np.float32)
-        m = nn.Transformer(d_model=8, nhead=2).generate_square_subsequent_mask(4)
+        m = nn.Transformer.generate_square_subsequent_mask(4)
         out1 = mha(t(x), attn_mask=m).numpy()
         x2 = x.copy()
         x2[0, 3] += 100.0  # perturb the last position only
@@ -403,8 +403,7 @@ class TestTransformer:
         dec.eval()
         memory = t(np.random.RandomState(0).randn(1, 5, 8))
         tgt = np.random.RandomState(1).randn(1, 3, 8).astype(np.float32)
-        causal = nn.Transformer(d_model=8,
-                                nhead=2).generate_square_subsequent_mask(3)
+        causal = nn.Transformer.generate_square_subsequent_mask(3)
         full = dec(t(tgt), memory, tgt_mask=causal).numpy()
         cache = dec.gen_cache(memory)
         steps = []
@@ -428,8 +427,7 @@ class TestTransformer:
         o = opt.AdamW(learning_rate=5e-3, parameters=params)
         loss_fn = nn.CrossEntropyLoss()
         perm = rng.permutation(V)  # fixed next-token rule
-        causal = nn.Transformer(
-            d_model=D, nhead=4).generate_square_subsequent_mask(T)
+        causal = nn.Transformer.generate_square_subsequent_mask(T)
 
         losses = []
         for step in range(60):
