@@ -893,7 +893,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     Pallas flash kernel on TPU when enabled, else a jnp composite."""
     from paddle_tpu.core.flags import flag
     use_pallas = flag("use_pallas_kernels")
-    if use_pallas:
+    # the Pallas kernel implements only the mask-free (optionally causal),
+    # dropout-free case — anything else must take the composite path rather
+    # than silently dropping arguments
+    pallas_eligible = attn_mask is None and (
+        dropout_p == 0.0 or not training)
+    if use_pallas and pallas_eligible:
         try:
             import jax as _j
             if _j.default_backend() == "tpu":

@@ -21,6 +21,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Pallas registers its TPU MLIR lowerings at import; that must happen while
+# the tpu platform is still known, i.e. before we deregister backends below
+# (kernels themselves run with interpret=True on the CPU mesh).
+try:
+    from jax.experimental import pallas as _pallas  # noqa: F401
+    from jax.experimental.pallas import tpu as _pltpu  # noqa: F401
+except Exception:
+    pass
 try:
     from jax._src import xla_bridge as _xb
     for _name in list(getattr(_xb, "_backend_factories", {})):
