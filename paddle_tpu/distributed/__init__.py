@@ -24,3 +24,4 @@ from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup,
 )
 from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401

@@ -1,0 +1,162 @@
+"""Megatron-style tensor-parallel layers.
+
+Parity with the reference's mpu layer set
+(``python/paddle/distributed/fleet/layers/mpu/mp_layers.py``:
+``VocabParallelEmbedding:35``, ``ColumnParallelLinear:173``,
+``RowParallelLinear:332``, ``ParallelCrossEntropy:498`` and the PyLayer comm
+primitives in ``mp_ops.py``). TPU-native redesign: there are no explicit
+``_c_identity/_mp_allreduce`` collectives — each layer creates its weight
+with a PartitionSpec on the ``mp`` mesh axis and constrains its activations;
+GSPMD inserts the identity/allreduce/allgather exactly where the reference
+hand-places them (SURVEY.md §7 principle 3: "parallelism is sharding
+annotation, not program surgery").
+
+Sharding map (weights stored [in, out] like paddle):
+  ColumnParallelLinear   W: P(None, "mp")   y sharded on features
+  RowParallelLinear      W: P("mp", None)   contraction → psum by GSPMD
+  VocabParallelEmbedding W: P("mp", None)   vocab-sharded lookup
+  ParallelCrossEntropy   logits constrained P(..., "mp") — the vocab-
+                         parallel softmax-CE (ref c_softmax_with_cross_entropy)
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.param_attr import ParamAttr
+from ..mesh import get_mesh
+from ..sharding_api import shard_tensor, with_sharding_constraint
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy"]
+
+
+def _mp_axis(mesh):
+    for cand in ("mp", "model", "tp"):
+        if cand in mesh.axis_names:
+            return cand
+    raise ValueError(
+        f"mesh {mesh.axis_names} has no model-parallel axis "
+        "('mp'/'model'/'tp')")
+
+
+class ColumnParallelLinear(Layer):
+    """Output-feature-sharded linear (reference: mp_layers.py:173)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None, mesh=None):
+        super().__init__()
+        self._mesh = mesh or get_mesh()
+        self._axis = _mp_axis(self._mesh)
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = self._mesh.shape[self._axis] > 1
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        shard_tensor(self.weight, self._mesh, spec=P(None, self._axis))
+        # reference parity (mp_layers.py:282 "if has_bias:"): the default
+        # None is falsy — no bias unless explicitly requested
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], is_bias=True)
+            shard_tensor(self.bias, self._mesh, spec=P(self._axis))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        spec = (P(*([None] * (out.ndim - 1)))
+                if self.gather_output
+                else P(*([None] * (out.ndim - 1) + [self._axis])))
+        return with_sharding_constraint(out, spec, self._mesh)
+
+
+class RowParallelLinear(Layer):
+    """Input-feature-sharded linear (reference: mp_layers.py:332). The
+    contraction over the sharded dim yields partial sums; constraining the
+    output replicated makes GSPMD emit the mp allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None,
+                 mesh=None):
+        super().__init__()
+        self._mesh = mesh or get_mesh()
+        self._axis = _mp_axis(self._mesh)
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = self._mesh.shape[self._axis] > 1
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr)
+        shard_tensor(self.weight, self._mesh, spec=P(self._axis, None))
+        # bias is added after the reduction → replicated (reference adds it
+        # on the full output too)
+        self.bias = None if has_bias is False else self.create_parameter(
+            shape=[out_features], is_bias=True)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = with_sharding_constraint(
+                x, P(*([None] * (x.ndim - 1) + [self._axis])), self._mesh)
+        out = F.linear(x, self.weight, None)
+        out = with_sharding_constraint(
+            out, P(*([None] * out.ndim)), self._mesh)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab-sharded embedding table (reference: mp_layers.py:35). The
+    gather over a vocab-sharded table compiles to a masked-lookup + psum
+    (the reference's c_embedding kernel does the same by hand)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None, mesh=None):
+        super().__init__()
+        self._mesh = mesh or get_mesh()
+        self._axis = _mp_axis(self._mesh)
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal() if (
+                weight_attr is None or weight_attr.initializer is None)
+            else None)
+        shard_tensor(self.weight, self._mesh, spec=P(self._axis, None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return with_sharding_constraint(
+            out, P(*([None] * out.ndim)), self._mesh)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax cross-entropy (reference: mp_layers.py:498 →
+    ``c_softmax_with_cross_entropy``). Constraining the logits vocab-sharded
+    makes the log-softmax reductions compile into mp-axis collectives — the
+    full logits row is never replicated."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100,
+                 mesh=None):
+        super().__init__()
+        self._mesh = mesh or get_mesh()
+        self._axis = _mp_axis(self._mesh)
+        self._ignore_index = ignore_index
+
+    def forward(self, input, label):
+        logits = with_sharding_constraint(
+            input, P(*([None] * (input.ndim - 1) + [self._axis])),
+            self._mesh)
+        loss = F.cross_entropy(logits, label,
+                               ignore_index=self._ignore_index,
+                               reduction="none")
+        # reference keeps the label's trailing-1 dim (mp_ops.py:399)
+        from paddle_tpu import ops
+        return ops.unsqueeze(loss, -1)

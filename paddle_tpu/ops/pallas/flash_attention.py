@@ -298,6 +298,11 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None,
                          block_q=_DEF_BLOCK_Q, block_k=_DEF_BLOCK_K):
     """Flash attention on arrays in [B, H, S, D] (or [BH, S, D]) layout."""
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"flash attention requires matching q/k/v shapes, got "
+            f"{q.shape}/{k.shape}/{v.shape}; cross-attention with a "
+            "different key length is not supported by this kernel yet")
     squeeze = False
     if q.ndim == 4:
         b, h, s, d = q.shape
@@ -306,19 +311,22 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None,
         v = v.reshape(b * h, s, d)
         squeeze = (b, h)
     bh, s, d = q.shape
-    if k.shape != q.shape or v.shape != q.shape:
-        raise ValueError(
-            f"flash attention requires matching q/k/v shapes, got "
-            f"{q.shape}/{k.shape}/{v.shape}; cross-attention with a "
-            "different key length is not supported by this kernel yet")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if not _interpret() and block_q % _LANES and block_q != s:
         # the lse output block (1, block_q) must satisfy the TPU tile rule:
-        # last dim a multiple of 128 or equal to the array dim
-        block_q = (block_q // _LANES) * _LANES or s
+        # last dim a multiple of 128 or equal to the array dim — pick the
+        # largest lane-multiple that still divides the sequence
+        cands = [b for b in range(_LANES, min(block_q, s) + 1, _LANES)
+                 if s % b == 0]
+        if not cands:
+            raise ValueError(
+                f"no TPU-tileable query block for seq {s} with "
+                f"block_q<={block_q}; pad the sequence to a multiple "
+                f"of {_LANES}")
+        block_q = cands[-1]
     if s % block_q or s % block_k:
         raise ValueError(
             f"flash attention requires seq {s} divisible by block sizes "
