@@ -33,6 +33,7 @@ from paddle_tpu import autograd  # noqa: F401
 from paddle_tpu import regularizer  # noqa: F401
 from paddle_tpu import jit  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
+from paddle_tpu import amp  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr  # noqa: F401
 
 bool = bool_  # paddle.bool

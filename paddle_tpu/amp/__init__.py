@@ -1,0 +1,20 @@
+"""Automatic mixed precision (reference: ``python/paddle/amp/``).
+
+``auto_cast`` (reference ``auto_cast.py:296``) installs a thread-local policy
+consulted by the op dispatch layer (``core.autograd.apply_op``) — the analog
+of the reference's per-op ``EagerAmpAutoCasts`` in every generated forward
+(``eager/amp_utils.h``): white-list ops (matmul/conv — the MXU ops) cast to
+the low dtype, black-list ops (softmax/norm/exp/... numerically fragile
+reductions) cast to float32, everything else follows O1 (keep input dtype)
+or O2 (low dtype) semantics.
+
+``GradScaler`` (reference ``grad_scaler.py:581``) implements dynamic loss
+scaling for fp16 parity; on TPU bf16 is the bread-and-butter dtype and needs
+no scaling (the scaler passes through when disabled, as the reference does).
+"""
+from .auto_cast import (  # noqa: F401
+    auto_cast, amp_guard, amp_state, decorate, white_list, black_list,
+)
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler"]

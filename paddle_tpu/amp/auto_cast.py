@@ -1,0 +1,123 @@
+"""auto_cast — the autocast context (reference: ``amp/auto_cast.py:296``)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+__all__ = ["auto_cast", "amp_guard", "amp_state", "decorate", "white_list",
+           "black_list"]
+
+_tls = threading.local()
+
+# Reference O1 lists (python/paddle/amp/auto_cast.py WHITE_LIST/BLACK_LIST,
+# adapted to this framework's op names): white = MXU-bound ops that love low
+# precision; black = numerically fragile ops pinned to fp32.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "conv1d", "conv2d", "conv3d", "conv_nd",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "linear",
+    "einsum", "addmm", "mv", "flash_attention",
+    "scaled_dot_product_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "kl_div", "ctc_loss", "layer_norm", "rms_norm", "batch_norm",
+    "instance_norm", "group_norm", "local_response_norm", "mean", "sum",
+    "cumsum", "prod", "norm", "cosine_similarity", "erf", "erfinv",
+    "sigmoid_focal_loss", "smooth_l1_loss", "mse_loss", "l1_loss", "dist",
+    "logsumexp", "softplus",
+}
+
+
+def white_list() -> Set[str]:
+    return set(WHITE_LIST)
+
+
+def black_list() -> Set[str]:
+    return set(BLACK_LIST)
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level", "white", "black")
+
+    def __init__(self, enable, dtype, level, white, black):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.white = white
+        self.black = black
+
+
+def amp_state() -> Optional[_AmpState]:
+    return getattr(_tls, "amp", None)
+
+
+def _policy_dtype(state: _AmpState, op_name: str):
+    """Target dtype for an op under the active policy, or None (keep)."""
+    name = (op_name or "").lower()
+    if name in state.black:
+        return "float32"
+    if name in state.white:
+        return state.dtype
+    if state.level == "O2":
+        return state.dtype
+    return None  # O1 default: run in the inputs' dtype
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Reference: paddle.amp.auto_cast (auto_cast.py:296). ``dtype`` defaults
+    to bfloat16 — the TPU-native low precision (fp16 supported for parity)."""
+    if level not in ("O0", "O1", "O2", "OD"):
+        raise ValueError(f"unsupported amp level {level!r}")
+    if dtype not in ("bfloat16", "float16"):
+        raise ValueError(f"unsupported amp dtype {dtype!r}")
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= {str(n).lower() for n in custom_white_list}
+        black -= white
+    if custom_black_list:
+        black |= {str(n).lower() for n in custom_black_list}
+        white -= black
+    prev = amp_state()
+    _tls.amp = _AmpState(enable and level != "O0", dtype, level, white,
+                         black) if enable and level != "O0" else None
+    try:
+        yield
+    finally:
+        _tls.amp = prev
+
+
+amp_guard = auto_cast  # legacy name (fluid.dygraph.amp.amp_guard)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Reference: paddle.amp.decorate — O2 casts the model parameters to the
+    low dtype; optimizer master weights come from ``multi_precision`` (pass
+    master_weight=True to force it on)."""
+    import numpy as np
+    from paddle_tpu.core.dtype import convert_dtype
+
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        np_dtype = convert_dtype(dtype).np_dtype
+        for m in model_list:
+            for p in m.parameters():
+                if np.issubdtype(np.asarray(p.data).dtype, np.floating):
+                    p._data = p.data.astype(np_dtype)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        if master_weight or (master_weight is None and level == "O2"):
+            for o in opt_list:
+                o._multi_precision = True
+        if single_opt:
+            opt_list = opt_list[0]
+        return (model_list[0] if single_model else model_list), opt_list
+    return model_list[0] if single_model else model_list

@@ -1,0 +1,113 @@
+"""GradScaler — dynamic loss scaling (reference: ``amp/grad_scaler.py:581``;
+the unscale step mirrors the ``check_finite_and_unscale`` op at ``:806``)."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    is_use_dynamic_loss_scaling = is_enable
+
+    def scale(self, var: Tensor) -> Tensor:
+        """Multiply the loss by the current scale."""
+        if not self._enable:
+            return var
+        from paddle_tpu import ops
+        return ops.scale(var, self._scale)
+
+    def unscale_(self, optimizer):
+        """Divide grads by the scale in place; record nan/inf presence
+        (reference: grad_scaler.py:806 check_finite_and_unscale)."""
+        if not self._enable or self._unscaled:
+            return
+        import jax.numpy as jnp
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad.data * inv
+            if bool(jnp.any(~jnp.isfinite(g))):
+                found = True
+            p.grad = Tensor(g, stop_gradient=True)
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        """unscale + conditional optimizer step (skipped on nan/inf)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        """Adjust the scale after a step (reference update_loss_scaling)."""
+        if not self._enable or not self._use_dynamic:
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        """Reference parity: backward already ran on the scaled loss; this
+        unscales, steps, and updates."""
+        self.step(optimizer)
+        self.update()
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = float(sd.get("scale", self._scale))
+        self._good_steps = int(sd.get("good_steps", 0))
+        self._bad_steps = int(sd.get("bad_steps", 0))
+
+
+AmpScaler = GradScaler  # legacy fluid name

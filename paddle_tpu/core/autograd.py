@@ -133,6 +133,11 @@ def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
     t_inputs = [flat[i] for i in t_idx]
     arrays = [t.data for t in t_inputs]
 
+    # AMP autocast: the analog of the reference's per-op EagerAmpAutoCasts
+    # in every generated forward (eager/amp_utils.h) — cast floating inputs
+    # by the active policy before dispatch
+    arrays = _maybe_autocast(op_name or getattr(fn, "__name__", ""), arrays)
+
     def pure(*arrs):
         buf = list(flat)
         for i, a in zip(t_idx, arrs):
@@ -179,6 +184,41 @@ def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
             t._out_idx = i
         outs.append(t)
     return tuple(outs) if multi else outs[0]
+
+
+def _maybe_autocast(op_name, arrays):
+    try:
+        from paddle_tpu.amp.auto_cast import amp_state, _policy_dtype
+    except ImportError:
+        return arrays
+    state = amp_state()
+    if state is None or not state.enable:
+        return arrays
+    target = _policy_dtype(state, op_name)
+    if target is None:
+        return arrays
+    tgt = jnp.dtype({"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+                     "float32": jnp.float32}[target])
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != tgt:
+            out.append(a.astype(tgt))
+        else:
+            out.append(a)
+    return out
+
+
+def _coerce_cot(g, aval):
+    """Cast an accumulated cotangent to the forward output's dtype — under
+    AMP a bf16 op can receive an f32 cotangent from a downstream fp32 op
+    (the reference's GradTensorHolder performs the same cast)."""
+    _, dtype = aval
+    if hasattr(g, "dtype") and g.dtype != dtype and \
+            jnp.issubdtype(g.dtype, jnp.inexact) and \
+            jnp.issubdtype(dtype, jnp.inexact):
+        return g.astype(dtype)
+    return g
 
 
 def _zeros_like_aval(aval):
@@ -247,6 +287,14 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
         h[slot] = g if h[slot] is None else h[slot] + g
 
     def _accum_leaf(t, g):
+        # leaf grads carry the parameter's dtype (reference GradNodeAccum
+        # casts the same way) — under AMP a bf16-cast op otherwise writes
+        # bf16 grads for f32 params and accumulation loses mantissa bits
+        if hasattr(g, "dtype") and hasattr(t.data, "dtype") and \
+                g.dtype != t.data.dtype and \
+                jnp.issubdtype(g.dtype, jnp.inexact) and \
+                jnp.issubdtype(t.data.dtype, jnp.inexact):
+            g = g.astype(t.data.dtype)
         if id(t) in pending_leaf:
             g = pending_leaf[id(t)][1] + g
         pending_leaf[id(t)] = (t, g)
@@ -287,7 +335,8 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                 if tn is node and h[slot] is not None and grad_map is not None:
                     grad_map[tid] = h[slot]
         cots = tuple(
-            h[i] if h[i] is not None else _zeros_like_aval(node.out_avals[i])
+            _coerce_cot(h[i], node.out_avals[i])
+            if h[i] is not None else _zeros_like_aval(node.out_avals[i])
             for i in range(node.n_outputs))
         for hook in node.hooks:
             cots = hook(cots) or cots
@@ -377,5 +426,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
             raise RuntimeError(
                 "one of the input tensors received no gradient; pass "
                 "allow_unused=True to get None instead")
+        if g is not None and hasattr(g, "dtype") and \
+                g.dtype != t.data.dtype and \
+                jnp.issubdtype(g.dtype, jnp.inexact) and \
+                jnp.issubdtype(t.data.dtype, jnp.inexact):
+            g = g.astype(t.data.dtype)  # AMP: grads in the input's dtype
         results.append(Tensor(g, stop_gradient=True) if g is not None else None)
     return results

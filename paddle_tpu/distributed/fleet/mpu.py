@@ -22,6 +22,9 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
+_U = P.UNCONSTRAINED  # leave batch dims to the partitioner (None would
+                      # force replication and all-gather a dp-sharded batch)
+
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn import initializer as I
 from paddle_tpu.nn.layer_base import Layer
@@ -56,6 +59,8 @@ class ColumnParallelLinear(Layer):
         self.gather_output = gather_output
         self.is_mp = self._mesh.shape[self._axis] > 1
         weight_attr = ParamAttr._to_attr(weight_attr)
+        if weight_attr is False:
+            raise ValueError("weight_attr=False: the weight is mandatory")
         self.weight = self.create_parameter(
             shape=[in_features, out_features], attr=weight_attr)
         shard_tensor(self.weight, self._mesh, spec=P(None, self._axis))
@@ -70,9 +75,9 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
-        spec = (P(*([None] * (out.ndim - 1)))
+        spec = (P(*([_U] * (out.ndim - 1) + [None]))
                 if self.gather_output
-                else P(*([None] * (out.ndim - 1) + [self._axis])))
+                else P(*([_U] * (out.ndim - 1) + [self._axis])))
         return with_sharding_constraint(out, spec, self._mesh)
 
 
@@ -93,6 +98,8 @@ class RowParallelLinear(Layer):
         self.input_is_parallel = input_is_parallel
         self.is_mp = self._mesh.shape[self._axis] > 1
         weight_attr = ParamAttr._to_attr(weight_attr)
+        if weight_attr is False:
+            raise ValueError("weight_attr=False: the weight is mandatory")
         self.weight = self.create_parameter(
             shape=[in_features, out_features], attr=weight_attr)
         shard_tensor(self.weight, self._mesh, spec=P(self._axis, None))
@@ -104,10 +111,10 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         if self.input_is_parallel:
             x = with_sharding_constraint(
-                x, P(*([None] * (x.ndim - 1) + [self._axis])), self._mesh)
+                x, P(*([_U] * (x.ndim - 1) + [self._axis])), self._mesh)
         out = F.linear(x, self.weight, None)
         out = with_sharding_constraint(
-            out, P(*([None] * out.ndim)), self._mesh)
+            out, P(*([_U] * (out.ndim - 1) + [None])), self._mesh)
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -124,6 +131,9 @@ class VocabParallelEmbedding(Layer):
         self._mesh = mesh or get_mesh()
         self._axis = _mp_axis(self._mesh)
         weight_attr = ParamAttr._to_attr(weight_attr)
+        if weight_attr is False:
+            raise ValueError("weight_attr=False: the embedding table is "
+                             "mandatory")
         self.weight = self.create_parameter(
             shape=[num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.XavierNormal() if (
@@ -134,7 +144,7 @@ class VocabParallelEmbedding(Layer):
     def forward(self, x):
         out = F.embedding(x, self.weight)
         return with_sharding_constraint(
-            out, P(*([None] * out.ndim)), self._mesh)
+            out, P(*([_U] * (out.ndim - 1) + [None])), self._mesh)
 
 
 class ParallelCrossEntropy(Layer):
@@ -152,7 +162,7 @@ class ParallelCrossEntropy(Layer):
 
     def forward(self, input, label):
         logits = with_sharding_constraint(
-            input, P(*([None] * (input.ndim - 1) + [self._axis])),
+            input, P(*([_U] * (input.ndim - 1) + [self._axis])),
             self._mesh)
         loss = F.cross_entropy(logits, label,
                                ignore_index=self._ignore_index,

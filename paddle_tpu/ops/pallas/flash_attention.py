@@ -321,12 +321,13 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None,
         # largest lane-multiple that still divides the sequence
         cands = [b for b in range(_LANES, min(block_q, s) + 1, _LANES)
                  if s % b == 0]
-        if not cands:
-            raise ValueError(
-                f"no TPU-tileable query block for seq {s} with "
-                f"block_q<={block_q}; pad the sequence to a multiple "
-                f"of {_LANES}")
-        block_q = cands[-1]
+        if cands:
+            block_q = cands[-1]  # largest lane-multiple <= requested
+        else:
+            # requested block too small to tile: smallest valid block above
+            # it, falling back to the whole sequence (always a legal tile)
+            bigger = [b for b in range(_LANES, s, _LANES) if s % b == 0]
+            block_q = bigger[0] if bigger else s
     if s % block_q or s % block_k:
         raise ValueError(
             f"flash attention requires seq {s} divisible by block sizes "

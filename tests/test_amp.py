@@ -1,0 +1,173 @@
+"""AMP tests: autocast policy routing, O1/O2 semantics, GradScaler dynamics,
+and a bf16 transformer step training within tolerance of fp32."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import amp
+
+
+def t(x, dtype=np.float32):
+    return pt.to_tensor(np.asarray(x, dtype=dtype))
+
+
+class TestAutoCast:
+    def test_white_op_runs_low_precision(self):
+        a = t(np.random.RandomState(0).randn(4, 4))
+        with amp.auto_cast(dtype="bfloat16"):
+            out = pt.matmul(a, a)
+        assert out.dtype.name == "bfloat16"
+
+    def test_black_op_stays_fp32(self):
+        a = t(np.random.RandomState(0).randn(4, 4))
+        with amp.auto_cast(dtype="bfloat16"):
+            out = pt.nn.functional.softmax(a)
+        assert out.dtype.name == "float32"
+
+    def test_o1_other_ops_keep_dtype(self):
+        a = t(np.random.RandomState(0).randn(4, 4))
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = a + a
+        assert out.dtype.name == "float32"
+
+    def test_o2_other_ops_cast(self):
+        a = t(np.random.RandomState(0).randn(4, 4))
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            out = a + a
+        assert out.dtype.name == "bfloat16"
+
+    def test_disabled_and_nested_restore(self):
+        a = t(np.random.RandomState(0).randn(4, 4))
+        with amp.auto_cast(enable=False):
+            assert pt.matmul(a, a).dtype.name == "float32"
+        with amp.auto_cast(dtype="bfloat16"):
+            with amp.auto_cast(enable=False):
+                assert pt.matmul(a, a).dtype.name == "float32"
+            assert pt.matmul(a, a).dtype.name == "bfloat16"
+        assert pt.matmul(a, a).dtype.name == "float32"
+
+    def test_custom_lists(self):
+        a = t(np.random.RandomState(0).randn(4, 4))
+        with amp.auto_cast(custom_black_list={"matmul"}, dtype="bfloat16"):
+            assert pt.matmul(a, a).dtype.name == "float32"
+        with amp.auto_cast(custom_white_list={"softmax"}, dtype="bfloat16"):
+            assert nn.functional.softmax(a).dtype.name == "bfloat16"
+
+    def test_decorate_o2_casts_params(self):
+        m = nn.Linear(4, 4)
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        m2, o2 = amp.decorate(m, o, level="O2", dtype="bfloat16")
+        assert str(m2.weight.data.dtype) == "bfloat16"
+        assert o2._multi_precision
+
+
+class TestGradScaler:
+    def _param(self):
+        p = pt.Parameter(np.ones((2, 2), np.float32))
+        return p
+
+    def test_scale_and_step(self):
+        p = self._param()
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=8.0)
+        loss = (p * t(np.ones((2, 2)))).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        # grads are 8x
+        np.testing.assert_allclose(p.grad.numpy(), 8 * np.ones((2, 2)))
+        scaler.step(o)
+        scaler.update()
+        # effective update used the unscaled grad
+        np.testing.assert_allclose(p.numpy(), 1.0 - 0.1, rtol=1e-6)
+
+    def test_inf_skips_step_and_decreases_scale(self):
+        p = self._param()
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=8.0, decr_ratio=0.5)
+        p.grad = pt.to_tensor(np.array([[np.inf, 1], [1, 1]], np.float32))
+        before = p.numpy().copy()
+        scaler.step(o)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), before)  # step skipped
+        assert scaler.get_loss_scaling() == 4.0
+
+    def test_scale_grows_after_n_good_steps(self):
+        p = self._param()
+        o = opt.SGD(learning_rate=0.0, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=2.0, incr_ratio=2.0,
+                                incr_every_n_steps=2)
+        for _ in range(2):
+            p.grad = pt.to_tensor(np.ones((2, 2), np.float32))
+            scaler.step(o)
+            scaler.update()
+        assert scaler.get_loss_scaling() == 4.0
+
+    def test_disabled_passthrough(self):
+        p = self._param()
+        o = opt.SGD(learning_rate=0.1, parameters=[p])
+        scaler = amp.GradScaler(enable=False)
+        loss = (p * t(np.ones((2, 2)))).sum()
+        assert scaler.scale(loss) is loss
+        loss.backward()
+        scaler.step(o)
+        np.testing.assert_allclose(p.numpy(), 0.9, rtol=1e-6)
+
+    def test_state_roundtrip(self):
+        s1 = amp.GradScaler(init_loss_scaling=4.0)
+        s1._good_steps = 7
+        s2 = amp.GradScaler()
+        s2.load_state_dict(s1.state_dict())
+        assert s2.get_loss_scaling() == 4.0 and s2._good_steps == 7
+
+
+class TestEndToEnd:
+    def test_bf16_training_tracks_fp32(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 16).astype(np.float32)
+        Y = X @ rng.randn(16, 4).astype(np.float32)
+
+        def run(use_amp):
+            pt.seed(5)
+            m = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                              nn.Linear(32, 4))
+            o = opt.AdamW(learning_rate=0.01, parameters=m.parameters())
+            losses = []
+            for _ in range(30):
+                if use_amp:
+                    with amp.auto_cast(dtype="bfloat16"):
+                        loss = nn.MSELoss()(m(t(X)), t(Y))
+                else:
+                    loss = nn.MSELoss()(m(t(X)), t(Y))
+                loss.backward()
+                o.step()
+                o.clear_grad()
+                losses.append(float(loss.numpy()))
+            return losses
+
+        base = run(False)
+        mixed = run(True)
+        assert mixed[-1] < base[0] * 0.1  # converges
+        # within a few percent of the fp32 trajectory at the end
+        assert abs(mixed[-1] - base[-1]) / base[0] < 0.05
+
+    def test_fp16_scaler_loop(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(32, 8).astype(np.float32)
+        Y = X @ rng.randn(8, 2).astype(np.float32)
+        pt.seed(2)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        o = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                         parameters=m.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=1024.0)
+        losses = []
+        for _ in range(60):
+            with amp.auto_cast(dtype="float16"):
+                loss = nn.MSELoss()(m(t(X)), t(Y))
+            scaler.scale(loss).backward()
+            scaler.step(o)
+            scaler.update()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.2
