@@ -34,6 +34,9 @@ from paddle_tpu import regularizer  # noqa: F401
 from paddle_tpu import jit  # noqa: F401
 from paddle_tpu import distributed  # noqa: F401
 from paddle_tpu import amp  # noqa: F401
+from paddle_tpu import io  # noqa: F401
+from paddle_tpu import framework  # noqa: F401
+from paddle_tpu.framework.io import save, load  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr  # noqa: F401
 
 bool = bool_  # paddle.bool

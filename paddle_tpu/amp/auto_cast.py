@@ -100,7 +100,7 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     """Reference: paddle.amp.decorate — O2 casts the model parameters to the
     low dtype; optimizer master weights come from ``multi_precision`` (pass
     master_weight=True to force it on)."""
-    import numpy as np
+    import jax.numpy as jnp
     from paddle_tpu.core.dtype import convert_dtype
 
     single_model = not isinstance(models, (list, tuple))
@@ -109,7 +109,9 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
         np_dtype = convert_dtype(dtype).np_dtype
         for m in model_list:
             for p in m.parameters():
-                if np.issubdtype(np.asarray(p.data).dtype, np.floating):
+                # dtype check on device metadata (no host transfer); covers
+                # bf16/fp16 re-decoration via jnp's floating hierarchy
+                if jnp.issubdtype(p.data.dtype, jnp.floating):
                     p._data = p.data.astype(np_dtype)
     if optimizers is not None:
         single_opt = not isinstance(optimizers, (list, tuple))

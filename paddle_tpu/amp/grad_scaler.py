@@ -28,7 +28,8 @@ class GradScaler:
     def is_enable(self) -> bool:
         return self._enable
 
-    is_use_dynamic_loss_scaling = is_enable
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._use_dynamic
 
     def scale(self, var: Tensor) -> Tensor:
         """Multiply the loss by the current scale."""
@@ -44,15 +45,16 @@ class GradScaler:
             return
         import jax.numpy as jnp
         inv = 1.0 / self._scale
-        found = False
+        # accumulate one found-inf scalar on device; a single host sync at
+        # the end instead of one blocking round-trip per parameter
+        found = jnp.zeros((), bool)
         for p in optimizer._parameter_list:
             if p.grad is None:
                 continue
             g = p.grad.data * inv
-            if bool(jnp.any(~jnp.isfinite(g))):
-                found = True
+            found = found | jnp.any(~jnp.isfinite(g))
             p.grad = Tensor(g, stop_gradient=True)
-        self._found_inf = found
+        self._found_inf = bool(found)
         self._unscaled = True
 
     def step(self, optimizer):
@@ -106,6 +108,12 @@ class GradScaler:
 
     def load_state_dict(self, sd):
         self._scale = float(sd.get("scale", self._scale))
+        self._incr_ratio = float(sd.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = float(sd.get("decr_ratio", self._decr_ratio))
+        self._incr_every_n_steps = int(
+            sd.get("incr_every_n_steps", self._incr_every_n_steps))
+        self._decr_every_n_nan_or_inf = int(
+            sd.get("decr_every_n_nan_or_inf", self._decr_every_n_nan_or_inf))
         self._good_steps = int(sd.get("good_steps", 0))
         self._bad_steps = int(sd.get("bad_steps", 0))
 

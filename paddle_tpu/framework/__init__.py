@@ -1,0 +1,2 @@
+"""paddle.framework parity namespace."""
+from .io import save, load  # noqa: F401

@@ -1,0 +1,89 @@
+"""Checkpoint save/load (reference: ``python/paddle/framework/io.py``:
+``save:639`` / ``load:881`` — pickle-format nested state with Tensor→ndarray
+conversion).
+
+TPU notes: arrays are pulled to host as numpy before pickling (device→host
+DMA batched by jax); on load, values come back as Tensors whose storage is
+host-committed — ``set_state_dict``/``set_value`` moves them onto the mesh
+placement of the receiving parameter. Sharded-state resharding on load (the
+reference's auto_parallel Converter) falls out of that: a checkpoint saved
+under one mesh loads under any other because saved values are full logical
+arrays.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_PROTOCOL_MIN, _PROTOCOL_MAX = 2, 4
+
+
+def _to_host(obj):
+    """Tensor → tagged numpy payload; containers walked recursively."""
+    from paddle_tpu.core.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return {"@tensor": np.asarray(obj.data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if hasattr(obj, "dtype") and hasattr(obj, "shape") and \
+            not isinstance(obj, np.ndarray):  # bare jax arrays
+        return {"@tensor": np.asarray(obj), "stop_gradient": True,
+                "name": ""}
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_to_host(v) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def _from_host(obj):
+    from paddle_tpu.core.tensor import Tensor
+    if isinstance(obj, dict):
+        if "@tensor" in obj:
+            t = Tensor(np.asarray(obj["@tensor"]),
+                       stop_gradient=obj.get("stop_gradient", True),
+                       name=obj.get("name", ""))
+            return t
+        return {k: _from_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_from_host(v) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    """paddle.save parity: pickle a (possibly nested) object with Tensors.
+
+    Multi-host: only process 0 writes (the reference guards the same way
+    in its distributed save helpers).
+    """
+    if not (_PROTOCOL_MIN <= protocol <= _PROTOCOL_MAX):
+        raise ValueError(
+            f"pickle protocol must be in [{_PROTOCOL_MIN}, "
+            f"{_PROTOCOL_MAX}], got {protocol}")
+    import jax
+    if jax.process_index() != 0:
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = _to_host(obj)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+    os.replace(tmp, path)  # atomic publish — no torn checkpoints
+
+
+def load(path: str, **configs) -> Any:
+    """paddle.load parity: read a checkpoint written by :func:`save`."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"checkpoint {path!r} does not exist")
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _from_host(payload)

@@ -1,0 +1,10 @@
+"""paddle.io parity namespace (reference: ``python/paddle/io/``)."""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ChainDataset, ComposeDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,185 @@
+"""Samplers (reference: ``python/paddle/io/`` BatchSampler /
+DistributedBatchSampler in ``fluid/dataloader/batch_sampler.py``)."""
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+__all__ = ["Sampler", "SequenceSampler", "RandomSampler",
+           "WeightedRandomSampler", "BatchSampler",
+           "DistributedBatchSampler", "SubsetRandomSampler"]
+
+
+def _rng(generator):
+    """Resolve paddle's generator argument into a numpy RNG."""
+    if generator is None:
+        return np.random
+    if hasattr(generator, "permutation"):  # np.random.Generator/RandomState
+        return generator
+    if isinstance(generator, (int, np.integer)):
+        return np.random.RandomState(int(generator))
+    if hasattr(generator, "seed"):  # paddle_tpu Generator
+        return np.random.RandomState(generator.seed())
+    return np.random
+
+
+def _chunked(iterable, batch_size, drop_last):
+    """Shared accumulate-and-flush batching loop."""
+    batch = []
+    for item in iterable:
+        batch.append(item)
+        if len(batch) == batch_size:
+            yield batch
+            batch = []
+    if batch and not drop_last:
+        yield batch
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = _rng(self.generator)
+        if self.replacement:
+            if hasattr(rng, "integers"):  # np.random.Generator API
+                return iter(rng.integers(0, n, self.num_samples).tolist())
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        perm = rng.permutation(n)[:self.num_samples]
+        return iter(perm.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    def __init__(self, indices, generator=None):
+        super().__init__(None)
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        return iter(_rng(self.generator).permutation(self.indices).tolist())
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        super().__init__(None)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+        if not replacement and num_samples > len(self.weights):
+            raise ValueError("cannot draw more samples than weights "
+                             "without replacement")
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Reference: paddle.io.BatchSampler — wraps a dataset or sampler."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        super().__init__(dataset)
+        if (dataset is None) == (sampler is None):
+            raise ValueError("pass exactly one of dataset / sampler")
+        if sampler is not None:
+            self.sampler = sampler
+        else:
+            self.sampler = RandomSampler(dataset) if shuffle \
+                else SequenceSampler(dataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        yield from _chunked(self.sampler, self.batch_size, self.drop_last)
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Rank-sliced batches (reference: batch_sampler.py
+    DistributedBatchSampler). Under single-controller SPMD one process
+    usually feeds the global batch; this sampler exists for the multi-host
+    case where each host loads its shard (num_replicas = host count)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        import jax
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None \
+            else jax.process_count()
+        self.local_rank = rank if rank is not None else jax.process_index()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(
+            math.ceil(len(dataset) / self.nranks)) if not drop_last else \
+            len(dataset) // self.nranks
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            indices = np.random.RandomState(
+                self.epoch).permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        if not self.drop_last:
+            indices += indices[: self.total_size - len(indices)]
+        else:
+            indices = indices[: self.total_size]
+        # contiguous per-rank slice (reference semantics)
+        indices = indices[self.local_rank * self.num_samples:
+                          (self.local_rank + 1) * self.num_samples]
+        yield from _chunked(indices, self.batch_size, self.drop_last)
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch

@@ -1,0 +1,302 @@
+"""paddle.io + checkpoint tests: datasets, samplers, DataLoader collation /
+prefetch / workers, save->load->resume reproducing the loss trajectory."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.io as io
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+class RangeSquares(io.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        x = np.float32([i])
+        return x, x * x
+
+
+class CountStream(io.IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32([i])
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        a = np.arange(12, dtype=np.float32).reshape(6, 2)
+        b = np.arange(6, dtype=np.int64)
+        ds = io.TensorDataset([a, b])
+        assert len(ds) == 6
+        x, y = ds[3]
+        np.testing.assert_allclose(x, a[3])
+        assert y == 3
+
+    def test_concat_and_subset(self):
+        d1, d2 = RangeSquares(3), RangeSquares(4)
+        cat = io.ConcatDataset([d1, d2])
+        assert len(cat) == 7
+        np.testing.assert_allclose(cat[5][0], [2.0])  # item 2 of d2
+        sub = io.Subset(d1, [2, 0])
+        assert len(sub) == 2
+        np.testing.assert_allclose(sub[0][0], [2.0])
+
+    def test_compose(self):
+        ds = io.ComposeDataset([RangeSquares(4), RangeSquares(4)])
+        item = ds[1]
+        assert len(item) == 4
+
+    def test_random_split(self):
+        parts = io.random_split(RangeSquares(10), [7, 3])
+        assert [len(p) for p in parts] == [7, 3]
+        all_idx = sorted(parts[0].indices + parts[1].indices)
+        assert all_idx == list(range(10))
+
+    def test_random_split_fractions(self):
+        parts = io.random_split(RangeSquares(10), [0.5, 0.5])
+        assert sorted(len(p) for p in parts) == [5, 5]
+
+
+class TestSamplers:
+    def test_sequence_and_random(self):
+        ds = RangeSquares(8)
+        assert list(io.SequenceSampler(ds)) == list(range(8))
+        got = list(io.RandomSampler(ds))
+        assert sorted(got) == list(range(8))
+
+    def test_batch_sampler(self):
+        bs = io.BatchSampler(RangeSquares(10), batch_size=3)
+        batches = list(bs)
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+        assert len(bs) == 4
+        bs2 = io.BatchSampler(RangeSquares(10), batch_size=3, drop_last=True)
+        assert len(list(bs2)) == 3 == len(bs2)
+
+    def test_weighted(self):
+        w = [0.0, 0.0, 1.0]
+        s = io.WeightedRandomSampler(w, 20)
+        assert set(s) == {2}
+
+    def test_distributed_batch_sampler_partitions(self):
+        ds = RangeSquares(16)
+        seen = []
+        for rank in range(4):
+            s = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                           rank=rank)
+            for b in s:
+                seen.extend(b)
+        assert sorted(seen) == list(range(16))
+
+    def test_distributed_sampler_pads_uneven(self):
+        ds = RangeSquares(10)
+        total = sum(len(list(io.DistributedBatchSampler(
+            ds, batch_size=2, num_replicas=4, rank=r))) for r in range(4))
+        # ceil(10/4)=3 samples per rank → 2 batches each
+        assert total == 8
+
+
+class TestDataLoader:
+    def test_basic_collation(self):
+        dl = io.DataLoader(RangeSquares(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == (4, 1)
+        np.testing.assert_allclose(y[:, 0], x[:, 0] ** 2)
+
+    def test_shuffle_covers_all(self):
+        dl = io.DataLoader(RangeSquares(12), batch_size=4, shuffle=True)
+        seen = np.concatenate([b[0][:, 0] for b in dl])
+        assert sorted(seen.tolist()) == list(range(12))
+
+    def test_iterable_dataset(self):
+        dl = io.DataLoader(CountStream(7), batch_size=3)
+        batches = list(dl)
+        assert [b.shape[0] for b in batches] == [3, 3, 1]
+
+    def test_iterable_drop_last(self):
+        dl = io.DataLoader(CountStream(7), batch_size=3, drop_last=True)
+        assert [b.shape[0] for b in dl] == [3, 3]
+
+    def test_num_workers_same_result(self):
+        d0 = list(io.DataLoader(RangeSquares(20), batch_size=5))
+        d4 = list(io.DataLoader(RangeSquares(20), batch_size=5,
+                                num_workers=4))
+        for (x0, y0), (x4, y4) in zip(d0, d4):
+            np.testing.assert_allclose(x0, x4)
+            np.testing.assert_allclose(y0, y4)
+
+    def test_worker_exception_propagates(self):
+        class Bad(io.Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                if i == 2:
+                    raise RuntimeError("boom")
+                return np.float32([i])
+
+        with pytest.raises(RuntimeError, match="boom"):
+            list(io.DataLoader(Bad(), batch_size=2))
+
+    def test_dict_collation(self):
+        class D(io.Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return {"x": np.float32([i]), "y": np.int64(i)}
+
+        batch = next(iter(io.DataLoader(D(), batch_size=4)))
+        assert set(batch) == {"x", "y"}
+        assert batch["x"].shape == (4, 1)
+
+    def test_custom_batch_sampler(self):
+        bs = io.BatchSampler(sampler=io.SequenceSampler(RangeSquares(6)),
+                             batch_size=2)
+        dl = io.DataLoader(RangeSquares(6), batch_sampler=bs)
+        assert len(list(dl)) == 3
+
+    def test_feeds_training_loop(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 4).astype(np.float32)
+        Y = X @ rng.randn(4, 1).astype(np.float32)
+        dl = io.DataLoader(io.TensorDataset([X, Y]), batch_size=16,
+                           shuffle=True, num_workers=2)
+        m = nn.Linear(4, 1)
+        o = opt.Adam(learning_rate=0.05, parameters=m.parameters())
+        epoch_means = []
+        for epoch in range(12):
+            losses = []
+            for xb, yb in dl:
+                loss = nn.MSELoss()(m(pt.to_tensor(xb)), pt.to_tensor(yb))
+                loss.backward()
+                o.step()
+                o.clear_grad()
+                losses.append(float(loss.numpy()))
+            epoch_means.append(np.mean(losses))
+        assert epoch_means[-1] < epoch_means[0] * 0.05, epoch_means
+
+
+class TestCheckpoint:
+    def test_save_load_state_dict(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        path = str(tmp_path / "model.pdparams")
+        pt.save(m.state_dict(), path)
+        loaded = pt.load(path)
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        missing, unexpected = m2.set_state_dict(loaded)
+        assert not missing and not unexpected
+        x = pt.to_tensor(np.random.RandomState(0).randn(3, 4).astype(
+            np.float32))
+        np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_save_load_nested_python(self, tmp_path):
+        obj = {"step": 7, "names": ["a", "b"],
+               "tensor": pt.to_tensor([1.0, 2.0]),
+               "nested": {"lr": 0.1}}
+        path = str(tmp_path / "misc.pdopt")
+        pt.save(obj, path)
+        back = pt.load(path)
+        assert back["step"] == 7 and back["nested"]["lr"] == 0.1
+        np.testing.assert_allclose(back["tensor"].numpy(), [1.0, 2.0])
+
+    def test_resume_reproduces_trajectory(self, tmp_path):
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 8).astype(np.float32)
+        Y = X @ rng.randn(8, 2).astype(np.float32)
+
+        def make():
+            pt.seed(4)
+            m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+            o = opt.AdamW(learning_rate=0.01, parameters=m.parameters())
+            return m, o
+
+        def step(m, o):
+            loss = nn.MSELoss()(m(pt.to_tensor(X)), pt.to_tensor(Y))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            return float(loss.numpy())
+
+        # run A: 6 steps straight
+        mA, oA = make()
+        traj_a = [step(mA, oA) for _ in range(6)]
+
+        # run B: 3 steps, checkpoint, fresh objects, resume, 3 more
+        mB, oB = make()
+        traj_b = [step(mB, oB) for _ in range(3)]
+        pt.save(mB.state_dict(), str(tmp_path / "m.pdparams"))
+        pt.save(oB.state_dict(), str(tmp_path / "o.pdopt"))
+
+        mC, oC = make()
+        mC.set_state_dict(pt.load(str(tmp_path / "m.pdparams")))
+        oC.set_state_dict(pt.load(str(tmp_path / "o.pdopt")))
+        traj_b += [step(mC, oC) for _ in range(3)]
+
+        np.testing.assert_allclose(traj_b, traj_a, rtol=1e-5)
+
+    def test_atomic_write_no_partial(self, tmp_path):
+        path = str(tmp_path / "x.pdparams")
+        pt.save({"a": pt.to_tensor([1.0])}, path)
+        assert os.path.exists(path)
+        assert not os.path.exists(path + ".tmp")
+
+    def test_load_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            pt.load(str(tmp_path / "nope.pdparams"))
+
+
+class TestReviewRegressions:
+    def test_early_break_no_deadlock(self):
+        # consumer abandons iteration; producer must unblock and exit
+        import threading
+        before = threading.active_count()
+        for _ in range(5):
+            for batch in io.DataLoader(RangeSquares(64), batch_size=2,
+                                       prefetch_factor=2):
+                break
+        import time
+        time.sleep(0.5)  # let producers observe stop and exit
+        assert threading.active_count() <= before + 1
+
+    def test_batch_size_none_unstacked(self):
+        class Pre(io.Dataset):
+            def __len__(self):
+                return 3
+
+            def __getitem__(self, i):
+                return np.zeros((5, 2), np.float32)
+
+        items = list(io.DataLoader(Pre(), batch_size=None))
+        assert items[0].shape == (5, 2)  # no spurious leading dim
+
+    def test_generator_reproducible(self):
+        ds = RangeSquares(16)
+        g1 = np.random.default_rng(42)
+        g2 = np.random.default_rng(42)
+        s1 = list(io.RandomSampler(ds, generator=g1))
+        s2 = list(io.RandomSampler(ds, generator=g2))
+        assert s1 == s2
+        p1 = [p.indices for p in io.random_split(ds, [8, 8], generator=7)]
+        p2 = [p.indices for p in io.random_split(ds, [8, 8], generator=7)]
+        assert p1 == p2
+
+    def test_scaler_flag_and_state_fields(self):
+        from paddle_tpu import amp
+        s = amp.GradScaler(enable=True, use_dynamic_loss_scaling=False)
+        assert s.is_use_dynamic_loss_scaling() is False
+        s1 = amp.GradScaler(incr_ratio=4.0, incr_every_n_steps=500)
+        s2 = amp.GradScaler()
+        s2.load_state_dict(s1.state_dict())
+        assert s2._incr_ratio == 4.0 and s2._incr_every_n_steps == 500
