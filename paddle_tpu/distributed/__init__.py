@@ -25,3 +25,7 @@ from .topology import (  # noqa: F401
 )
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model,
+)

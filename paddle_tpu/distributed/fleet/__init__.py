@@ -13,6 +13,16 @@ from ..mesh import get_mesh
 from ..parallel import DataParallel
 from ..topology import CommunicateTopology, HybridCommunicateGroup
 from . import mpu  # noqa: F401
+from . import pipeline  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import MoELayer, NaiveGate, SwitchGate, GShardGate  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    ring_attention, ulysses_attention, scatter_sequence, gather_sequence,
+)
+from .pipeline import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, PipelineLayer, PipelineParallel,
+)
 from .mpu import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,
@@ -22,7 +32,7 @@ __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
            "worker_num", "worker_index", "mpu", "ColumnParallelLinear",
            "RowParallelLinear", "VocabParallelEmbedding",
-           "ParallelCrossEntropy"]
+           "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel", "MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ring_attention", "ulysses_attention", "scatter_sequence", "gather_sequence"]
 
 _state = {"hcg": None, "strategy": None}
 

@@ -1,0 +1,148 @@
+"""Sequence/context parallelism + ring attention.
+
+Greenfield capability (SURVEY.md §5: the reference snapshot has NO sequence
+parallelism — no ring attention, no Ulysses; SURVEY.md §7 directs designing
+it GSPMD-natively for the Llama long-context north star).
+
+Design: activations shard the *sequence* dim on the ``sp`` mesh axis. For
+attention — the one op that mixes sequence positions — K/V shards rotate
+around the ring with ``lax.ppermute`` (one ICI hop per step) while each
+rank's resident Q block folds the incoming block into an online-softmax
+accumulator. Peak memory per rank is O((S/n)^2) scores and the K/V transfer
+overlaps the block matmuls (async ICI DMA), which is exactly the RingAttention
+schedule. Causal masking skips rotations that are entirely in the future.
+
+``ulysses_attention`` offers the all-to-all alternative (head-scatter):
+re-shard [B, S/n, H, D] -> [B, S, H/n, D], run any attention (the Pallas
+flash kernel on chip), and shard back — two all-to-alls on ICI.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+from ..mesh import get_mesh
+from ..sharding_api import with_sharding_constraint
+
+__all__ = ["ring_attention", "ulysses_attention", "scatter_sequence",
+           "gather_sequence"]
+
+
+def scatter_sequence(x: Tensor, mesh=None, axis: str = "sp",
+                     seq_dim: int = 1) -> Tensor:
+    """Annotate the sequence dim sharded on the sp axis."""
+    mesh = mesh or get_mesh()
+    spec = [P.UNCONSTRAINED] * x.ndim
+    spec[seq_dim] = axis
+    return with_sharding_constraint(x, P(*spec), mesh)
+
+
+def gather_sequence(x: Tensor, mesh=None, seq_dim: int = 1) -> Tensor:
+    """Constrain the sequence dim replicated (an all-gather over sp)."""
+    mesh = mesh or get_mesh()
+    spec = [P.UNCONSTRAINED] * x.ndim
+    spec[seq_dim] = None
+    return with_sharding_constraint(x, P(*spec), mesh)
+
+
+def _ring_attention_arrays(q, k, v, mesh, axis, causal, sm_scale):
+    """Pure-array ring attention over a seq-sharded [B, S, H, D] triple."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mesh.shape[axis]
+
+    def per_rank(ql, kl, vl):
+        # local shards [B, Sq, H, D]
+        b, sq, h, d = ql.shape
+        rank = jax.lax.axis_index(axis)
+        qt = jnp.swapaxes(ql, 1, 2).astype(jnp.float32)  # [B, H, Sq, D]
+        scale = sm_scale
+
+        def step(r, carry):
+            m, l, acc, kc, vc = carry
+            kt = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)
+            vt = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+            src = (rank - r) % n  # origin rank of the current K/V block
+            if causal:
+                q_pos = rank * sq + jnp.arange(sq)
+                k_pos = src * sq + jnp.arange(sq)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                s = jnp.where(mask[None, None], s, -jnp.inf)
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, m_cur)
+            # guard fully-masked rows (exp(-inf - -inf))
+            safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+            p = jnp.exp(s - safe_m[..., None])
+            p = jnp.where(jnp.isneginf(m_new)[..., None], 0.0, p)
+            alpha = jnp.where(jnp.isneginf(m), 0.0,
+                              jnp.exp(m - safe_m))
+            l_new = l * alpha + p.sum(axis=-1)
+            acc_new = acc * alpha[..., None] + \
+                jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return m_new, l_new, acc_new, kc, vc
+
+        m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, h, sq), jnp.float32)
+        a0 = jnp.zeros((b, h, sq, d), jnp.float32)
+        # mark the replicated initializers device-varying so the scan carry
+        # type matches the rank-dependent outputs (shard_map vma rule)
+        try:
+            m0, l0, a0 = (jax.lax.pcast(x, to="varying")
+                          for x in (m0, l0, a0))
+        except (AttributeError, TypeError):
+            m0, l0, a0 = (jax.lax.pvary(x, axis) for x in (m0, l0, a0))
+        m, l, acc, _, _ = jax.lax.fori_loop(0, n, step,
+                                            (m0, l0, a0, kl, vl))
+        out = acc / jnp.maximum(l, 1e-20)[..., None]
+        return jnp.swapaxes(out, 1, 2).astype(ql.dtype)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def ring_attention(query, key, value, mesh=None, axis: str = "sp",
+                   causal: bool = False, sm_scale: Optional[float] = None):
+    """Ring attention over a sequence-sharded [B, S, H, D] triple
+    (Tensor-in/Tensor-out, taped)."""
+    mesh = mesh or get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        raise RuntimeError(f"ring_attention needs a mesh with axis {axis!r}")
+    if sm_scale is None:
+        d = query.shape[-1]
+        sm_scale = 1.0 / math.sqrt(d)
+    return apply_op(
+        lambda q, k, v: _ring_attention_arrays(q, k, v, mesh, axis, causal,
+                                               sm_scale),
+        query, key, value, op_name="ring_attention")
+
+
+def ulysses_attention(query, key, value, mesh=None, axis: str = "sp",
+                      causal: bool = False):
+    """Ulysses/DeepSpeed-style SP: all-to-all heads<->sequence so each rank
+    holds full sequences for a head subset, then ordinary attention."""
+    from paddle_tpu.nn import functional as F
+    mesh = mesh or get_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        raise RuntimeError(
+            f"ulysses_attention needs a mesh with axis {axis!r}")
+    # re-shard: seq-sharded -> head-sharded (GSPMD emits the all-to-all)
+    head_spec = P(None, None, axis, None)
+
+    def reshard(t, spec):
+        return with_sharding_constraint(t, spec, mesh)
+
+    q = reshard(query, head_spec)
+    k = reshard(key, head_spec)
+    v = reshard(value, head_spec)
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=causal)
+    return reshard(out, P(None, axis, None, None))

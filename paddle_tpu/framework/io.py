@@ -12,7 +12,6 @@ arrays.
 """
 from __future__ import annotations
 
-import io as _io
 import os
 import pickle
 from typing import Any
@@ -30,8 +29,9 @@ def _to_host(obj):
     if isinstance(obj, Tensor):
         return {"@tensor": np.asarray(obj.data),
                 "stop_gradient": obj.stop_gradient, "name": obj.name}
-    if hasattr(obj, "dtype") and hasattr(obj, "shape") and \
-            not isinstance(obj, np.ndarray):  # bare jax arrays
+    if isinstance(obj, (np.generic, np.ndarray)):
+        return obj  # numpy scalars/arrays pickle as themselves
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):  # bare jax arrays
         return {"@tensor": np.asarray(obj), "stop_gradient": True,
                 "name": ""}
     if isinstance(obj, dict):

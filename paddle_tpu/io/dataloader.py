@@ -19,7 +19,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from .dataset import Dataset, IterableDataset
-from .sampler import BatchSampler, SequenceSampler, RandomSampler
+from .sampler import BatchSampler, RandomSampler, SequenceSampler
 
 __all__ = ["DataLoader", "default_collate_fn"]
 
@@ -117,19 +117,22 @@ class DataLoader:
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
-            self.batch_sampler = BatchSampler(
-                dataset, shuffle=shuffle,
-                batch_size=batch_size if batch_size is not None else 1,
-                drop_last=drop_last)
             if batch_size is None:
                 self.batch_sampler = None  # un-batched mode
+                self._unbatched_sampler = RandomSampler(dataset) if shuffle \
+                    else SequenceSampler(dataset)
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
 
     # -- iteration paths -------------------------------------------------------
     def _iter_map_style(self):
         ds, collate = self.dataset, self.collate_fn
         if self.batch_sampler is None:
-            # batch_size=None: deliver samples un-stacked (paddle contract)
-            for i in range(len(ds)):
+            # batch_size=None: deliver samples un-stacked (paddle contract),
+            # honoring shuffle via the un-batched sampler
+            for i in self._unbatched_sampler:
                 yield ds[i]
             return
         if self.num_workers <= 1:

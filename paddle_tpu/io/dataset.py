@@ -123,6 +123,9 @@ def random_split(dataset: Dataset, lengths: Sequence,
     lengths = list(lengths)
     if all(0 < f < 1 for f in lengths if isinstance(f, float)) and \
             any(isinstance(f, float) for f in lengths):
+        if abs(sum(lengths) - 1.0) > 1e-6:
+            raise ValueError(
+                f"split fractions must sum to 1, got {sum(lengths)}")
         sizes = [int(np.floor(n * f)) for f in lengths]
         for i in range(n - sum(sizes)):
             sizes[i % len(sizes)] += 1

@@ -20,7 +20,13 @@ def _rng(generator):
         return generator
     if isinstance(generator, (int, np.integer)):
         return np.random.RandomState(int(generator))
-    if hasattr(generator, "seed"):  # paddle_tpu Generator
+    if hasattr(generator, "seed") and hasattr(generator, "_count"):
+        # paddle_tpu Generator: advance its counter so successive epochs
+        # draw different (but seed-deterministic) orderings
+        seed = (generator.seed() + generator._count) % (2 ** 31)
+        generator._count += 1
+        return np.random.RandomState(seed)
+    if hasattr(generator, "seed"):
         return np.random.RandomState(generator.seed())
     return np.random
 
@@ -168,7 +174,8 @@ class DistributedBatchSampler(BatchSampler):
         else:
             indices = list(range(n))
         if not self.drop_last:
-            indices += indices[: self.total_size - len(indices)]
+            while len(indices) < self.total_size:  # datasets < shortfall
+                indices += indices[: self.total_size - len(indices)]
         else:
             indices = indices[: self.total_size]
         # contiguous per-rank slice (reference semantics)

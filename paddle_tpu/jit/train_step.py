@@ -184,14 +184,29 @@ class TrainStep:
         train_sh = {n: param_spec(n) for n in train}
         frozen_sh = {n: param_spec(n) for n in frozen}
         buf_sh = {n: rep for n in buffers}
+        # ZeRO stage 1/2: group_sharded_parallel marks the optimizer to
+        # shard its accumulators even when the params stay replicated
+        zero_axis = getattr(self._opt, "_shard_states_axis", None)
+        zero_n = mesh.shape.get(zero_axis, 1) if zero_axis in \
+            getattr(mesh, "axis_names", ()) else 1
         states_sh = {}
         for n in train:
             p = self._params[n]
             st = self._opt._ensure_state(p)
-            states_sh[n] = {
-                k: (param_spec(n) if getattr(v, "shape", None) ==
-                    p.data.shape else rep)
-                for k, v in st.items()}
+            pspec = getattr(p, "_sharding_spec", None)
+            sh = {}
+            for k, v in st.items():
+                shape = getattr(v, "shape", None)
+                if shape != p.data.shape:
+                    sh[k] = rep
+                elif pspec is not None:
+                    sh[k] = ns(pspec)
+                elif zero_n > 1 and shape and shape[0] % zero_n == 0:
+                    sh[k] = ns(PartitionSpec(
+                        zero_axis, *([None] * (len(shape) - 1))))
+                else:
+                    sh[k] = rep
+            states_sh[n] = sh
         in_spec = self._input_spec
         if in_spec is None and "dp" in mesh.axis_names:
             in_spec = PartitionSpec("dp")
