@@ -37,6 +37,11 @@ from paddle_tpu import amp  # noqa: F401
 from paddle_tpu import io  # noqa: F401
 from paddle_tpu import framework  # noqa: F401
 from paddle_tpu.framework.io import save, load  # noqa: F401
+from paddle_tpu import metric  # noqa: F401
+from paddle_tpu import hapi  # noqa: F401
+from paddle_tpu.hapi import Model  # noqa: F401
+from paddle_tpu import static  # noqa: F401
+from paddle_tpu import profiler  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr  # noqa: F401
 
 bool = bool_  # paddle.bool
@@ -72,8 +77,9 @@ def set_device(device: str) -> str:
 
 def enable_static():
     raise NotImplementedError(
-        "global static mode is replaced by paddle_tpu.jit.to_static / "
-        "paddle_tpu.static program capture")
+        "global static mode is replaced by trace-based capture: decorate "
+        "with paddle_tpu.jit.to_static, export with paddle_tpu.jit.save "
+        "(paddle_tpu.static keeps InputSpec)")
 
 
 def disable_static():

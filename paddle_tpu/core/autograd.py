@@ -147,10 +147,18 @@ def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
 
     requires = is_grad_enabled() and any(not t.stop_gradient for t in t_inputs)
 
-    if requires:
-        out, vjp_fn = jax.vjp(pure, *arrays)
-    else:
-        out = pure(*arrays)
+    # profiler instrumentation (reference: RecordEvent in every generated
+    # forward, add_n_fwd_func.cc:27); None — and zero overhead — unless a
+    # Profiler is actively recording
+    _prof_ev = _record_op_event(op_name or getattr(fn, "__name__", "op"))
+    try:
+        if requires:
+            out, vjp_fn = jax.vjp(pure, *arrays)
+        else:
+            out = pure(*arrays)
+    finally:
+        if _prof_ev is not None:
+            _prof_ev.end()
 
     multi = isinstance(out, (tuple, list))
     out_arrays = list(out) if multi else [out]
@@ -184,6 +192,14 @@ def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
             t._out_idx = i
         outs.append(t)
     return tuple(outs) if multi else outs[0]
+
+
+def _record_op_event(name):
+    try:
+        from paddle_tpu.profiler import record_op
+    except ImportError:
+        return None
+    return record_op(name)
 
 
 def _maybe_autocast(op_name, arrays):

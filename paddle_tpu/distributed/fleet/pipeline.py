@@ -40,7 +40,7 @@ class LayerDesc:
         self.args = args
         self.kwargs = kwargs
 
-    def build(self) -> Layer:
+    def build(self, registry=None) -> Layer:
         return self.layer_cls(*self.args, **self.kwargs)
 
 
@@ -51,17 +51,19 @@ class SharedLayerDesc(LayerDesc):
     automatically (same tape leaf), replacing the reference's explicit
     allreduce over the shared-weight group."""
 
-    _registry = {}
-
     def __init__(self, key, layer_cls, *args, forward_func=None, **kwargs):
         super().__init__(layer_cls, *args, **kwargs)
         self.key = key
         self.forward_func = forward_func
 
-    def build(self) -> Layer:
-        if self.key not in SharedLayerDesc._registry:
-            SharedLayerDesc._registry[self.key] = super().build()
-        return SharedLayerDesc._registry[self.key]
+    def build(self, registry=None) -> Layer:
+        # the registry is scoped to one PipelineLayer build — two models
+        # built in the same process must never silently share weights
+        if registry is None:
+            registry = {}
+        if self.key not in registry:
+            registry[self.key] = super().build(registry)
+        return registry[self.key]
 
 
 class PipelineLayer(Layer):
@@ -107,9 +109,10 @@ class PipelineLayer(Layer):
 
         # materialize layers and segment uniformly
         built: List[Layer] = []
+        shared_registry: dict = {}
         for item in layers:
             if isinstance(item, LayerDesc):
-                built.append(item.build())
+                built.append(item.build(shared_registry))
             elif isinstance(item, Layer):
                 built.append(item)
             else:

@@ -65,30 +65,43 @@ def _ring_attention_arrays(q, k, v, mesh, axis, causal, sm_scale):
 
         def step(r, carry):
             m, l, acc, kc, vc = carry
-            kt = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)
-            vt = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
-            s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
             src = (rank - r) % n  # origin rank of the current K/V block
+
+            def compute(m, l, acc):
+                kt = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)
+                vt = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
+                s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+                if causal:
+                    q_pos = rank * sq + jnp.arange(sq)
+                    k_pos = src * sq + jnp.arange(sq)
+                    mask = q_pos[:, None] >= k_pos[None, :]
+                    s = jnp.where(mask[None, None], s, -jnp.inf)
+                m_cur = jnp.max(s, axis=-1)
+                m_new = jnp.maximum(m, m_cur)
+                # guard fully-masked rows (exp(-inf - -inf))
+                safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+                p = jnp.exp(s - safe_m[..., None])
+                p = jnp.where(jnp.isneginf(m_new)[..., None], 0.0, p)
+                alpha = jnp.where(jnp.isneginf(m), 0.0,
+                                  jnp.exp(m - safe_m))
+                l_new = l * alpha + p.sum(axis=-1)
+                acc_new = acc * alpha[..., None] + \
+                    jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+                return m_new, l_new, acc_new
+
             if causal:
-                q_pos = rank * sq + jnp.arange(sq)
-                k_pos = src * sq + jnp.arange(sq)
-                mask = q_pos[:, None] >= k_pos[None, :]
-                s = jnp.where(mask[None, None], s, -jnp.inf)
-            m_cur = jnp.max(s, axis=-1)
-            m_new = jnp.maximum(m, m_cur)
-            # guard fully-masked rows (exp(-inf - -inf))
-            safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-            p = jnp.exp(s - safe_m[..., None])
-            p = jnp.where(jnp.isneginf(m_new)[..., None], 0.0, p)
-            alpha = jnp.where(jnp.isneginf(m), 0.0,
-                              jnp.exp(m - safe_m))
-            l_new = l * alpha + p.sum(axis=-1)
-            acc_new = acc * alpha[..., None] + \
-                jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+                # blocks entirely in the future (src > rank) skip the
+                # matmuls — the ring still rotates so later steps see the
+                # right K/V
+                m, l, acc = jax.lax.cond(
+                    src <= rank, compute, lambda m_, l_, a_: (m_, l_, a_),
+                    m, l, acc)
+            else:
+                m, l, acc = compute(m, l, acc)
             perm = [(i, (i + 1) % n) for i in range(n)]
             kc = jax.lax.ppermute(kc, axis, perm)
             vc = jax.lax.ppermute(vc, axis, perm)
-            return m_new, l_new, acc_new, kc, vc
+            return m, l, acc, kc, vc
 
         m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((b, h, sq), jnp.float32)

@@ -1,0 +1,323 @@
+"""hapi Model — the Keras-style high-level API.
+
+Parity with the reference's ``python/paddle/hapi/model.py`` (``Model.fit:1036``,
+``evaluate``, ``predict``, ``prepare``, ``save``/``load``; callbacks in
+``hapi/callbacks.py``). The train step runs through ``jit.TrainStep`` so
+hapi users get the compiled hot path for free — the analog of the
+reference's dygraph/static adapter pair collapsing into one compiled mode.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler"]
+
+
+class Callback:
+    """Reference: hapi/callbacks.py Callback."""
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
+                              else f"{k}: {v}"
+                              for k, v in (logs or {}).items())
+            print(f"step {step} - {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = ", ".join(f"{k}: {v:.4f}" if isinstance(v, float)
+                              else f"{k}: {v}"
+                              for k, v in (logs or {}).items())
+            print(f"epoch {epoch} - {items}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir="checkpoint"):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch % self.save_freq == 0:
+            import os
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="min", patience=0,
+                 min_delta=0.0, baseline=None, save_best_model=False):
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = baseline
+        self.wait = 0
+        self.stopped = False
+
+    def _better(self, cur, best):
+        if best is None:
+            return True
+        return cur < best - self.min_delta if self.mode == "min" \
+            else cur > best + self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped = True
+                self.model._stop_training = True
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from paddle_tpu.optimizer.lr import LRScheduler as S
+        lr = getattr(self.model._optimizer, "_lr", None)
+        return lr if isinstance(lr, S) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if s is not None and self.by_step:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if s is not None and self.by_epoch:
+            s.step()
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+class Model:
+    """Reference: hapi/model.py Model (fit:1036 / evaluate:1731)."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List = []
+        self._train_step = None
+        self._stop_training = False
+
+    # -- setup ----------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        else:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        if optimizer is not None and loss is not None:
+            import paddle_tpu as pt
+
+            def loss_fn(net, x, y):
+                return self._loss(net(x), y)
+            self._train_step = pt.jit.TrainStep(self.network, loss_fn,
+                                                optimizer)
+        return self
+
+    # -- core steps -----------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        x = _as_tensor(inputs[0] if isinstance(inputs, (list, tuple))
+                       else inputs)
+        y = _as_tensor(labels[0] if isinstance(labels, (list, tuple))
+                       else labels)
+        loss = self._train_step(x, y)
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        from paddle_tpu.core.autograd import no_grad
+        x = _as_tensor(inputs[0] if isinstance(inputs, (list, tuple))
+                       else inputs)
+        y = _as_tensor(labels[0] if isinstance(labels, (list, tuple))
+                       else labels)
+        with no_grad():
+            out = self.network(x)
+            loss = self._loss(out, y) if self._loss else None
+        for m in self._metrics:
+            res = m.compute(out, y)
+            if not isinstance(res, tuple):
+                res = (res,)
+            m.update(*res)
+        return [float(loss.numpy())] if loss is not None else []
+
+    def predict_batch(self, inputs):
+        from paddle_tpu.core.autograd import no_grad
+        x = _as_tensor(inputs[0] if isinstance(inputs, (list, tuple))
+                       else inputs)
+        with no_grad():
+            out = self.network(x)
+        return [out.numpy()]
+
+    # -- loops ----------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._to_loader(train_data, batch_size, shuffle, drop_last,
+                                 num_workers)
+        callbacks = list(callbacks or [])
+        if verbose:
+            callbacks.append(ProgBarLogger(log_freq, verbose))
+        for cb in callbacks:
+            cb.set_model(self)
+        self._stop_training = False
+        history = {"loss": []}
+        for cb in callbacks:
+            cb.on_train_begin()
+        step = 0
+        for epoch in range(epochs):
+            for cb in callbacks:
+                cb.on_epoch_begin(epoch)
+            self.network.train()
+            epoch_losses = []
+            for batch in loader:
+                x, y = batch[0], batch[1]
+                loss = self.train_batch(x, y)[0]
+                epoch_losses.append(loss)
+                step += 1
+                logs = {"loss": loss}
+                for cb in callbacks:
+                    cb.on_train_batch_end(step, logs)
+                if num_iters is not None and step >= num_iters:
+                    break
+            logs = {"loss": float(np.mean(epoch_losses))}
+            history["loss"].append(logs["loss"])
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, batch_size,
+                                          verbose=0,
+                                          num_workers=num_workers)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            for cb in callbacks:
+                cb.on_epoch_end(epoch, logs)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                import os
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self._stop_training or (num_iters is not None and
+                                       step >= num_iters):
+                break
+        for cb in callbacks:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            res = self.eval_batch(batch[0], batch[1])
+            if res:
+                losses.append(res[0])
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, list):
+                logs.update(dict(zip(names, vals)))
+            else:
+                logs[names] = vals
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=0):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        self.network.eval()
+        outs = [self.predict_batch(b[0] if isinstance(b, (tuple, list))
+                                   else b)[0] for b in loader]
+        if stack_outputs:
+            return [np.concatenate(outs, axis=0)]
+        return [outs]
+
+    # -- persistence / introspection ------------------------------------------
+    def save(self, path, training=True):
+        from paddle_tpu.framework.io import save
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from paddle_tpu.framework.io import load
+        self.network.set_state_dict(load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(int(np.prod(p.shape)) for p in
+                    self.network.parameters())
+        trainable = sum(int(np.prod(p.shape)) for p in
+                        self.network.parameters() if not p.stop_gradient)
+        lines = [repr(self.network),
+                 f"Total params: {total:,}",
+                 f"Trainable params: {trainable:,}"]
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total, "trainable_params": trainable}
+
+    @staticmethod
+    def _to_loader(data, batch_size, shuffle, drop_last, num_workers):
+        from paddle_tpu.io import DataLoader, Dataset
+        if data is None:
+            raise ValueError("data must not be None")
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # assume an iterable of batches

@@ -8,6 +8,7 @@ from .functional import (  # noqa: F401
     functional_call, functional_state, swap_state,
 )
 from .train_step import TrainStep  # noqa: F401
+from .serialization import save, load, TranslatedLayer  # noqa: F401
 
 __all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module",
-           "functional_call", "functional_state", "swap_state", "TrainStep"]
+           "functional_call", "functional_state", "swap_state", "TrainStep", "save", "load", "TranslatedLayer"]

@@ -117,7 +117,9 @@ class StaticFunction:
         flat_args, _ = jax.tree_util.tree_flatten(
             _unwrap((args, kwargs)))
         rng_key = _gen.next_key()
-        out_arrays, out_bufs = compiled(state, rng_key, flat_args)
+        from paddle_tpu.profiler import RecordEvent
+        with RecordEvent(f"to_static:{getattr(self, '__name__', 'fn')}"):
+            out_arrays, out_bufs = compiled(state, rng_key, flat_args)
         if self._layer is not None and out_bufs:
             # write updated running stats back into the layer (concrete now)
             named = dict(self._layer.named_buffers())

@@ -1,0 +1,144 @@
+"""jit.save / jit.load — inference export as portable StableHLO.
+
+Parity with the reference's deployment seam (``python/paddle/jit/api.py:774
+save`` / ``:1255 load`` writing ``.pdmodel``/``.pdiparams``;
+``translated_layer.py`` re-loading as a Layer). TPU-native form: the traced
+forward is serialized with ``jax.export`` (versioned StableHLO — the AOT
+artifact SURVEY.md §2.10 item 17 calls for), parameters are baked into the
+exported computation, and a sibling ``.pdiparams`` keeps the state_dict for
+re-training / fine-tune loads. ``jit.load`` returns a ``TranslatedLayer``
+whose forward calls the deserialized executable.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _specs_to_avals(input_spec):
+    import jax
+    from jax import export as jax_export
+    from paddle_tpu.static import InputSpec
+
+    # dynamic (None/-1) dims export as shared symbolic dimensions so the
+    # loaded artifact accepts any batch size (the reference's -1 dims)
+    scope = jax_export.SymbolicScope()
+    sym_cache = {}
+
+    def dims_of(shape):
+        # dynamic dims at the same axis position share one symbol: multi-
+        # input models (features + labels) keep an equal batch dimension
+        out = []
+        for i, s in enumerate(shape):
+            if s in (-1, None):
+                if i not in sym_cache:
+                    sym_cache[i] = jax_export.symbolic_shape(
+                        f"_dyn_ax{i}", scope=scope)[0]
+                out.append(sym_cache[i])
+            else:
+                out.append(int(s))
+        return tuple(out)
+
+    avals = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            avals.append(jax.ShapeDtypeStruct(dims_of(spec.shape),
+                                              spec.dtype.np_dtype))
+        elif isinstance(spec, Tensor):
+            avals.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                              spec.data.dtype))
+        elif hasattr(spec, "shape") and hasattr(spec, "dtype"):
+            avals.append(jax.ShapeDtypeStruct(dims_of(spec.shape),
+                                              spec.dtype))
+        else:
+            raise TypeError(f"unsupported input spec {spec!r}")
+    return avals
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
+    """Export ``layer`` (or a to_static-wrapped function) for inference.
+
+    Writes ``<path>.pdmodel`` (serialized StableHLO artifact) and, for
+    Layers, ``<path>.pdiparams`` (state_dict) — the reference's file pair.
+    """
+    import jax
+    from jax import export as jax_export
+    from paddle_tpu.core.autograd import no_grad
+    from .functional import functional_state, swap_state
+
+    if input_spec is None:
+        raise ValueError(
+            "input_spec is required: pass InputSpecs or example Tensors")
+    avals = _specs_to_avals(input_spec)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+    if isinstance(layer, Layer):
+        prev_modes = [(l, l.training)
+                      for l in layer.sublayers(include_self=True)]
+        layer.eval()
+        train, frozen, buffers = functional_state(layer)
+        state = {**train, **frozen, **buffers}
+
+        def fn(*args):
+            with no_grad(), swap_state(layer, state,
+                                       collect_buffers=False):
+                out = layer(*[Tensor(a) for a in args])
+            return jax.tree_util.tree_map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        from paddle_tpu.framework.io import save as save_state
+        save_state(layer.state_dict(), path + ".pdiparams")
+    else:
+        fn = layer  # a function over Tensors
+
+        def fn(*args):  # noqa: F811
+            with no_grad():
+                out = layer(*[Tensor(a) for a in args])
+            return jax.tree_util.tree_map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+    try:
+        exported = jax_export.export(jax.jit(fn))(*avals)
+    finally:
+        if isinstance(layer, Layer):
+            for l, mode in prev_modes:  # export must not flip train mode
+                l.training = mode
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    return path
+
+
+class TranslatedLayer(Layer):
+    """Reference: ``translated_layer.py`` — a loaded inference artifact
+    presented as a Layer."""
+
+    def __init__(self, exported):
+        super().__init__()
+        self._exported = exported
+
+    def forward(self, *args):
+        arrays = [a.data if isinstance(a, Tensor) else np.asarray(a)
+                  for a in args]
+        out = self._exported.call(*arrays)
+        return Tensor(out) if not isinstance(out, (tuple, list)) else \
+            tuple(Tensor(o) for o in out)
+
+
+def load(path: str) -> TranslatedLayer:
+    """Load a ``jit.save`` artifact as a callable Layer."""
+    from jax import export as jax_export
+    model_path = path + ".pdmodel" if not path.endswith(".pdmodel") else path
+    with open(model_path, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    return TranslatedLayer(exported)

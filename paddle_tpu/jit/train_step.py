@@ -274,9 +274,11 @@ class TrainStep:
         flat_batch, _ = jax.tree_util.tree_flatten(_unwrap((args, kwargs)))
         rng_key = _gen.next_key()
 
-        loss_val, new_train, new_states, new_bufs = compiled(
-            train, frozen, buffers, states, self._group_lrs(), rng_key,
-            flat_batch)
+        from paddle_tpu.profiler import RecordEvent
+        with RecordEvent("TrainStep"):  # one host span per compiled step
+            loss_val, new_train, new_states, new_bufs = compiled(
+                train, frozen, buffers, states, self._group_lrs(), rng_key,
+                flat_batch)
 
         # write back (storage replacement — same semantics as eager step())
         opt._step_count += 1

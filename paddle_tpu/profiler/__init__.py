@@ -1,0 +1,231 @@
+"""paddle.profiler parity — host tracer + chrome-trace export.
+
+Reference three-layer design (SURVEY.md §5): RecordEvent instrumentation at
+every op (``platform/profiler/event_tracing.h``), tracers collecting into an
+event store (``host_tracer.cc``/``cuda_tracer.cc``), chrome-trace/summary
+sinks (``chrometracing_logger.cc``, ``profiler_statistic.py``).
+
+TPU mapping: the host side is rebuilt here (op dispatch emits RecordEvents
+when a Profiler is active — zero overhead otherwise); the device side
+delegates to jax.profiler's XPlane capture (libtpu's tracer — the CUPTI
+analog), written next to the host trace for TensorBoard/xprof.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "load_profiler_result"]
+
+_state = {"active": None}
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    CUSTOM_DEVICE = "custom_device"
+    TPU = "tpu"
+
+
+class _Event:
+    __slots__ = ("name", "start", "end", "tid", "args")
+
+    def __init__(self, name, start, end, tid, args=None):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+        self.args = args
+
+
+class RecordEvent:
+    """RAII host span (reference: ``paddle.profiler.RecordEvent``). Usable
+    as context manager or begin()/end() pair; no-op when no profiler runs."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def begin(self):
+        if _state["active"] is not None:
+            self._t0 = time.perf_counter_ns()
+
+    def end(self):
+        prof = _state["active"]
+        if prof is not None and self._t0 is not None:
+            prof._events.append(_Event(
+                self.name, self._t0, time.perf_counter_ns(),
+                threading.get_ident()))
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def record_op(name: str):
+    """Fast-path hook for the op dispatcher: returns a live RecordEvent or
+    None when profiling is off."""
+    if _state["active"] is None:
+        return None
+    ev = RecordEvent(name)
+    ev.begin()
+    return ev
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int],
+                                                                     str]:
+    """Reference: profiler.py:117 make_scheduler state machine
+    (CLOSED/READY/RECORD cycling)."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> str:
+        if step < skip_first:
+            return "closed"
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return "closed"
+        pos = s % period
+        if pos < closed:
+            return "closed"
+        if pos < closed + ready:
+            return "ready"
+        return "record"
+    return schedule
+
+
+class Profiler:
+    """Reference: ``python/paddle/profiler/profiler.py:344``."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False):
+        self._targets = targets or [ProfilerTarget.CPU]
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._events: List[_Event] = []
+        self._step = 0
+        self._recording = False
+        self._device_trace_dir: Optional[str] = None
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        self._step = 0
+        self._apply_state()
+        return self
+
+    def stop(self):
+        self._stop_recording()
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        return self
+
+    def step(self, num_samples=None):
+        self._step += 1
+        self._apply_state()
+
+    def _apply_state(self):
+        state = "record" if self._scheduler is None \
+            else self._scheduler(self._step)
+        if state == "record" and not self._recording:
+            self._start_recording()
+        elif state != "record" and self._recording:
+            self._stop_recording()
+
+    def _start_recording(self):
+        self._recording = True
+        _state["active"] = self
+        if ProfilerTarget.TPU in self._targets or \
+                ProfilerTarget.GPU in self._targets:
+            try:
+                import jax
+                self._device_trace_dir = os.environ.get(
+                    "PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+                jax.profiler.start_trace(self._device_trace_dir)
+            except Exception:
+                self._device_trace_dir = None
+
+    def _stop_recording(self):
+        if not self._recording:
+            return
+        self._recording = False
+        if _state["active"] is self:
+            _state["active"] = None
+        if self._device_trace_dir is not None:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_trace_dir = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- sinks ----------------------------------------------------------------
+    def export_chrome_tracing(self, dir_name: str,
+                              worker_name: Optional[str] = None) -> str:
+        os.makedirs(dir_name, exist_ok=True)
+        path = os.path.join(
+            dir_name, f"{worker_name or 'host'}.pb.trace.json")
+        events = []
+        for e in self._events:
+            events.append({
+                "name": e.name, "ph": "X", "cat": "op",
+                "ts": e.start / 1000.0,  # chrome wants microseconds
+                "dur": (e.end - e.start) / 1000.0,
+                "pid": 0, "tid": e.tid,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+    def summary(self, sorted_by="total", op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregated per-op table (reference: profiler_statistic.py)."""
+        agg = {}
+        for e in self._events:
+            tot, cnt, mx = agg.get(e.name, (0, 0, 0))
+            dur = e.end - e.start
+            agg[e.name] = (tot + dur, cnt + 1, max(mx, dur))
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+        unit = {"ms": 1e6, "us": 1e3, "s": 1e9}[time_unit]
+        lines = [f"{'name':<40}{'calls':>8}{'total':>12}{'max':>12}"
+                 f"{'avg':>12}  ({time_unit})"]
+        for name, (tot, cnt, mx) in rows:
+            lines.append(f"{name[:39]:<40}{cnt:>8}{tot / unit:>12.3f}"
+                         f"{mx / unit:>12.3f}{tot / cnt / unit:>12.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return rows
+
+    @property
+    def events(self):
+        return list(self._events)
+
+
+def export_chrome_tracing(dir_name: str, worker_name=None):
+    """Reference: profiler.py:215 — returns an on_trace_ready callback."""
+    def handler(prof: Profiler):
+        prof.export_chrome_tracing(dir_name, worker_name)
+    return handler
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,52 @@
+"""paddle.static parity surface.
+
+The reference's static graph stack (Program/Block IR + executors, SURVEY.md
+§2.3) collapses into trace-based capture here (SURVEY.md §7: the CINN seam →
+XLA): ``paddle_tpu.jit.to_static`` is the Program builder, XLA the executor.
+This module keeps the pieces user code actually touches: ``InputSpec`` and
+the inference-model save/load entry points.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from paddle_tpu.core.dtype import convert_dtype
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+class InputSpec:
+    """Reference: ``python/paddle/static/input.py`` InputSpec."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None, stop_gradient: bool = True):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def to_shape_dtype_struct(self, batch: int = 1):
+        import jax
+        shape = tuple(batch if s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype.np_dtype)
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, "
+                f"dtype={self.dtype.name}, name={self.name})")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "program-based save_inference_model has no analog; use "
+        "paddle_tpu.jit.save(layer, path, input_spec=[...]) which exports "
+        "a compiled StableHLO artifact")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load(path) to load a jit.save artifact")

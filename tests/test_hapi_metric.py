@@ -1,0 +1,126 @@
+"""hapi Model + paddle.metric tests: fit/evaluate/predict lifecycle, metric
+math vs sklearn-style numpy oracles, callbacks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.io as io
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import metric
+
+
+class TestMetrics:
+    def test_accuracy_top1(self):
+        m = metric.Accuracy()
+        pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], np.float32)
+        label = np.array([1, 0, 0])
+        m.update(m.compute(pt.to_tensor(pred), pt.to_tensor(label)))
+        assert abs(m.accumulate() - 2 / 3) < 1e-6
+
+    def test_accuracy_topk(self):
+        m = metric.Accuracy(topk=(1, 2))
+        pred = np.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]], np.float32)
+        label = np.array([1, 1])
+        m.update(m.compute(pt.to_tensor(pred), pt.to_tensor(label)))
+        acc = m.accumulate()
+        assert abs(acc[0] - 0.0) < 1e-6 and abs(acc[1] - 1.0) < 1e-6
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_precision_recall(self):
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p = metric.Precision()
+        p.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6  # tp=2 fp=1
+        r = metric.Recall()
+        r.update(preds, labels)
+        assert abs(r.accumulate() - 2 / 3) < 1e-6  # tp=2 fn=1
+
+    def test_auc_perfect_and_random(self):
+        auc = metric.Auc()
+        preds = np.array([0.9, 0.8, 0.2, 0.1])
+        labels = np.array([1, 1, 0, 0])
+        auc.update(preds, labels)
+        assert auc.accumulate() > 0.99
+        auc.reset()
+        auc.update(np.array([0.5, 0.5, 0.5, 0.5]), labels)
+        assert abs(auc.accumulate() - 0.5) < 0.01
+
+    def test_auc_matches_numpy_rank_oracle(self):
+        rng = np.random.RandomState(0)
+        preds = rng.rand(500)
+        labels = (rng.rand(500) < preds).astype(np.int64)  # informative
+        auc = metric.Auc()
+        auc.update(preds, labels)
+        # rank-based AUC oracle
+        pos = preds[labels == 1]
+        neg = preds[labels == 0]
+        oracle = (pos[:, None] > neg[None, :]).mean() + \
+            0.5 * (pos[:, None] == neg[None, :]).mean()
+        assert abs(auc.accumulate() - oracle) < 0.01
+
+
+class TestHapiModel:
+    def _dataset(self, n=128):
+        rng = np.random.RandomState(0)
+        X = rng.randn(n, 8).astype(np.float32)
+        y = (X.sum(-1) > 0).astype(np.int64)
+        return io.TensorDataset([X, y])
+
+    def _model(self):
+        pt.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+        m = pt.Model(net)
+        m.prepare(optimizer=opt.AdamW(learning_rate=0.01,
+                                      parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(),
+                  metrics=metric.Accuracy())
+        return m
+
+    def test_fit_evaluate_predict(self, capsys):
+        m = self._model()
+        hist = m.fit(self._dataset(), batch_size=32, epochs=8, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0] * 0.5
+        logs = m.evaluate(self._dataset(), batch_size=32, verbose=0)
+        assert logs["acc"] > 0.9
+        out = m.predict(self._dataset(), batch_size=32,
+                        stack_outputs=True)[0]
+        assert out.shape == (128, 2)
+
+    def test_eval_during_fit(self):
+        m = self._model()
+        hist = m.fit(self._dataset(), eval_data=self._dataset(64),
+                     batch_size=32, epochs=2, verbose=0)
+        assert len(hist["loss"]) == 2
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = self._model()
+        m.fit(self._dataset(), batch_size=32, epochs=1, verbose=0)
+        m.save(str(tmp_path / "ck"))
+        m2 = self._model()
+        m2.load(str(tmp_path / "ck"))
+        x = np.zeros((4, 8), np.float32)
+        np.testing.assert_allclose(
+            m.network(pt.to_tensor(x)).numpy(),
+            m2.network(pt.to_tensor(x)).numpy(), rtol=1e-6)
+
+    def test_early_stopping(self):
+        m = self._model()
+        es = pt.hapi.EarlyStopping(monitor="loss", patience=0,
+                                   baseline=-1.0)  # nothing beats -1
+        hist = m.fit(self._dataset(), batch_size=32, epochs=10, verbose=0,
+                     callbacks=[es])
+        assert len(hist["loss"]) < 10  # stopped early
+
+    def test_summary(self, capsys):
+        m = self._model()
+        info = m.summary()
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
+        assert info["trainable_params"] == info["total_params"]
+
+    def test_num_iters(self):
+        m = self._model()
+        m.fit(self._dataset(), batch_size=32, epochs=100, verbose=0,
+              num_iters=3)
+        assert m._optimizer._step_count == 3

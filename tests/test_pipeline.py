@@ -57,7 +57,6 @@ class TestPipelineLayer:
                                    rtol=1e-5, atol=1e-6)
 
     def test_shared_layer_desc_ties_weights(self, mesh_pp4):
-        fleet.SharedLayerDesc._registry.clear()
         descs = [fleet.SharedLayerDesc("emb", nn.Linear, 4, 4),
                  fleet.LayerDesc(nn.ReLU),
                  fleet.SharedLayerDesc("emb", nn.Linear, 4, 4)]

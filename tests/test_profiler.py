@@ -1,0 +1,88 @@
+"""Profiler tests: RecordEvent spans, op auto-instrumentation, scheduler
+state machine, chrome-trace export, summary aggregation."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.profiler as profiler
+
+
+class TestRecordEvent:
+    def test_noop_when_inactive(self):
+        ev = profiler.RecordEvent("x")
+        ev.begin()
+        ev.end()  # must not raise nor record anywhere
+
+    def test_spans_recorded(self):
+        with profiler.Profiler() as prof:
+            with profiler.RecordEvent("my_region"):
+                pass
+        names = [e.name for e in prof.events]
+        assert "my_region" in names
+
+    def test_ops_auto_instrumented(self):
+        a = pt.to_tensor(np.ones((4, 4), np.float32))
+        with profiler.Profiler() as prof:
+            b = pt.matmul(a, a)
+            c = pt.add(b, a)
+        names = [e.name for e in prof.events]
+        assert "matmul" in names and "add" in names
+
+    def test_zero_overhead_off(self):
+        # no profiler: apply_op's hook returns None (no events anywhere)
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        pt.matmul(a, a)
+        prof = profiler.Profiler()
+        assert prof.events == []
+
+
+class TestScheduler:
+    def test_state_machine(self):
+        sched = profiler.make_scheduler(closed=1, ready=1, record=2,
+                                        skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states == ["closed", "closed", "ready", "record", "record",
+                          "closed"]
+
+    def test_profiler_honors_scheduler(self):
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        sched = profiler.make_scheduler(closed=1, ready=0, record=1)
+        prof = profiler.Profiler(scheduler=sched).start()
+        pt.matmul(a, a)  # step 0: closed
+        prof.step()
+        pt.matmul(a, a)  # step 1: record
+        prof.stop()
+        assert len([e for e in prof.events if e.name == "matmul"]) == 1
+
+
+class TestSinks:
+    def test_chrome_trace_export(self, tmp_path):
+        a = pt.to_tensor(np.ones((3, 3), np.float32))
+        with profiler.Profiler() as prof:
+            pt.matmul(a, a)
+        path = prof.export_chrome_tracing(str(tmp_path))
+        data = json.load(open(path))
+        assert data["traceEvents"]
+        ev = data["traceEvents"][0]
+        assert set(ev) >= {"name", "ph", "ts", "dur"}
+
+    def test_on_trace_ready_callback(self, tmp_path):
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        with profiler.Profiler(
+                on_trace_ready=profiler.export_chrome_tracing(
+                    str(tmp_path))):
+            pt.add(a, a)
+        assert any(f.endswith(".trace.json")
+                   for f in os.listdir(str(tmp_path)))
+
+    def test_summary(self, capsys):
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        with profiler.Profiler() as prof:
+            for _ in range(3):
+                pt.matmul(a, a)
+        rows = prof.summary()
+        agg = dict(rows)
+        assert agg["matmul"][1] == 3  # 3 calls
+        assert "matmul" in capsys.readouterr().out
