@@ -1,0 +1,284 @@
+// TCPStore — key-value rendezvous over raw TCP.
+//
+// Native counterpart of the reference's C++ store
+// (paddle/phi/core/distributed/store/tcp_store.cc + tcp_utils.cc): the
+// master host listens, every participant connects, and the store answers
+// SET/GET/ADD/WAIT/DELETE — the primitive under comm-id exchange, barriers,
+// and elastic membership (SURVEY.md §2.4). Python binds via ctypes
+// (paddle_tpu/distributed/tcp_store.py); no pybind11 dependency.
+//
+// Protocol (all integers little-endian):
+//   request : u8 cmd | u32 klen | key bytes | u32 vlen | value bytes
+//   response: i64 status_or_int | u32 vlen | value bytes
+// Commands: 0=SET 1=GET 2=ADD(value = i64 delta) 3=WAIT 4=DELETE 5=PING
+// GET on a missing key returns status -1; WAIT blocks until the key exists.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_response(int fd, int64_t status, const std::string& value) {
+  uint32_t vlen = static_cast<uint32_t>(value.size());
+  if (!write_exact(fd, &status, sizeof(status))) return false;
+  if (!write_exact(fd, &vlen, sizeof(vlen))) return false;
+  if (vlen && !write_exact(fd, value.data(), vlen)) return false;
+  return true;
+}
+
+void serve_client(Store* store, int fd) {
+  for (;;) {
+    uint8_t cmd;
+    uint32_t klen = 0, vlen = 0;
+    if (!read_exact(fd, &cmd, 1)) break;
+    if (!read_exact(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_exact(fd, key.data(), klen)) break;
+    if (!read_exact(fd, &vlen, 4)) break;
+    std::string value(vlen, '\0');
+    if (vlen && !read_exact(fd, value.data(), vlen)) break;
+
+    bool ok = true;
+    switch (cmd) {
+      case 0: {  // SET
+        {
+          std::lock_guard<std::mutex> g(store->mu);
+          store->kv[key] = value;
+        }
+        store->cv.notify_all();
+        ok = send_response(fd, 0, "");
+        break;
+      }
+      case 1: {  // GET
+        std::lock_guard<std::mutex> g(store->mu);
+        auto it = store->kv.find(key);
+        if (it == store->kv.end()) {
+          ok = send_response(fd, -1, "");
+        } else {
+          ok = send_response(fd, 0, it->second);
+        }
+        break;
+      }
+      case 2: {  // ADD: value holds an i64 delta; missing key starts at 0
+        int64_t delta = 0;
+        if (value.size() == sizeof(delta))
+          std::memcpy(&delta, value.data(), sizeof(delta));
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> g(store->mu);
+          int64_t cur = 0;
+          auto it = store->kv.find(key);
+          if (it != store->kv.end() && it->second.size() == sizeof(cur))
+            std::memcpy(&cur, it->second.data(), sizeof(cur));
+          result = cur + delta;
+          std::string stored(sizeof(result), '\0');
+          std::memcpy(stored.data(), &result, sizeof(result));
+          store->kv[key] = stored;
+        }
+        store->cv.notify_all();
+        ok = send_response(fd, result, "");
+        break;
+      }
+      case 3: {  // WAIT (blocks until the key exists)
+        std::unique_lock<std::mutex> g(store->mu);
+        store->cv.wait(g, [&] { return store->kv.count(key) > 0; });
+        ok = send_response(fd, 0, store->kv[key]);
+        break;
+      }
+      case 4: {  // DELETE
+        int64_t erased;
+        {
+          std::lock_guard<std::mutex> g(store->mu);
+          erased = static_cast<int64_t>(store->kv.erase(key));
+        }
+        ok = send_response(fd, erased, "");
+        break;
+      }
+      case 5:  // PING
+        ok = send_response(fd, 0, "pong");
+        break;
+      default:
+        ok = send_response(fd, -2, "");
+    }
+    if (!ok) break;
+  }
+  ::close(fd);
+}
+
+struct Server {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  Store store;
+  std::thread accept_thread;
+  bool running = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Start the master store. port 0 picks an ephemeral port; the bound port is
+// returned via *out_port. Returns an opaque handle or null on failure.
+void* tcp_store_server_start(uint16_t port, uint16_t* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+
+  auto* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  srv->running = true;
+  if (out_port) *out_port = srv->port;
+  srv->accept_thread = std::thread([srv] {
+    for (;;) {
+      int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;  // listen socket closed -> shut down
+      int one = 1;
+      ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::thread(serve_client, &srv->store, cfd).detach();
+    }
+  });
+  return srv;
+}
+
+void tcp_store_server_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  if (!srv) return;
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  delete srv;
+}
+
+// ---- client ----
+int tcp_store_connect(const char* host, uint16_t port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void tcp_store_close(int fd) {
+  if (fd >= 0) ::close(fd);
+}
+
+static int64_t request(int fd, uint8_t cmd, const char* key, uint32_t klen,
+                       const char* val, uint32_t vlen, char* out,
+                       uint32_t out_cap, uint32_t* out_len) {
+  if (!write_exact(fd, &cmd, 1)) return -1000;
+  if (!write_exact(fd, &klen, 4)) return -1000;
+  if (klen && !write_exact(fd, key, klen)) return -1000;
+  if (!write_exact(fd, &vlen, 4)) return -1000;
+  if (vlen && !write_exact(fd, val, vlen)) return -1000;
+  int64_t status;
+  uint32_t rlen;
+  if (!read_exact(fd, &status, 8)) return -1000;
+  if (!read_exact(fd, &rlen, 4)) return -1000;
+  if (rlen > 0) {
+    std::vector<char> buf(rlen);
+    if (!read_exact(fd, buf.data(), rlen)) return -1000;
+    uint32_t n = rlen < out_cap ? rlen : out_cap;
+    if (out && n) std::memcpy(out, buf.data(), n);
+    if (out_len) *out_len = rlen;
+  } else if (out_len) {
+    *out_len = 0;
+  }
+  return status;
+}
+
+int64_t tcp_store_set(int fd, const char* key, uint32_t klen,
+                      const char* val, uint32_t vlen) {
+  return request(fd, 0, key, klen, val, vlen, nullptr, 0, nullptr);
+}
+
+int64_t tcp_store_get(int fd, const char* key, uint32_t klen, char* out,
+                      uint32_t out_cap, uint32_t* out_len) {
+  return request(fd, 1, key, klen, nullptr, 0, out, out_cap, out_len);
+}
+
+int64_t tcp_store_add(int fd, const char* key, uint32_t klen,
+                      int64_t delta) {
+  return request(fd, 2, key, klen, reinterpret_cast<char*>(&delta),
+                 sizeof(delta), nullptr, 0, nullptr);
+}
+
+int64_t tcp_store_wait(int fd, const char* key, uint32_t klen, char* out,
+                       uint32_t out_cap, uint32_t* out_len) {
+  return request(fd, 3, key, klen, nullptr, 0, out, out_cap, out_len);
+}
+
+int64_t tcp_store_delete(int fd, const char* key, uint32_t klen) {
+  return request(fd, 4, key, klen, nullptr, 0, nullptr, 0, nullptr);
+}
+
+int64_t tcp_store_ping(int fd) {
+  char buf[8];
+  uint32_t n = 0;
+  return request(fd, 5, nullptr, 0, nullptr, 0, buf, sizeof(buf), &n);
+}
+
+}  // extern "C"

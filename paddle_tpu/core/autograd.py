@@ -87,9 +87,10 @@ class GradNode:
     """
 
     __slots__ = ("name", "vjp_fn", "edges", "n_outputs", "out_avals", "multi",
-                 "hooks", "__weakref__")
+                 "hooks", "fwd", "input_tensors", "input_vals", "__weakref__")
 
-    def __init__(self, name, vjp_fn, edges, n_outputs, out_avals, multi=False):
+    def __init__(self, name, vjp_fn, edges, n_outputs, out_avals, multi=False,
+                 fwd=None, input_tensors=None, input_vals=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.edges = edges
@@ -97,6 +98,13 @@ class GradNode:
         self.out_avals = out_avals  # (shape, dtype) per output slot
         self.multi = multi  # forward returned a tuple (vjp expects tuple cotangent)
         self.hooks: List[Callable] = []
+        # replay metadata for create_graph (higher-order) differentiation:
+        # the pure forward fn + input tensor refs + their recorded values
+        # (the reference keeps the static graph for GeneralGrad; we keep the
+        # pure functions and rebuild a jax-differentiable composition)
+        self.fwd = fwd
+        self.input_tensors = input_tensors
+        self.input_vals = input_vals
 
     def register_hook(self, hook: Callable):
         self.hooks.append(hook)
@@ -181,7 +189,8 @@ def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
         node = GradNode(
             op_name or getattr(fn, "__name__", "op"), vjp_fn, edges,
             len(out_arrays), [(a.shape, a.dtype) for a in out_arrays],
-            multi=multi)
+            multi=multi, fwd=pure, input_tensors=list(t_inputs),
+            input_vals=list(arrays))
 
     outs = []
     for i, a in enumerate(out_arrays):
@@ -194,12 +203,20 @@ def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
     return tuple(outs) if multi else outs[0]
 
 
+_record_op_hook = None
+
+
 def _record_op_event(name):
-    try:
-        from paddle_tpu.profiler import record_op
-    except ImportError:
+    global _record_op_hook
+    if _record_op_hook is None:
+        try:
+            from paddle_tpu.profiler import record_op
+        except ImportError:
+            record_op = None
+        _record_op_hook = record_op if record_op is not None else False
+    if _record_op_hook is False:
         return None
-    return record_op(name)
+    return _record_op_hook(name)
 
 
 def _maybe_autocast(op_name, arrays):
@@ -363,7 +380,12 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                 "with retain_graph=True the first time")
         in_cots = node.vjp_fn(cots if _vjp_multi(node) else cots[0])
         if not retain_graph:
-            node.vjp_fn = None  # free residuals
+            # free residuals AND replay metadata (fwd closes over the same
+            # activations; keeping it would defeat the free)
+            node.vjp_fn = None
+            node.fwd = None
+            node.input_tensors = None
+            node.input_vals = None
         for e, g in zip(node.edges, in_cots):
             if e is None:
                 continue
@@ -384,6 +406,115 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
             grad_map[id(t)] = _run_leaf_hooks(t, g)
         else:
             _write_leaf_grad(t, g)
+
+
+def _topo_nodes(outputs):
+    """Producer-first topological order of nodes reachable from outputs."""
+    order, seen = [], set()
+
+    def visit(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for t in node.input_tensors or ():
+            if t._grad_node is not None:
+                visit(t._grad_node)
+        order.append(node)
+    for t in outputs:
+        if t._grad_node is not None:
+            visit(t._grad_node)
+    return order
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """Higher-order paddle.grad: rebuild the recorded computation as one
+    pure jax function (replaying each node's stored forward), differentiate
+    with jax.vjp, and run the result THROUGH the tape so it is itself
+    differentiable (reference: eager/general_grad.h create_graph path)."""
+    from .tensor import Tensor
+
+    nodes = _topo_nodes(outputs)
+    if any(n.fwd is None for n in nodes):
+        raise RuntimeError(
+            "create_graph requires the recorded forward functions; part of "
+            "this graph was freed (backward without retain_graph?)")
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+    # connectivity check for allow_unused semantics (outputs themselves
+    # are reachable: grad(y, y) is the identity cotangent)
+    reachable = {id(t) for t in outputs}
+    for n in nodes:
+        for t in n.input_tensors:
+            reachable.add(id(t))
+
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    cots = [jnp.ones(t.data.shape, t.data.dtype) if g is None
+            else (g.data if isinstance(g, Tensor) else jnp.asarray(g))
+            for t, g in zip(outputs, grad_outputs)]
+    # an output that is itself a requested input must resolve to the
+    # replay ARGUMENT (grad(y, y) is the identity), not the recomputed value
+    out_keys = [("leaf", id(t)) if (id(t) in input_ids or
+                                    t._grad_node is None)
+                else (id(t._grad_node), t._out_idx) for t in outputs]
+
+    # every OTHER differentiable leaf also enters the replay as an argument
+    # so the returned grads stay differentiable w.r.t. them (mixed partials
+    # like d2z/dxdy where only x was requested in the first grad call)
+    extras, seen_extra = [], set(input_ids)
+    for n in nodes:
+        for t in n.input_tensors:
+            if not t.stop_gradient and id(t) not in seen_extra and \
+                    t._grad_node is None:
+                seen_extra.add(id(t))
+                extras.append(t)
+    all_args = list(inputs) + extras
+
+    def g_fn(*arrs):
+        def replay(*inner):
+            env = {}  # (id(node), slot) -> value
+            leaf_env = {id(t): a for t, a in zip(all_args, inner)}
+            for node in nodes:
+                vals = []
+                for t, recorded in zip(node.input_tensors,
+                                       node.input_vals):
+                    if id(t) in leaf_env:
+                        vals.append(leaf_env[id(t)])
+                    elif t._grad_node is not None and \
+                            (id(t._grad_node), t._out_idx) in env:
+                        vals.append(env[(id(t._grad_node), t._out_idx)])
+                    else:
+                        vals.append(recorded)
+                res = node.fwd(*vals)
+                res_list = list(res) if isinstance(res, (tuple, list)) \
+                    else [res]
+                for slot, v in enumerate(res_list):
+                    env[(id(node), slot)] = v
+            outs = []
+            for key, t in zip(out_keys, outputs):
+                if key[0] == "leaf":
+                    outs.append(leaf_env.get(id(t), t.data))
+                else:
+                    outs.append(env[key])
+            return tuple(outs)
+
+        _, vjp = jax.vjp(replay, *arrs)
+        return vjp(tuple(cots))[: len(inputs)]
+
+    grads = apply_op(g_fn, *all_args, op_name="grad")
+    grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
+    results = []
+    for t, g in zip(inputs, grads):
+        if id(t) not in reachable:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; pass "
+                    "allow_unused=True to get None instead")
+            results.append(None)
+        else:
+            results.append(g)
+    return results
 
 
 def _vjp_multi(node):
@@ -420,15 +551,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     ``.grad`` field anywhere in the model is touched.
     """
     from .tensor import Tensor
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph/higher-order grad via the eager tape is not yet "
-            "supported; use the functional API (paddle_tpu.jit) with jax.grad "
-            "composition for higher-order derivatives")
     if isinstance(inputs, Tensor):
         inputs = [inputs]
     if isinstance(outputs, Tensor):
         outputs = [outputs]
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused)
 
     gmap: dict = {}
     taps = {id(t): (t._grad_node, t._out_idx)

@@ -29,3 +29,4 @@ from . import sharding  # noqa: F401
 from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model,
 )
+from .tcp_store import TCPStore  # noqa: F401

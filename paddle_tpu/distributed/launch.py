@@ -1,0 +1,138 @@
+"""Process launcher + elastic membership.
+
+Parity with ``python -m paddle.distributed.launch`` (reference:
+``python/paddle/distributed/launch/``: controllers build a node/pod model,
+inject PADDLE_TRAINER_* env, watch logs; elastic in
+``fleet/elastic/manager.py`` heartbeats etcd). TPU shape: one process per
+HOST (each host drives its local chips; jax.distributed handles the device
+mesh), rendezvous through the native TCPStore instead of etcd/HTTP, and a
+heartbeat-based ElasticManager that detects dead trainers and triggers
+relaunch.
+
+CLI::
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node 2 train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from .tcp_store import TCPStore
+
+__all__ = ["launch", "ElasticManager", "main"]
+
+
+class ElasticManager:
+    """Store-backed membership (reference: elastic/manager.py:126 —
+    register with TTL lease + heartbeat thread; watch for dead peers)."""
+
+    def __init__(self, store: TCPStore, rank: int, world_size: int,
+                 heartbeat_interval: float = 1.0,
+                 heartbeat_timeout: float = 5.0):
+        self._store = store
+        self.rank = rank
+        self.world_size = world_size
+        self._interval = heartbeat_interval
+        self._timeout = heartbeat_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._beat()
+
+        def loop():
+            while not self._stop.wait(self._interval):
+                self._beat()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _beat(self):
+        self._store.set(f"__hb/{self.rank}", str(time.time()))
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def dead_ranks(self) -> List[int]:
+        now = time.time()
+        dead = []
+        for r in range(self.world_size):
+            v = self._store.get(f"__hb/{r}")
+            if v is None or now - float(v) > self._timeout:
+                dead.append(r)
+        return dead
+
+    def all_alive(self) -> bool:
+        return not self.dead_ranks()
+
+
+def launch(script: str, script_args: Optional[List[str]] = None,
+           nproc_per_node: int = 1, master: Optional[str] = None,
+           max_restarts: int = 0, log_dir: Optional[str] = None) -> int:
+    """Spawn ``nproc_per_node`` trainer processes with reference-compatible
+    env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER), a
+    TCPStore master in this launcher, and restart-on-failure up to
+    ``max_restarts`` (elastic relaunch)."""
+    script_args = script_args or []
+    store = TCPStore(is_master=True, world_size=nproc_per_node)
+    master_addr = master or f"127.0.0.1:{store.port}"
+    attempts = 0
+    while True:
+        procs = []
+        logs = []
+        for rank in range(nproc_per_node):
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(nproc_per_node),
+                "PADDLE_MASTER": master_addr,
+                "PADDLE_STORE_PORT": str(store.port),
+            })
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                lf = open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
+                logs.append(lf)
+                out = lf
+            else:
+                out = None
+            procs.append(subprocess.Popen(
+                [sys.executable, script, *script_args], env=env,
+                stdout=out, stderr=subprocess.STDOUT if out else None))
+        codes = [p.wait() for p in procs]
+        for lf in logs:
+            lf.close()
+        if all(c == 0 for c in codes):
+            return 0
+        attempts += 1
+        if attempts > max_restarts:
+            return next(c for c in codes if c != 0)
+        # elastic relaunch: clear heartbeat keys and go again
+        for r in range(nproc_per_node):
+            store.delete_key(f"__hb/{r}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed trainer processes")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--master", type=str, default=None)
+    parser.add_argument("--max_restarts", type=int, default=0)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    return launch(args.script, args.script_args, args.nproc_per_node,
+                  args.master, args.max_restarts, args.log_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

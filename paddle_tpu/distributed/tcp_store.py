@@ -1,0 +1,168 @@
+"""TCPStore — Python surface over the native C++ store.
+
+Parity with ``paddle.distributed.TCPStore`` (reference C++:
+``paddle/phi/core/distributed/store/tcp_store.cc``; Python binding in
+``parallel.py:1090`` rendezvous). The implementation is the C++ server in
+``native/tcp_store.cpp`` compiled on first use (g++ -O2 -shared, cached
+under ``native/build/``) and driven through ctypes — the framework's
+runtime networking is native code, per the reference's architecture.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from typing import Optional
+
+__all__ = ["TCPStore", "barrier_via_store"]
+
+_lib_lock = threading.Lock()
+_lib = None
+
+
+def _native_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def _load_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_native_dir(), "tcp_store.cpp")
+        build = os.path.join(_native_dir(), "build")
+        os.makedirs(build, exist_ok=True)
+        so = os.path.join(build, "libtcp_store.so")
+        if not os.path.exists(so) or \
+                os.path.getmtime(so) < os.path.getmtime(src):
+            tmp = so + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                 src, "-o", tmp],
+                check=True, capture_output=True)
+            os.replace(tmp, so)
+        lib = ctypes.CDLL(so)
+        lib.tcp_store_server_start.restype = ctypes.c_void_p
+        lib.tcp_store_server_start.argtypes = [
+            ctypes.c_uint16, ctypes.POINTER(ctypes.c_uint16)]
+        lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcp_store_connect.restype = ctypes.c_int
+        lib.tcp_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+        lib.tcp_store_close.argtypes = [ctypes.c_int]
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.tcp_store_set.restype = ctypes.c_int64
+        lib.tcp_store_set.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                      ctypes.c_uint32, ctypes.c_char_p,
+                                      ctypes.c_uint32]
+        lib.tcp_store_get.restype = ctypes.c_int64
+        lib.tcp_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                      ctypes.c_uint32, ctypes.c_char_p,
+                                      ctypes.c_uint32, u32p]
+        lib.tcp_store_add.restype = ctypes.c_int64
+        lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                      ctypes.c_uint32, ctypes.c_int64]
+        lib.tcp_store_wait.restype = ctypes.c_int64
+        lib.tcp_store_wait.argtypes = lib.tcp_store_get.argtypes
+        lib.tcp_store_delete.restype = ctypes.c_int64
+        lib.tcp_store_delete.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                         ctypes.c_uint32]
+        lib.tcp_store_ping.restype = ctypes.c_int64
+        lib.tcp_store_ping.argtypes = [ctypes.c_int]
+        _lib = lib
+        return lib
+
+
+class TCPStore:
+    """paddle.distributed.TCPStore parity: the master hosts the table,
+    everyone (master included) talks to it over a client socket."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 300.0):
+        lib = _load_lib()
+        self._lib = lib
+        self._server = None
+        self.host = host
+        self.world_size = world_size
+        if is_master:
+            out_port = ctypes.c_uint16(0)
+            self._server = lib.tcp_store_server_start(
+                ctypes.c_uint16(port), ctypes.byref(out_port))
+            if not self._server:
+                raise RuntimeError(f"failed to bind TCPStore on port {port}")
+            port = out_port.value
+        self.port = port
+        deadline = time.monotonic() + timeout
+        while True:
+            self._fd = lib.tcp_store_connect(host.encode(),
+                                             ctypes.c_uint16(port))
+            if self._fd >= 0:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"could not reach TCPStore at {host}:{port}")
+            time.sleep(0.05)
+        if lib.tcp_store_ping(self._fd) != 0:
+            raise RuntimeError("TCPStore ping failed")
+
+    # -- KV API ---------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        v = value if isinstance(value, bytes) else str(value).encode()
+        k = key.encode()
+        if self._lib.tcp_store_set(self._fd, k, len(k), v, len(v)) != 0:
+            raise RuntimeError("TCPStore set failed")
+
+    def get(self, key: str) -> Optional[bytes]:
+        k = key.encode()
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = ctypes.c_uint32(0)
+        status = self._lib.tcp_store_get(self._fd, k, len(k), buf,
+                                         len(buf), ctypes.byref(n))
+        if status == -1:
+            return None
+        if status < -1:
+            raise RuntimeError("TCPStore get failed")
+        return buf.raw[: n.value]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        k = key.encode()
+        res = self._lib.tcp_store_add(self._fd, k, len(k), int(amount))
+        if res <= -1000:
+            raise RuntimeError("TCPStore add failed")
+        return int(res)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Block until the key exists; returns its value."""
+        k = key.encode()
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = ctypes.c_uint32(0)
+        status = self._lib.tcp_store_wait(self._fd, k, len(k), buf,
+                                          len(buf), ctypes.byref(n))
+        if status != 0:
+            raise RuntimeError("TCPStore wait failed")
+        return buf.raw[: n.value]
+
+    def delete_key(self, key: str) -> bool:
+        k = key.encode()
+        return self._lib.tcp_store_delete(self._fd, k, len(k)) > 0
+
+    def __del__(self):
+        try:
+            if getattr(self, "_fd", -1) >= 0:
+                self._lib.tcp_store_close(self._fd)
+            if getattr(self, "_server", None):
+                self._lib.tcp_store_server_stop(self._server)
+        except Exception:
+            pass
+
+
+def barrier_via_store(store: TCPStore, name: str, world_size: int) -> None:
+    """Reference-pattern store barrier: everyone increments, then waits for
+    the count to reach world_size (parallel.py's init barrier)."""
+    arrived = store.add(f"__barrier/{name}", 1)
+    if arrived == world_size:
+        store.set(f"__barrier/{name}/done", b"1")
+    store.wait(f"__barrier/{name}/done")

@@ -202,7 +202,8 @@ class Model:
         loader = self._to_loader(train_data, batch_size, shuffle, drop_last,
                                  num_workers)
         callbacks = list(callbacks or [])
-        if verbose:
+        if verbose and not any(isinstance(c, ProgBarLogger)
+                               for c in callbacks):
             callbacks.append(ProgBarLogger(log_freq, verbose))
         for cb in callbacks:
             cb.set_model(self)

@@ -32,16 +32,24 @@ def _specs_to_avals(input_spec):
     scope = jax_export.SymbolicScope()
     sym_cache = {}
 
+    fresh = [0]
+
     def dims_of(shape):
-        # dynamic dims at the same axis position share one symbol: multi-
-        # input models (features + labels) keep an equal batch dimension
+        # the leading dynamic dim (batch) is shared across inputs so
+        # features/labels stay batch-consistent; other dynamic dims get
+        # independent symbols (two inputs may have unequal seq lengths)
         out = []
         for i, s in enumerate(shape):
             if s in (-1, None):
-                if i not in sym_cache:
-                    sym_cache[i] = jax_export.symbolic_shape(
-                        f"_dyn_ax{i}", scope=scope)[0]
-                out.append(sym_cache[i])
+                if i == 0:
+                    if 0 not in sym_cache:
+                        sym_cache[0] = jax_export.symbolic_shape(
+                            "_dyn_batch", scope=scope)[0]
+                    out.append(sym_cache[0])
+                else:
+                    fresh[0] += 1
+                    out.append(jax_export.symbolic_shape(
+                        f"_dyn{fresh[0]}", scope=scope)[0])
             else:
                 out.append(int(s))
         return tuple(out)

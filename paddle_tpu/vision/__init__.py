@@ -1,0 +1,7 @@
+"""paddle.vision parity namespace (reference: ``python/paddle/vision/``)."""
+from . import transforms  # noqa: F401
+from . import models  # noqa: F401
+from . import datasets  # noqa: F401
+from .models import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, LeNet,
+)

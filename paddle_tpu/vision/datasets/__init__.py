@@ -1,0 +1,113 @@
+"""Vision datasets (reference: ``python/paddle/vision/datasets/``).
+
+The sandbox has no network egress, so downloads raise with a clear message;
+local-file loading (MNIST idx format) and the synthetic FakeData generator
+work everywhere (FakeData is also the perf-bench input source).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + idx)
+        img = rng.randn(*self.image_shape).astype(np.float32)
+        label = np.int64(idx % self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """MNIST from local idx/idx.gz files (reference file-format parity:
+    ``python/paddle/vision/datasets/mnist.py``)."""
+
+    _files = {
+        "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 root=None):
+        self.transform = transform
+        if image_path is None or label_path is None:
+            root = root or os.path.expanduser("~/.cache/paddle_tpu/mnist")
+            img_name, lbl_name = self._files[mode]
+            image_path = self._find(root, img_name)
+            label_path = self._find(root, lbl_name)
+            if image_path is None or label_path is None:
+                raise FileNotFoundError(
+                    f"MNIST files not found under {root}; this environment "
+                    "has no network egress — place the idx(.gz) files there "
+                    "or pass image_path/label_path explicitly")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _find(root, name):
+        for cand in (os.path.join(root, name),
+                     os.path.join(root, name + ".gz")):
+            if os.path.exists(cand):
+                return cand
+        return None
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    @classmethod
+    def _read_images(cls, path):
+        with cls._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx3 magic {magic} in {path}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    @classmethod
+    def _read_labels(cls, path):
+        with cls._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx1 magic {magic} in {path}")
+            return np.frombuffer(f.read(n), dtype=np.uint8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    _files = {
+        "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }

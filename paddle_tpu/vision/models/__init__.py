@@ -1,0 +1,6 @@
+"""Vision model zoo (reference: ``python/paddle/vision/models/``)."""
+from .resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    BasicBlock, BottleneckBlock,
+)
+from .lenet import LeNet  # noqa: F401

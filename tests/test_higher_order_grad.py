@@ -1,0 +1,118 @@
+"""create_graph / higher-order gradient tests vs analytic oracles and the
+reference's double-grad use cases (gradient penalty)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def t(x, sg=False):
+    return pt.to_tensor(np.asarray(x, dtype=np.float32), stop_gradient=sg)
+
+
+class TestCreateGraph:
+    def test_second_derivative_polynomial(self):
+        x = t([2.0])
+        y = x * x * x  # y = x^3
+        (gx,) = pt.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)  # 3x^2
+        (gxx,) = pt.grad(gx, [x])
+        np.testing.assert_allclose(gxx.numpy(), [12.0], rtol=1e-5)  # 6x
+
+    def test_third_derivative(self):
+        x = t([1.5])
+        y = x * x * x * x  # x^4
+        (g1,) = pt.grad(y, [x], create_graph=True)
+        (g2,) = pt.grad(g1, [x], create_graph=True)
+        (g3,) = pt.grad(g2, [x])
+        np.testing.assert_allclose(g3.numpy(), [24 * 1.5], rtol=1e-5)
+
+    def test_mixed_partial(self):
+        x, y = t([2.0]), t([3.0])
+        z = x * x * y  # d/dx = 2xy; d2/dxdy = 2x
+        (gx,) = pt.grad(z, [x], create_graph=True)
+        (gxy,) = pt.grad(gx, [y])
+        np.testing.assert_allclose(gxy.numpy(), [4.0], rtol=1e-5)
+
+    def test_through_nonlinearity(self):
+        x = t([0.7])
+        y = pt.tanh(x)
+        (g1,) = pt.grad(y, [x], create_graph=True)
+        (g2,) = pt.grad(g1, [x])
+        th = np.tanh(0.7)
+        np.testing.assert_allclose(g2.numpy(),
+                                   [-2 * th * (1 - th ** 2)], rtol=1e-4)
+
+    def test_unused_input(self):
+        x, z = t([1.0]), t([1.0])
+        y = x * 2.0
+        with pytest.raises(RuntimeError):
+            pt.grad(y, [z], create_graph=True)
+        gx, gz = pt.grad(y, [x, z], create_graph=True, allow_unused=True)
+        assert gz is None
+        np.testing.assert_allclose(gx.numpy(), [2.0], rtol=1e-6)
+
+    def test_grad_outputs_seed(self):
+        x = t([3.0])
+        y = x * x
+        (g,) = pt.grad(y, [x], grad_outputs=[t([2.0], sg=True)],
+                       create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-5)  # 2 * 2x
+
+    def test_gradient_penalty_trains(self):
+        # WGAN-GP pattern: loss includes ||dD/dx||^2 — needs create_graph
+        pt.seed(0)
+        rng = np.random.RandomState(0)
+        lin = nn.Linear(4, 1)
+        o = pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=lin.parameters())
+        X = rng.randn(8, 4).astype(np.float32)
+        for _ in range(5):
+            x = t(X)
+            out = lin(x).sum()
+            (gx,) = pt.grad(out, [x], create_graph=True)
+            gp = (gx * gx).sum()  # ||grad||^2 penalty term
+            gp.backward()
+            o.step()
+            o.clear_grad(set_to_zero=False)
+        # d(gp)/d(w): gp = 8 * ||w||^2 -> w shrinks toward 0
+        assert np.linalg.norm(lin.weight.numpy()) < 1.0
+
+    def test_first_order_result_matches_plain_grad(self):
+        x = t([1.0, 2.0, 3.0])
+        w = t([0.5, -1.0, 2.0])
+        y = (x * w).sum()
+        (g_plain,) = pt.grad(y, [x], retain_graph=True)
+        (g_cg,) = pt.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(g_cg.numpy(), g_plain.numpy(), rtol=1e-6)
+        assert not g_cg.stop_gradient  # lives on the tape
+        assert g_plain.stop_gradient
+
+
+class TestReviewRegressions:
+    def test_grad_outputs_none_entry(self):
+        x = t([3.0])
+        y = x * x
+        (g,) = pt.grad(y, [x], grad_outputs=[None], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [6.0], rtol=1e-5)
+
+    def test_grad_of_output_wrt_itself(self):
+        x = t([2.0])
+        y = x * x
+        (g,) = pt.grad(y, [y], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [1.0], rtol=1e-6)
+
+    def test_freed_graph_raises_in_create_graph(self):
+        x = t([2.0])
+        y = x * x
+        y.backward()  # frees residuals AND replay metadata
+        with pytest.raises(RuntimeError, match="freed"):
+            pt.grad(y, [x], create_graph=True)
+
+    def test_retain_graph_keeps_replay(self):
+        x = t([2.0])
+        y = x * x
+        y.backward(retain_graph=True)
+        (g,) = pt.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [4.0], rtol=1e-6)

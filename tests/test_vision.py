@@ -1,0 +1,131 @@
+"""Vision tests: transforms vs numpy/torch oracles, ResNet/LeNet forward +
+training on FakeData."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.io as io
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import FakeData
+
+
+class TestTransforms:
+    def test_to_tensor(self):
+        img = (np.random.RandomState(0).rand(8, 6, 3) * 255).astype(np.uint8)
+        out = T.ToTensor()(img)
+        assert out.shape == (3, 8, 6)
+        assert out.dtype == np.float32 and out.max() <= 1.0
+
+    def test_normalize(self):
+        x = np.ones((3, 4, 4), np.float32)
+        out = T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])(x)
+        np.testing.assert_allclose(out, np.ones_like(x))
+
+    def test_resize_matches_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        img = np.random.RandomState(0).rand(10, 8, 3).astype(np.float32)
+        out = T.Resize((5, 4))(img)
+        ref = TF.interpolate(torch.tensor(img).permute(2, 0, 1)[None],
+                             size=(5, 4), mode="bilinear",
+                             align_corners=False)[0].permute(1, 2, 0).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_crops(self):
+        img = np.arange(100, dtype=np.float32).reshape(10, 10, 1)
+        c = T.CenterCrop(4)(img)
+        assert c.shape == (4, 4, 1)
+        np.testing.assert_allclose(c[0, 0, 0], 33.0)
+        r = T.RandomCrop(6)(img)
+        assert r.shape == (6, 6, 1)
+
+    def test_flip_and_compose(self):
+        img = np.arange(12, dtype=np.float32).reshape(2, 6, 1)
+        out = T.RandomHorizontalFlip(prob=1.0)(img)
+        np.testing.assert_allclose(out[:, ::-1], img)
+        pipe = T.Compose([T.RandomHorizontalFlip(prob=0.0), T.Transpose()])
+        assert pipe(img).shape == (1, 2, 6)
+
+
+class TestModels:
+    def test_resnet18_forward(self):
+        pt.seed(0)
+        m = pt.vision.resnet18(num_classes=10)
+        m.eval()
+        x = pt.to_tensor(np.random.RandomState(0).randn(
+            2, 3, 32, 32).astype(np.float32))
+        out = m(x)
+        assert out.shape == [2, 10]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_resnet50_structure(self):
+        m = pt.vision.resnet50(num_classes=7)
+        # bottleneck expansion: final fc in_features 2048
+        assert m.fc.weight.shape == [2048, 7]
+        n_params = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert 23_000_000 < n_params < 27_000_000  # ~25.6M like the ref
+
+    def test_lenet_trains_on_fakedata(self):
+        pt.seed(1)
+        ds = FakeData(num_samples=64, image_shape=(1, 28, 28),
+                      num_classes=4)
+        # learnable rule: class = argmax of 4 fixed projections
+        rng = np.random.RandomState(0)
+        W = rng.randn(784, 4).astype(np.float32)
+        items = [(x, np.int64((x.reshape(-1) @ W).argmax()))
+                 for x, _ in [ds[i] for i in range(64)]]
+        X = np.stack([x for x, _ in items])
+        Y = np.stack([y for _, y in items])
+        dl = io.DataLoader(io.TensorDataset([X, Y]), batch_size=16,
+                           shuffle=True)
+        m = pt.vision.LeNet(num_classes=4)
+        o = opt.AdamW(learning_rate=2e-3, parameters=m.parameters())
+        ce = nn.CrossEntropyLoss()
+        losses = []
+        for epoch in range(15):
+            for xb, yb in dl:
+                loss = ce(m(pt.to_tensor(xb)), pt.to_tensor(yb))
+                loss.backward()
+                o.step()
+                o.clear_grad()
+                losses.append(float(loss.numpy()))
+        assert np.mean(losses[-4:]) < np.mean(losses[:4]) * 0.7
+
+
+class TestDatasets:
+    def test_fakedata_deterministic(self):
+        ds = FakeData(num_samples=10, image_shape=(3, 8, 8), seed=3)
+        x1, y1 = ds[5]
+        x2, y2 = ds[5]
+        np.testing.assert_allclose(x1, x2)
+        assert y1 == 5 % 10
+
+    def test_fakedata_with_transform(self):
+        ds = FakeData(num_samples=4, image_shape=(8, 8, 3),
+                      transform=T.Compose([T.Transpose()]))
+        x, _ = ds[0]
+        assert x.shape == (3, 8, 8)
+
+    def test_mnist_missing_raises_clearly(self, tmp_path):
+        from paddle_tpu.vision.datasets import MNIST
+        with pytest.raises(FileNotFoundError, match="no network egress"):
+            MNIST(root=str(tmp_path))
+
+    def test_mnist_reads_idx_files(self, tmp_path):
+        import struct
+        imgs = (tmp_path / "train-images-idx3-ubyte")
+        lbls = (tmp_path / "train-labels-idx1-ubyte")
+        rng = np.random.RandomState(0)
+        data = rng.randint(0, 255, (5, 28, 28), dtype=np.uint8)
+        labels = np.arange(5, dtype=np.uint8)
+        imgs.write_bytes(struct.pack(">IIII", 2051, 5, 28, 28) +
+                         data.tobytes())
+        lbls.write_bytes(struct.pack(">II", 2049, 5) + labels.tobytes())
+        from paddle_tpu.vision.datasets import MNIST
+        ds = MNIST(root=str(tmp_path))
+        assert len(ds) == 5
+        img, y = ds[3]
+        np.testing.assert_array_equal(img, data[3])
+        assert y == 3
