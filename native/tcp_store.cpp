@@ -10,8 +10,10 @@
 // Protocol (all integers little-endian):
 //   request : u8 cmd | u32 klen | key bytes | u32 vlen | value bytes
 //   response: i64 status_or_int | u32 vlen | value bytes
-// Commands: 0=SET 1=GET 2=ADD(value = i64 delta) 3=WAIT 4=DELETE 5=PING
-// GET on a missing key returns status -1; WAIT blocks until the key exists.
+// Commands: 0=SET 1=GET 2=ADD(value = i64 delta) 3=WAIT(value = i64
+// timeout_ms, -1 = forever) 4=DELETE 5=PING 6=DELETE_PREFIX
+// GET on a missing key returns status -1; WAIT blocks until the key exists,
+// returning -3 on timeout and -4 if the server is shutting down.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -19,8 +21,11 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <list>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -34,6 +39,7 @@ struct Store {
   std::mutex mu;
   std::condition_variable cv;
   std::map<std::string, std::string> kv;
+  bool stopping = false;
 };
 
 bool read_exact(int fd, void* buf, size_t n) {
@@ -66,7 +72,18 @@ bool send_response(int fd, int64_t status, const std::string& value) {
   return true;
 }
 
-void serve_client(Store* store, int fd) {
+// One accepted connection. The server (accept-loop reap or stop) owns the
+// fd's close and the thread's join; serve_client only flags completion —
+// closing here would let the kernel reuse the descriptor number while it is
+// still in the server's list, so stop() could shutdown an unrelated socket.
+struct ClientSlot {
+  int fd = -1;
+  std::thread th;
+  std::atomic<bool> done{false};
+};
+
+void serve_client(Store* store, ClientSlot* slot) {
+  const int fd = slot->fd;
   for (;;) {
     uint8_t cmd;
     uint32_t klen = 0, vlen = 0;
@@ -119,10 +136,28 @@ void serve_client(Store* store, int fd) {
         ok = send_response(fd, result, "");
         break;
       }
-      case 3: {  // WAIT (blocks until the key exists)
+      case 3: {  // WAIT (value = i64 timeout_ms; -1 blocks forever)
+        int64_t timeout_ms = -1;
+        if (value.size() == sizeof(timeout_ms))
+          std::memcpy(&timeout_ms, value.data(), sizeof(timeout_ms));
         std::unique_lock<std::mutex> g(store->mu);
-        store->cv.wait(g, [&] { return store->kv.count(key) > 0; });
-        ok = send_response(fd, 0, store->kv[key]);
+        auto pred = [&] {
+          return store->stopping || store->kv.count(key) > 0;
+        };
+        bool found;
+        if (timeout_ms < 0) {
+          store->cv.wait(g, pred);
+          found = store->kv.count(key) > 0;
+        } else {
+          found = store->cv.wait_for(
+                      g, std::chrono::milliseconds(timeout_ms), pred) &&
+                  store->kv.count(key) > 0;
+        }
+        if (found) {
+          ok = send_response(fd, 0, store->kv[key]);
+        } else {
+          ok = send_response(fd, store->stopping ? -4 : -3, "");
+        }
         break;
       }
       case 4: {  // DELETE
@@ -137,12 +172,26 @@ void serve_client(Store* store, int fd) {
       case 5:  // PING
         ok = send_response(fd, 0, "pong");
         break;
+      case 6: {  // DELETE_PREFIX: erase every key starting with `key`
+        int64_t erased = 0;
+        {
+          std::lock_guard<std::mutex> g(store->mu);
+          auto it = store->kv.lower_bound(key);
+          while (it != store->kv.end() &&
+                 it->first.compare(0, key.size(), key) == 0) {
+            it = store->kv.erase(it);
+            ++erased;
+          }
+        }
+        ok = send_response(fd, erased, "");
+        break;
+      }
       default:
         ok = send_response(fd, -2, "");
     }
     if (!ok) break;
   }
-  ::close(fd);
+  slot->done.store(true);
 }
 
 struct Server {
@@ -150,7 +199,8 @@ struct Server {
   uint16_t port = 0;
   Store store;
   std::thread accept_thread;
-  bool running = false;
+  std::mutex clients_mu;
+  std::list<ClientSlot> clients;  // list: stable addresses for the threads
 };
 
 }  // namespace
@@ -179,7 +229,6 @@ void* tcp_store_server_start(uint16_t port, uint16_t* out_port) {
   auto* srv = new Server();
   srv->listen_fd = fd;
   srv->port = ntohs(addr.sin_port);
-  srv->running = true;
   if (out_port) *out_port = srv->port;
   srv->accept_thread = std::thread([srv] {
     for (;;) {
@@ -187,7 +236,22 @@ void* tcp_store_server_start(uint16_t port, uint16_t* out_port) {
       if (cfd < 0) break;  // listen socket closed -> shut down
       int one = 1;
       ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-      std::thread(serve_client, &srv->store, cfd).detach();
+      std::lock_guard<std::mutex> g(srv->clients_mu);
+      // reap finished connections so a long-lived master does not retain
+      // one joinable thread (and its stack mapping) per connection ever made
+      for (auto it = srv->clients.begin(); it != srv->clients.end();) {
+        if (it->done.load()) {
+          if (it->th.joinable()) it->th.join();
+          ::close(it->fd);
+          it = srv->clients.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      srv->clients.emplace_back();
+      ClientSlot& slot = srv->clients.back();
+      slot.fd = cfd;
+      slot.th = std::thread(serve_client, &srv->store, &slot);
     }
   });
   return srv;
@@ -199,6 +263,23 @@ void tcp_store_server_stop(void* handle) {
   ::shutdown(srv->listen_fd, SHUT_RDWR);
   ::close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  // wake WAITers, unblock reads, and join every client thread before the
+  // Store (mutex/condvar) is destroyed — detached threads would race the
+  // delete below (use-after-free)
+  {
+    std::lock_guard<std::mutex> g(srv->store.mu);
+    srv->store.stopping = true;
+  }
+  srv->store.cv.notify_all();
+  {
+    std::lock_guard<std::mutex> g(srv->clients_mu);
+    for (ClientSlot& c : srv->clients)
+      if (!c.done.load()) ::shutdown(c.fd, SHUT_RDWR);
+  }
+  for (ClientSlot& c : srv->clients) {
+    if (c.th.joinable()) c.th.join();
+    ::close(c.fd);
+  }
   delete srv;
 }
 
@@ -266,13 +347,19 @@ int64_t tcp_store_add(int fd, const char* key, uint32_t klen,
                  sizeof(delta), nullptr, 0, nullptr);
 }
 
-int64_t tcp_store_wait(int fd, const char* key, uint32_t klen, char* out,
-                       uint32_t out_cap, uint32_t* out_len) {
-  return request(fd, 3, key, klen, nullptr, 0, out, out_cap, out_len);
+int64_t tcp_store_wait(int fd, const char* key, uint32_t klen,
+                       int64_t timeout_ms, char* out, uint32_t out_cap,
+                       uint32_t* out_len) {
+  return request(fd, 3, key, klen, reinterpret_cast<char*>(&timeout_ms),
+                 sizeof(timeout_ms), out, out_cap, out_len);
 }
 
 int64_t tcp_store_delete(int fd, const char* key, uint32_t klen) {
   return request(fd, 4, key, klen, nullptr, 0, nullptr, 0, nullptr);
+}
+
+int64_t tcp_store_delete_prefix(int fd, const char* key, uint32_t klen) {
+  return request(fd, 6, key, klen, nullptr, 0, nullptr, 0, nullptr);
 }
 
 int64_t tcp_store_ping(int fd) {

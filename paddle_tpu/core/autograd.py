@@ -506,7 +506,9 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
     grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
     results = []
     for t, g in zip(inputs, grads):
-        if id(t) not in reachable:
+        # stop_gradient inputs get no gradient, matching the first-order
+        # path (the replay would otherwise happily differentiate them)
+        if id(t) not in reachable or t.stop_gradient:
             if not allow_unused:
                 raise RuntimeError(
                     "one of the input tensors received no gradient; pass "

@@ -76,23 +76,42 @@ class ElasticManager:
 
 def launch(script: str, script_args: Optional[List[str]] = None,
            nproc_per_node: int = 1, master: Optional[str] = None,
-           max_restarts: int = 0, log_dir: Optional[str] = None) -> int:
+           max_restarts: int = 0, log_dir: Optional[str] = None,
+           node_rank: int = 0, nnodes: int = 1) -> int:
     """Spawn ``nproc_per_node`` trainer processes with reference-compatible
-    env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER), a
-    TCPStore master in this launcher, and restart-on-failure up to
-    ``max_restarts`` (elastic relaunch)."""
+    env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER) and
+    restart-on-failure up to ``max_restarts`` (elastic relaunch).
+
+    Single-node (``master=None``): this launcher hosts the TCPStore.
+    Multi-node: ``master`` is ``host:port``; the ``node_rank == 0`` launcher
+    binds the store at that port, every other node connects to it as a
+    client, so all trainers rendezvous against ONE store. Trainer ranks are
+    GLOBAL: ``node_rank * nproc_per_node + local`` out of
+    ``nnodes * nproc_per_node``.
+    """
     script_args = script_args or []
-    store = TCPStore(is_master=True, world_size=nproc_per_node)
-    master_addr = master or f"127.0.0.1:{store.port}"
+    world_size = nnodes * nproc_per_node
+    if master is None:
+        store = TCPStore(is_master=True, world_size=world_size)
+        master_addr = f"127.0.0.1:{store.port}"
+    else:
+        master_addr = master
+        mhost, mport = master.rsplit(":", 1)
+        store = TCPStore(host=mhost, port=int(mport),
+                         is_master=(node_rank == 0),
+                         world_size=world_size)
     attempts = 0
     while True:
         procs = []
         logs = []
-        for rank in range(nproc_per_node):
+        for local in range(nproc_per_node):
+            rank = node_rank * nproc_per_node + local
             env = dict(os.environ)
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_TRAINERS_NUM": str(nproc_per_node),
+                "PADDLE_TRAINERS_NUM": str(world_size),
+                "PADDLE_LOCAL_RANK": str(local),
+                "PADDLE_NODE_RANK": str(node_rank),
                 "PADDLE_MASTER": master_addr,
                 "PADDLE_STORE_PORT": str(store.port),
             })
@@ -114,9 +133,14 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         attempts += 1
         if attempts > max_restarts:
             return next(c for c in codes if c != 0)
-        # elastic relaunch: clear heartbeat keys and go again
-        for r in range(nproc_per_node):
-            store.delete_key(f"__hb/{r}")
+        # elastic relaunch: clear ALL rendezvous state (heartbeats AND
+        # barrier/done keys — stale barriers would let restarted trainers
+        # fall through before their peers re-register). Only the master
+        # node clears: a non-master launcher wiping the shared store would
+        # break barriers other nodes' live trainers are mid-wait on.
+        if node_rank == 0:
+            store.delete_prefix("__hb/")
+            store.delete_prefix("__barrier/")
 
 
 def main(argv=None):
@@ -127,11 +151,14 @@ def main(argv=None):
     parser.add_argument("--master", type=str, default=None)
     parser.add_argument("--max_restarts", type=int, default=0)
     parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--nnodes", type=int, default=1)
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.script, args.script_args, args.nproc_per_node,
-                  args.master, args.max_restarts, args.log_dir)
+                  args.master, args.max_restarts, args.log_dir,
+                  args.node_rank, args.nnodes)
 
 
 if __name__ == "__main__":

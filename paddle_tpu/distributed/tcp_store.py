@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import socket
 import subprocess
 import threading
 import time
@@ -65,10 +66,16 @@ def _load_lib():
         lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                       ctypes.c_uint32, ctypes.c_int64]
         lib.tcp_store_wait.restype = ctypes.c_int64
-        lib.tcp_store_wait.argtypes = lib.tcp_store_get.argtypes
+        lib.tcp_store_wait.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                       ctypes.c_uint32, ctypes.c_int64,
+                                       ctypes.c_char_p, ctypes.c_uint32,
+                                       u32p]
         lib.tcp_store_delete.restype = ctypes.c_int64
         lib.tcp_store_delete.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                          ctypes.c_uint32]
+        lib.tcp_store_delete_prefix.restype = ctypes.c_int64
+        lib.tcp_store_delete_prefix.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32]
         lib.tcp_store_ping.restype = ctypes.c_int64
         lib.tcp_store_ping.argtypes = [ctypes.c_int]
         _lib = lib
@@ -77,7 +84,12 @@ def _load_lib():
 
 class TCPStore:
     """paddle.distributed.TCPStore parity: the master hosts the table,
-    everyone (master included) talks to it over a client socket."""
+    everyone (master included) talks to it over a client socket.
+
+    Thread-safe: each Python thread gets its own connection (a single shared
+    socket would interleave request bytes — ctypes releases the GIL during
+    the native call — and a blocking ``wait`` would starve heartbeats).
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  is_master: bool = False, world_size: int = 1,
@@ -87,6 +99,9 @@ class TCPStore:
         self._server = None
         self.host = host
         self.world_size = world_size
+        self._local = threading.local()
+        self._fds_lock = threading.Lock()
+        self._fds: list = []
         if is_master:
             out_port = ctypes.c_uint16(0)
             self._server = lib.tcp_store_server_start(
@@ -97,16 +112,40 @@ class TCPStore:
         self.port = port
         deadline = time.monotonic() + timeout
         while True:
-            self._fd = lib.tcp_store_connect(host.encode(),
-                                             ctypes.c_uint16(port))
-            if self._fd >= 0:
+            try:
+                fd = self._connect()
                 break
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"could not reach TCPStore at {host}:{port}")
-            time.sleep(0.05)
-        if lib.tcp_store_ping(self._fd) != 0:
+            except ConnectionError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"could not reach TCPStore at {host}:{port}")
+                time.sleep(0.05)
+        if lib.tcp_store_ping(fd) != 0:
             raise RuntimeError("TCPStore ping failed")
+
+    def _connect(self) -> int:
+        # the native client takes numeric IPv4 only (inet_pton); resolve
+        # hostnames here so master='node0.cluster:port' works
+        try:
+            ip = socket.gethostbyname(self.host)
+        except OSError:
+            ip = self.host
+        fd = self._lib.tcp_store_connect(ip.encode(),
+                                         ctypes.c_uint16(self.port))
+        if fd < 0:
+            raise ConnectionError(
+                f"could not reach TCPStore at {self.host}:{self.port}")
+        self._local.fd = fd
+        with self._fds_lock:
+            self._fds.append(fd)
+        return fd
+
+    @property
+    def _fd(self) -> int:
+        fd = getattr(self._local, "fd", None)
+        if fd is None:
+            fd = self._connect()
+        return fd
 
     # -- KV API ---------------------------------------------------------------
     def set(self, key: str, value) -> None:
@@ -117,15 +156,19 @@ class TCPStore:
 
     def get(self, key: str) -> Optional[bytes]:
         k = key.encode()
-        buf = ctypes.create_string_buffer(1 << 20)
-        n = ctypes.c_uint32(0)
-        status = self._lib.tcp_store_get(self._fd, k, len(k), buf,
-                                         len(buf), ctypes.byref(n))
-        if status == -1:
-            return None
-        if status < -1:
-            raise RuntimeError("TCPStore get failed")
-        return buf.raw[: n.value]
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = ctypes.c_uint32(0)
+            status = self._lib.tcp_store_get(self._fd, k, len(k), buf,
+                                             cap, ctypes.byref(n))
+            if status == -1:
+                return None
+            if status < -1:
+                raise RuntimeError("TCPStore get failed")
+            if n.value <= cap:
+                return buf.raw[: n.value]
+            cap = n.value  # value larger than the buffer: refetch full size
 
     def add(self, key: str, amount: int = 1) -> int:
         k = key.encode()
@@ -135,24 +178,47 @@ class TCPStore:
         return int(res)
 
     def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
-        """Block until the key exists; returns its value."""
+        """Block until the key exists; returns its value. Raises
+        TimeoutError after ``timeout`` seconds (None = wait forever)."""
         k = key.encode()
-        buf = ctypes.create_string_buffer(1 << 20)
-        n = ctypes.c_uint32(0)
-        status = self._lib.tcp_store_wait(self._fd, k, len(k), buf,
-                                          len(buf), ctypes.byref(n))
-        if status != 0:
-            raise RuntimeError("TCPStore wait failed")
-        return buf.raw[: n.value]
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        cap = 1 << 16
+        while True:
+            tmo = -1 if deadline is None else \
+                max(0, int((deadline - time.monotonic()) * 1000))
+            buf = ctypes.create_string_buffer(cap)
+            n = ctypes.c_uint32(0)
+            status = self._lib.tcp_store_wait(self._fd, k, len(k), tmo, buf,
+                                              cap, ctypes.byref(n))
+            if status == -3:
+                raise TimeoutError(
+                    f"TCPStore wait('{key}') timed out after {timeout}s")
+            if status != 0:
+                raise RuntimeError("TCPStore wait failed")
+            if n.value <= cap:
+                return buf.raw[: n.value]
+            big = self.get(key)  # value larger than buffer: refetch in full
+            if big is not None:
+                return big
+            # key deleted between wait and refetch — wait again
 
     def delete_key(self, key: str) -> bool:
         k = key.encode()
         return self._lib.tcp_store_delete(self._fd, k, len(k)) > 0
 
+    def delete_prefix(self, prefix: str) -> int:
+        """Erase every key starting with ``prefix``; returns the count."""
+        k = prefix.encode()
+        res = self._lib.tcp_store_delete_prefix(self._fd, k, len(k))
+        if res <= -1000:
+            raise RuntimeError("TCPStore delete_prefix failed")
+        return int(res)
+
     def __del__(self):
         try:
-            if getattr(self, "_fd", -1) >= 0:
-                self._lib.tcp_store_close(self._fd)
+            for fd in getattr(self, "_fds", []):
+                self._lib.tcp_store_close(fd)
             if getattr(self, "_server", None):
                 self._lib.tcp_store_server_stop(self._server)
         except Exception:

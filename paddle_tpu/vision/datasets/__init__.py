@@ -48,13 +48,15 @@ class MNIST(Dataset):
         "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
         "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
     }
+    _cache_name = "mnist"
 
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=False, backend=None,
                  root=None):
         self.transform = transform
         if image_path is None or label_path is None:
-            root = root or os.path.expanduser("~/.cache/paddle_tpu/mnist")
+            root = root or os.path.expanduser(
+                f"~/.cache/paddle_tpu/{self._cache_name}")
             img_name, lbl_name = self._files[mode]
             image_path = self._find(root, img_name)
             label_path = self._find(root, lbl_name)
@@ -107,7 +109,6 @@ class MNIST(Dataset):
 
 
 class FashionMNIST(MNIST):
-    _files = {
-        "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
-        "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
-    }
+    """Same idx file format as MNIST but a distinct cache directory, so a
+    default-root FashionMNIST() can never silently pick up MNIST digits."""
+    _cache_name = "fashion-mnist"

@@ -116,3 +116,16 @@ class TestReviewRegressions:
         y.backward(retain_graph=True)
         (g,) = pt.grad(y, [x], create_graph=True)
         np.testing.assert_allclose(g.numpy(), [4.0], rtol=1e-6)
+
+
+class TestStopGradientInputs:
+    def test_create_graph_respects_stop_gradient(self):
+        import numpy as np
+        import paddle_tpu as pt
+        x = pt.to_tensor(np.float32(3.0), stop_gradient=True)
+        w = pt.to_tensor(np.float32(2.0), stop_gradient=False)
+        y = x * w
+        with pytest.raises(RuntimeError):
+            pt.grad(y, [x], create_graph=True)
+        (gx,) = pt.grad(y, [x], create_graph=True, allow_unused=True)
+        assert gx is None

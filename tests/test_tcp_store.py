@@ -142,3 +142,79 @@ class TestLauncher:
         dead = m0.dead_ranks()
         assert dead == [1]
         m0.stop()
+
+
+class TestRobustness:
+    """Regressions: wait timeout, oversized values, prefix delete, shared
+    store across threads, clean server shutdown with a blocked waiter."""
+
+    def test_wait_timeout_raises(self, master):
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            master.wait("never_set_key", timeout=0.3)
+        assert time.monotonic() - t0 < 5
+
+    def test_wait_with_timeout_returns_value_when_set(self, master):
+        master.set("tmo_key", b"v")
+        assert master.wait("tmo_key", timeout=5) == b"v"
+
+    def test_large_value_roundtrip(self, master):
+        # > the 64 KiB first-try client buffer AND > the old 1 MiB cap
+        blob = bytes(range(256)) * (9 * 1024)  # 2.25 MiB
+        master.set("big", blob)
+        assert master.get("big") == blob
+        assert master.wait("big", timeout=5) == blob
+
+    def test_delete_prefix(self, master):
+        for i in range(5):
+            master.set(f"pfx/{i}", str(i))
+        master.set("pfx_other", "keep")
+        assert master.delete_prefix("pfx/") == 5
+        assert master.get("pfx/0") is None
+        assert master.get("pfx_other") == b"keep"
+
+    def test_shared_store_across_threads(self, master):
+        # one TCPStore object used concurrently from many threads (the
+        # ElasticManager heartbeat pattern) — per-thread sockets must not
+        # interleave wire bytes
+        store = TCPStore(host="127.0.0.1", port=master.port)
+        errors = []
+
+        def hammer(tid):
+            try:
+                for i in range(100):
+                    store.set(f"thr/{tid}", f"{tid}:{i}")
+                    v = store.get(f"thr/{tid}")
+                    assert v is not None and v.decode().startswith(f"{tid}:")
+                    store.add("thr_cnt", 1)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert store.add("thr_cnt", 0) == 400
+
+    def test_server_stop_with_blocked_waiter(self):
+        # destroying the master while a client is parked in WAIT must not
+        # crash/UAF; the waiter gets an error, not garbage
+        srv = TCPStore(is_master=True)
+        port = srv.port
+        out = {}
+
+        def waiter():
+            c = TCPStore(host="127.0.0.1", port=port)
+            try:
+                c.wait("never")
+            except Exception as e:
+                out["err"] = e
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        del srv  # joins client threads, wakes the waiter
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert "err" in out

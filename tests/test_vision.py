@@ -129,3 +129,12 @@ class TestDatasets:
         img, y = ds[3]
         np.testing.assert_array_equal(img, data[3])
         assert y == 3
+
+
+def test_fashion_mnist_uses_distinct_cache_dir():
+    """FashionMNIST() must never silently load MNIST digits from the MNIST
+    cache — its default root is a separate directory."""
+    from paddle_tpu.vision.datasets import MNIST, FashionMNIST
+    assert MNIST._cache_name != FashionMNIST._cache_name
+    with pytest.raises(FileNotFoundError, match="fashion-mnist"):
+        FashionMNIST(root=None, mode="test")
