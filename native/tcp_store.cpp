@@ -106,14 +106,17 @@ void serve_client(Store* store, ClientSlot* slot) {
         ok = send_response(fd, 0, "");
         break;
       }
-      case 1: {  // GET
-        std::lock_guard<std::mutex> g(store->mu);
-        auto it = store->kv.find(key);
-        if (it == store->kv.end()) {
-          ok = send_response(fd, -1, "");
-        } else {
-          ok = send_response(fd, 0, it->second);
+      case 1: {  // GET — copy out under the lock, send after releasing it
+        // (a stalled reader must not block the store for everyone else)
+        bool found;
+        std::string out;
+        {
+          std::lock_guard<std::mutex> g(store->mu);
+          auto it = store->kv.find(key);
+          found = it != store->kv.end();
+          if (found) out = it->second;
         }
+        ok = found ? send_response(fd, 0, out) : send_response(fd, -1, "");
         break;
       }
       case 2: {  // ADD: value holds an i64 delta; missing key starts at 0
@@ -140,24 +143,26 @@ void serve_client(Store* store, ClientSlot* slot) {
         int64_t timeout_ms = -1;
         if (value.size() == sizeof(timeout_ms))
           std::memcpy(&timeout_ms, value.data(), sizeof(timeout_ms));
-        std::unique_lock<std::mutex> g(store->mu);
-        auto pred = [&] {
-          return store->stopping || store->kv.count(key) > 0;
-        };
-        bool found;
-        if (timeout_ms < 0) {
-          store->cv.wait(g, pred);
-          found = store->kv.count(key) > 0;
-        } else {
-          found = store->cv.wait_for(
-                      g, std::chrono::milliseconds(timeout_ms), pred) &&
-                  store->kv.count(key) > 0;
+        bool found, stopping;
+        std::string out;
+        {
+          std::unique_lock<std::mutex> g(store->mu);
+          auto pred = [&] {
+            return store->stopping || store->kv.count(key) > 0;
+          };
+          if (timeout_ms < 0) {
+            store->cv.wait(g, pred);
+            found = store->kv.count(key) > 0;
+          } else {
+            found = store->cv.wait_for(
+                        g, std::chrono::milliseconds(timeout_ms), pred) &&
+                    store->kv.count(key) > 0;
+          }
+          if (found) out = store->kv[key];
+          stopping = store->stopping;
         }
-        if (found) {
-          ok = send_response(fd, 0, store->kv[key]);
-        } else {
-          ok = send_response(fd, store->stopping ? -4 : -3, "");
-        }
+        ok = found ? send_response(fd, 0, out)
+                   : send_response(fd, stopping ? -4 : -3, "");
         break;
       }
       case 4: {  // DELETE
@@ -260,9 +265,12 @@ void* tcp_store_server_start(uint16_t port, uint16_t* out_port) {
 void tcp_store_server_stop(void* handle) {
   auto* srv = static_cast<Server*>(handle);
   if (!srv) return;
+  // shutdown unblocks accept(); close only AFTER the join so the kernel
+  // cannot recycle the descriptor number into an unrelated socket the
+  // accept loop would then operate on
   ::shutdown(srv->listen_fd, SHUT_RDWR);
-  ::close(srv->listen_fd);
   if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  ::close(srv->listen_fd);
   // wake WAITers, unblock reads, and join every client thread before the
   // Store (mutex/condvar) is destroyed — detached threads would race the
   // delete below (use-after-free)

@@ -88,6 +88,13 @@ def launch(script: str, script_args: Optional[List[str]] = None,
     client, so all trainers rendezvous against ONE store. Trainer ranks are
     GLOBAL: ``node_rank * nproc_per_node + local`` out of
     ``nnodes * nproc_per_node``.
+
+    Elastic restarts are coordinated cluster-wide through a shared
+    ``__restart_epoch`` counter: any launcher whose local trainers fail
+    bumps it; every launcher polls it and restarts its trainers when it
+    moves. Rendezvous keys (store barriers) are namespaced by the epoch
+    (PADDLE_RESTART_EPOCH), so an attempt can never consume a previous
+    attempt's stale keys — no cross-node key deletion is needed.
     """
     script_args = script_args or []
     world_size = nnodes * nproc_per_node
@@ -100,7 +107,7 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         store = TCPStore(host=mhost, port=int(mport),
                          is_master=(node_rank == 0),
                          world_size=world_size)
-    attempts = 0
+    epoch = int(store.add("__restart_epoch", 0))
     while True:
         procs = []
         logs = []
@@ -114,6 +121,7 @@ def launch(script: str, script_args: Optional[List[str]] = None,
                 "PADDLE_NODE_RANK": str(node_rank),
                 "PADDLE_MASTER": master_addr,
                 "PADDLE_STORE_PORT": str(store.port),
+                "PADDLE_RESTART_EPOCH": str(epoch),
             })
             if log_dir:
                 os.makedirs(log_dir, exist_ok=True)
@@ -125,22 +133,50 @@ def launch(script: str, script_args: Optional[List[str]] = None,
             procs.append(subprocess.Popen(
                 [sys.executable, script, *script_args], env=env,
                 stdout=out, stderr=subprocess.STDOUT if out else None))
-        codes = [p.wait() for p in procs]
+
+        # supervise: watch local procs AND the cluster restart epoch
+        fail_code = None
+        while True:
+            codes = [p.poll() for p in procs]
+            if any(c not in (None, 0) for c in codes):
+                fail_code = next(c for c in codes if c not in (None, 0))
+                # signal the whole cluster (idempotent-enough: concurrent
+                # failers over-bump, launchers re-read the counter below)
+                if int(store.add("__restart_epoch", 0)) == epoch:
+                    store.add("__restart_epoch", 1)
+                break
+            if all(c == 0 for c in codes):
+                break
+            if int(store.add("__restart_epoch", 0)) > epoch:
+                break  # another node requested a restart
+            time.sleep(0.2)
+
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            p.wait()
         for lf in logs:
             lf.close()
-        if all(c == 0 for c in codes):
-            return 0
-        attempts += 1
-        if attempts > max_restarts:
-            return next(c for c in codes if c != 0)
-        # elastic relaunch: clear ALL rendezvous state (heartbeats AND
-        # barrier/done keys — stale barriers would let restarted trainers
-        # fall through before their peers re-register). Only the master
-        # node clears: a non-master launcher wiping the shared store would
-        # break barriers other nodes' live trainers are mid-wait on.
-        if node_rank == 0:
-            store.delete_prefix("__hb/")
-            store.delete_prefix("__barrier/")
+
+        new_epoch = int(store.add("__restart_epoch", 0))
+        if fail_code is None and new_epoch == epoch:
+            # clean local exit — but a peer may still fail and request a
+            # restart; leaving now would also tear down the master store
+            # under the cluster. Publish done and leave only when every
+            # node finished this epoch cleanly (or a restart is requested).
+            store.set(f"__done/{epoch}/{node_rank}", b"1")
+            while True:
+                new_epoch = int(store.add("__restart_epoch", 0))
+                if new_epoch != epoch:
+                    break
+                if all(store.get(f"__done/{epoch}/{n}") is not None
+                       for n in range(nnodes)):
+                    return 0
+                time.sleep(0.2)
+        if new_epoch > max_restarts:
+            return fail_code if fail_code is not None else 1
+        epoch = new_epoch
 
 
 def main(argv=None):

@@ -101,7 +101,7 @@ class TCPStore:
         self.world_size = world_size
         self._local = threading.local()
         self._fds_lock = threading.Lock()
-        self._fds: list = []
+        self._fds: dict = {}  # thread ident -> fd
         if is_master:
             out_port = ctypes.c_uint16(0)
             self._server = lib.tcp_store_server_start(
@@ -137,7 +137,12 @@ class TCPStore:
                 f"could not reach TCPStore at {self.host}:{self.port}")
         self._local.fd = fd
         with self._fds_lock:
-            self._fds.append(fd)
+            # reap connections whose owning thread has exited, so churning
+            # threads (elastic restarts, loader workers) don't leak sockets
+            live = {t.ident for t in threading.enumerate()}
+            for ident in [i for i in self._fds if i not in live]:
+                self._lib.tcp_store_close(self._fds.pop(ident))
+            self._fds[threading.get_ident()] = fd
         return fd
 
     @property
@@ -217,7 +222,7 @@ class TCPStore:
 
     def __del__(self):
         try:
-            for fd in getattr(self, "_fds", []):
+            for fd in getattr(self, "_fds", {}).values():
                 self._lib.tcp_store_close(fd)
             if getattr(self, "_server", None):
                 self._lib.tcp_store_server_stop(self._server)
@@ -227,8 +232,13 @@ class TCPStore:
 
 def barrier_via_store(store: TCPStore, name: str, world_size: int) -> None:
     """Reference-pattern store barrier: everyone increments, then waits for
-    the count to reach world_size (parallel.py's init barrier)."""
-    arrived = store.add(f"__barrier/{name}", 1)
+    the count to reach world_size (parallel.py's init barrier).
+
+    Keys are namespaced by the elastic restart epoch (PADDLE_RESTART_EPOCH,
+    injected by the launcher), so trainers restarted after a failure can
+    never fall through a previous attempt's stale done-key."""
+    epoch = os.environ.get("PADDLE_RESTART_EPOCH", "0")
+    arrived = store.add(f"__barrier/{epoch}/{name}", 1)
     if arrived == world_size:
-        store.set(f"__barrier/{name}/done", b"1")
-    store.wait(f"__barrier/{name}/done")
+        store.set(f"__barrier/{epoch}/{name}/done", b"1")
+    store.wait(f"__barrier/{epoch}/{name}/done")

@@ -1,0 +1,37 @@
+"""paddle.sparse parity — COO/CSR sparse tensors on the TPU stack.
+
+Reference surface: ``python/paddle/sparse/__init__.py`` (creation/unary/
+binary ops), ``paddle/phi/core/sparse_coo_tensor.h`` / ``sparse_csr_tensor.h``
+(the tensor types), ``phi/kernels/sparse/`` (kernels).
+
+TPU design notes: XLA has no native sparse storage, so the hot path keeps the
+MXU-friendly shape — ``matmul``/``mv`` lower to gather + segment-sum (a
+scatter-add matmul XLA tiles well), never to a per-element scalar loop.
+Pattern-changing steps with data-dependent sizes (``coalesce``, dense→sparse)
+run their *index* arithmetic on host numpy (eager values are concrete) and
+route the *value* arithmetic through the autograd tape, so every sparse op is
+differentiable w.r.t. ``values``.
+"""
+from .creation import (  # noqa: F401
+    sparse_coo_tensor, sparse_csr_tensor, SparseCooTensor, SparseCsrTensor,
+)
+from .unary import (  # noqa: F401
+    sin, tan, asin, atan, sinh, tanh, asinh, atanh, sqrt, square, log1p,
+    abs, pow, cast, neg, deg2rad, rad2deg, expm1, coalesce, transpose,
+    reshape,
+)
+from .binary import (  # noqa: F401
+    add, subtract, multiply, divide, matmul, masked_matmul, mv, addmm,
+    is_same_shape,
+)
+from . import nn  # noqa: F401
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor",
+    "SparseCooTensor", "SparseCsrTensor",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "cast", "neg",
+    "deg2rad", "rad2deg", "expm1", "coalesce", "transpose", "reshape",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "mv", "addmm", "is_same_shape", "nn",
+]

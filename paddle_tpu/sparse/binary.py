@@ -1,0 +1,139 @@
+"""Sparse binary ops.
+
+Reference: ``python/paddle/sparse/binary.py`` (matmul:103, masked_matmul:174,
+mv:241, addmm:316, add/subtract/multiply/divide) over
+``phi/kernels/sparse/{elementwise_*,matmul_*}`` kernels.
+
+TPU shape of the math: sp @ dense = gather rows of ``dense`` at the sparse
+column coords, scale by values, segment-sum into output rows — a form XLA
+lowers to MXU-friendly gathers + scatter-adds with no host loop.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+
+from .creation import SparseCooTensor, SparseCsrTensor, coalesce_
+
+__all__ = ["add", "subtract", "multiply", "divide", "matmul",
+           "masked_matmul", "mv", "addmm", "is_same_shape"]
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _to_coo(x):
+    return x.to_sparse_coo() if isinstance(x, SparseCsrTensor) else x
+
+
+def _union_ew(x, y, sign, name):
+    """COO+COO elementwise with union pattern: concat then coalesce —
+    the reference's ElementWiseAddCooKernel semantics."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    x, y = _to_coo(x), _to_coo(y)
+    if not is_same_shape(x, y):
+        raise ValueError(f"sparse {name}: shapes differ {x.shape} vs "
+                         f"{y.shape}")
+    idx = np.concatenate([np.asarray(x.indices().data),
+                          np.asarray(y.indices().data)], axis=1)
+
+    def combine(xv, yv):
+        import jax.numpy as jnp
+        return jnp.concatenate([xv, sign * yv], axis=0)
+    vals = apply_op(combine, x.values(), y.values(),
+                    op_name=f"sparse_{name}")
+    out = coalesce_(SparseCooTensor(idx, vals, x.shape))
+    return out.to_sparse_csr() if was_csr else out
+
+
+def add(x, y, name=None):
+    return _union_ew(x, y, 1, "add")
+
+
+def subtract(x, y, name=None):
+    return _union_ew(x, y, -1, "subtract")
+
+
+def _same_pattern(x, y):
+    return x.nnz() == y.nnz() and np.array_equal(
+        np.asarray(x.indices().data), np.asarray(y.indices().data))
+
+
+def _pattern_ew(x, y, jnp_op, name):
+    """multiply/divide: defined on matching nonzero patterns (the
+    reference's elementwise kernels also require same-shape same-pattern
+    operands for these)."""
+    was_csr = isinstance(x, SparseCsrTensor)
+    x, y = coalesce_(_to_coo(x)), coalesce_(_to_coo(y))
+    if not _same_pattern(x, y):
+        raise ValueError(
+            f"sparse {name} requires matching nonzero patterns")
+
+    def fn(xv, yv):
+        import jax.numpy as jnp
+        return getattr(jnp, jnp_op)(xv, yv)
+    vals = apply_op(fn, x.values(), y.values(), op_name=f"sparse_{name}")
+    out = SparseCooTensor(x.indices(), vals, x.shape)
+    return out.to_sparse_csr() if was_csr else out
+
+
+def multiply(x, y, name=None):
+    return _pattern_ew(x, y, "multiply", "multiply")
+
+
+def divide(x, y, name=None):
+    return _pattern_ew(x, y, "divide", "divide")
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (2-D; the reference's primary spmm path)."""
+    coo = coalesce_(_to_coo(x))
+    if coo.sparse_dim != 2 or coo.dense_dim != 0:
+        raise NotImplementedError("sparse matmul supports 2-D operands")
+    rows, cols = (np.asarray(coo.indices().data[i]) for i in (0, 1))
+    m = coo.shape[0]
+
+    def spmm(values, dense):
+        import jax
+        # out[r, :] += v * dense[c, :]  — gather + segment-sum
+        contrib = values[:, None] * dense[cols]
+        return jax.ops.segment_sum(contrib, rows, num_segments=m)
+    return apply_op(spmm, coo.values(), y, op_name="sparse_matmul")
+
+
+def mv(x, vec, name=None):
+    """sparse @ vector -> vector."""
+    coo = coalesce_(_to_coo(x))
+    rows, cols = (np.asarray(coo.indices().data[i]) for i in (0, 1))
+    m = coo.shape[0]
+
+    def spmv(values, v):
+        import jax
+        return jax.ops.segment_sum(values * v[cols], rows, num_segments=m)
+    return apply_op(spmv, coo.values(), vec, op_name="sparse_mv")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask, name=None):
+    """(dense @ dense) sampled at ``mask``'s nonzero pattern (SDDMM)."""
+    coo = _to_coo(mask)
+    rows, cols = (np.asarray(coo.indices().data[i]) for i in (0, 1))
+
+    def sddmm(a, b):
+        # values[k] = a[rows[k], :] . b[:, cols[k]]
+        return (a[rows] * b.T[cols]).sum(axis=-1)
+    vals = apply_op(sddmm, x, y, op_name="sparse_masked_matmul")
+    out = SparseCooTensor(coo.indices(), vals,
+                          (x.shape[0], y.shape[1]))
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) else out
+
+
+def addmm(input: Tensor, x, y: Tensor, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (sparse x @ dense y)."""
+    prod = matmul(x, y)
+
+    def axpy(inp, p):
+        return beta * inp + alpha * p
+    return apply_op(axpy, input, prod, op_name="sparse_addmm")

@@ -1,0 +1,291 @@
+"""Sparse tensor types + creation ops.
+
+Reference: ``python/paddle/sparse/creation.py`` (``sparse_coo_tensor:62``,
+``sparse_csr_tensor:143``), ``paddle/phi/core/sparse_coo_tensor.h:30`` and
+``sparse_csr_tensor.h:30`` (non_zero_indices/non_zero_elements layout).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor"]
+
+
+def _as_index_tensor(x):
+    # int32 coords: TPU-native index width (int64 would truncate anyway
+    # without jax x64 mode)
+    if isinstance(x, Tensor):
+        return Tensor(x.data.astype("int32"), stop_gradient=True)
+    return Tensor(np.asarray(x, dtype=np.int32), stop_gradient=True)
+
+
+def _as_value_tensor(x, dtype=None, stop_gradient=True):
+    was_tensor = isinstance(x, Tensor)
+    t = x if was_tensor else pt.to_tensor(np.asarray(x))
+    if dtype is not None:
+        t = t.astype(dtype)
+    # a passed-in Tensor keeps its own trainability (the default
+    # stop_gradient=True must not silently detach it from the tape);
+    # stop_gradient=False always enables grads
+    if not was_tensor or stop_gradient is False:
+        t.stop_gradient = stop_gradient
+    return t
+
+
+class SparseCooTensor:
+    """COO: ``indices`` [sparse_dim, nnz] int64 + ``values`` [nnz, *dense_dims].
+
+    ``values`` lives on the autograd tape; ``indices`` are always
+    stop-gradient (integer pattern)."""
+
+    def __init__(self, indices: Tensor, values: Tensor, shape):
+        self._indices = _as_index_tensor(indices)
+        self._values = values if isinstance(values, Tensor) else \
+            _as_value_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    # -- structure ------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def sparse_dim(self):
+        return int(self._indices.data.shape[0])
+
+    @property
+    def dense_dim(self):
+        return self.ndim - self.sparse_dim
+
+    def nnz(self):
+        return int(self._indices.data.shape[1])
+
+    def indices(self) -> Tensor:
+        return self._indices
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    # -- conversion -----------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        idx = tuple(np.asarray(self._indices.data))  # static pattern
+        shape = self._shape
+
+        def scatter(values):
+            import jax.numpy as jnp
+            # indexing with the sparse coords addresses [nnz, *dense_dims];
+            # .add (not .set) so un-coalesced duplicates sum like the ref
+            return jnp.zeros(shape, values.dtype).at[idx].add(values)
+        return apply_op(scatter, self._values, op_name="sparse_to_dense")
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if self.sparse_dim != 2 or self.dense_dim != 0:
+            raise ValueError("to_sparse_csr supports 2-D COO tensors")
+        coo = coalesce_(self)
+        rows = np.asarray(coo._indices.data[0])
+        crows = np.zeros(self._shape[0] + 1, dtype=np.int64)
+        np.add.at(crows[1:], rows, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(crows, coo._indices[1], coo._values,
+                               self._shape)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return coalesce_(self)
+
+    def astype(self, dtype) -> "SparseCooTensor":
+        return SparseCooTensor(self._indices, self._values.astype(dtype),
+                               self._shape)
+
+    def numpy(self):
+        return np.asarray(self.to_dense().data)
+
+    def backward(self, *args, **kwargs):
+        return self._values.backward(*args, **kwargs)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})\n  indices=\n{self._indices}\n"
+                f"  values=\n{self._values}")
+
+
+class SparseCsrTensor:
+    """CSR: ``crows`` [M+1], ``cols`` [nnz], ``values`` [nnz] (2-D only,
+    matching the reference's primary use)."""
+
+    def __init__(self, crows: Tensor, cols: Tensor, values: Tensor, shape):
+        self._crows = _as_index_tensor(crows)
+        self._cols = _as_index_tensor(cols)
+        self._values = values if isinstance(values, Tensor) else \
+            _as_value_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("SparseCsrTensor supports 2-D tensors")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def ndim(self):
+        return 2
+
+    def nnz(self):
+        return int(self._cols.data.shape[0])
+
+    def crows(self) -> Tensor:
+        return self._crows
+
+    def cols(self) -> Tensor:
+        return self._cols
+
+    def values(self) -> Tensor:
+        return self._values
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _row_ids(self) -> np.ndarray:
+        crows = np.asarray(self._crows.data)
+        return np.repeat(np.arange(self._shape[0], dtype=np.int64),
+                         np.diff(crows))
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        rows = self._row_ids()
+        cols = np.asarray(self._cols.data)
+        idx = np.stack([rows, cols])
+        return SparseCooTensor(idx, self._values, self._shape)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(self.to_dense().data)
+
+    def backward(self, *args, **kwargs):
+        return self._values.backward(*args, **kwargs)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def coalesce_(sp: SparseCooTensor) -> SparseCooTensor:
+    """Sort + merge duplicate coordinates (reference:
+    ``phi/kernels/sparse/coalesce_kernel.cc``). Index bookkeeping is host
+    numpy (data-dependent nnz); value merging is a differentiable
+    segment-sum."""
+    idx = np.asarray(sp._indices.data)
+    if idx.shape[1] == 0:
+        return sp
+    flat = np.ravel_multi_index(idx, sp._shape[: sp.sparse_dim])
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    n = len(uniq)
+    new_idx = np.stack(np.unravel_index(uniq, sp._shape[: sp.sparse_dim]))
+
+    def merge(values):
+        import jax
+        return jax.ops.segment_sum(values, inverse, num_segments=n)
+    vals = apply_op(merge, sp._values, op_name="sparse_coalesce")
+    return SparseCooTensor(new_idx, vals, sp._shape)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    """paddle.sparse.sparse_coo_tensor parity (creation.py:62)."""
+    indices = _as_index_tensor(indices)
+    values = _as_value_tensor(values, dtype, stop_gradient)
+    idx = np.asarray(indices.data)
+    if idx.ndim != 2:
+        raise ValueError("indices must be [sparse_dim, nnz]")
+    if shape is None:
+        sparse_shape = (idx.max(axis=1) + 1) if idx.shape[1] else \
+            np.zeros(idx.shape[0], dtype=np.int64)
+        shape = tuple(int(s) for s in sparse_shape) + \
+            tuple(values.shape[1:])
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    """paddle.sparse.sparse_csr_tensor parity (creation.py:143)."""
+    return SparseCsrTensor(crows, cols,
+                           _as_value_tensor(values, dtype, stop_gradient),
+                           shape)
+
+
+def _dense_to_coo(t: Tensor, sparse_dim=None) -> SparseCooTensor:
+    """Tensor.to_sparse_coo: host-side pattern discovery + differentiable
+    value gather."""
+    arr = np.asarray(t.data)
+    nd = arr.ndim
+    sparse_dim = nd if sparse_dim is None else int(sparse_dim)
+    reduced = arr
+    if sparse_dim < nd:
+        reduced = np.abs(arr).sum(axis=tuple(range(sparse_dim, nd)))
+    idx = np.stack(np.nonzero(reduced)).astype(np.int64)
+    gather_idx = tuple(idx)
+
+    def gather(dense):
+        return dense[gather_idx]
+    vals = apply_op(gather, t, op_name="dense_to_sparse")
+    return SparseCooTensor(idx, vals, arr.shape)
+
+
+def _dense_to_csr(t: Tensor) -> SparseCsrTensor:
+    return _dense_to_coo(t).to_sparse_csr()
+
+
+# install conversion methods on the dense Tensor (the reference patches
+# these onto its Tensor: python/paddle/fluid/dygraph/varbase_patch_methods.py)
+Tensor.to_sparse_coo = _dense_to_coo
+Tensor.to_sparse_csr = _dense_to_csr

@@ -1,0 +1,10 @@
+"""paddle.sparse.nn parity (reference: ``python/paddle/sparse/nn/``)."""
+from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    ReLU, ReLU6, LeakyReLU, Softmax, BatchNorm, SyncBatchNorm,
+    Conv3D, SubmConv3D, MaxPool3D,
+)
+
+__all__ = ["functional", "ReLU", "ReLU6", "LeakyReLU", "Softmax",
+           "BatchNorm", "SyncBatchNorm", "Conv3D", "SubmConv3D",
+           "MaxPool3D"]

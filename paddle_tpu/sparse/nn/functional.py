@@ -1,0 +1,113 @@
+"""paddle.sparse.nn.functional parity.
+
+Reference: ``python/paddle/sparse/nn/functional/{activation,conv,pooling}.py``.
+Activations keep the nonzero pattern (values may become explicit zeros,
+matching the reference's sparse relu kernels). Conv/pool run densified
+through the framework's XLA conv — on TPU the dense conv IS the fast path
+(MXU), and SubmConv3D re-masks the output to the input's active sites
+(submanifold semantics, ref ``phi/kernels/sparse/conv_kernel.h``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.autograd import apply_op
+
+from ..creation import SparseCooTensor, SparseCsrTensor
+
+__all__ = ["relu", "relu6", "leaky_relu", "softmax", "conv3d",
+           "subm_conv3d", "max_pool3d"]
+
+
+def _map_values(sp, fn, op_name):
+    vals = apply_op(fn, sp.values(), op_name=op_name)
+    if isinstance(sp, SparseCooTensor):
+        return SparseCooTensor(sp.indices(), vals, sp.shape)
+    return SparseCsrTensor(sp.crows(), sp.cols(), vals, sp.shape)
+
+
+def relu(x, name=None):
+    def fn(v):
+        import jax.numpy as jnp
+        return jnp.maximum(v, 0)
+    return _map_values(x, fn, "sparse_relu")
+
+
+def relu6(x, name=None):
+    def fn(v):
+        import jax.numpy as jnp
+        return jnp.clip(v, 0, 6)
+    return _map_values(x, fn, "sparse_relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    def fn(v):
+        import jax.numpy as jnp
+        return jnp.where(v >= 0, v, negative_slope * v)
+    return _map_values(x, fn, "sparse_leaky_relu")
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the nonzeros of each row (CSR; reference
+    ``sparse/nn/functional/activation.py:79`` — only the last axis of a 2-D
+    CSR matrix is supported there too)."""
+    if axis not in (-1, 1):
+        raise NotImplementedError("sparse softmax: last axis only")
+    csr = x if isinstance(x, SparseCsrTensor) else x.to_sparse_csr()
+    row_ids = csr._row_ids()
+    m = csr.shape[0]
+
+    def fn(v):
+        import jax
+        import jax.numpy as jnp
+        row_max = jax.ops.segment_max(v, row_ids, num_segments=m)
+        e = jnp.exp(v - row_max[row_ids])
+        denom = jax.ops.segment_sum(e, row_ids, num_segments=m)
+        return e / denom[row_ids]
+    vals = apply_op(fn, csr.values(), op_name="sparse_softmax")
+    out = SparseCsrTensor(csr.crows(), csr.cols(), vals, csr.shape)
+    return out if isinstance(x, SparseCsrTensor) else out.to_sparse_coo()
+
+
+def _dense_conv3d(x: SparseCooTensor, weight, bias, stride, padding,
+                  dilation, groups, subm):
+    """NDHWC sparse conv via the XLA dense conv; data layout matches the
+    reference (x: [N, D, H, W, C], weight: [kD, kH, kW, C_in, C_out])."""
+    dense = x.to_dense()
+    # framework conv3d is NCDHW with weight [C_out, C_in, kD, kH, kW]
+    nchw = dense.transpose([0, 4, 1, 2, 3])
+    w = weight.transpose([4, 3, 0, 1, 2])
+    out = F.conv3d(nchw, w, bias=bias, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    out = out.transpose([0, 2, 3, 4, 1])
+    if subm:
+        # submanifold: outputs only at the input's active (n,d,h,w) sites;
+        # channels stay dense
+        idx = tuple(np.asarray(x.indices().data))
+
+        def gather4(o):
+            return o[idx[0], idx[1], idx[2], idx[3]]
+        vals = apply_op(gather4, out, op_name="subm_gather")
+        return SparseCooTensor(np.asarray(x.indices().data)[:4], vals,
+                               tuple(out.shape[:4]) + (out.shape[4],))
+    return out.to_sparse_coo(sparse_dim=4)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    return _dense_conv3d(x, weight, bias, stride, padding, dilation,
+                         groups, subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _dense_conv3d(x, weight, bias, stride, padding, dilation,
+                         groups, subm=True)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    dense = x.to_dense().transpose([0, 4, 1, 2, 3])
+    out = F.max_pool3d(dense, kernel_size, stride=stride, padding=padding)
+    return out.transpose([0, 2, 3, 4, 1]).to_sparse_coo(sparse_dim=4)

@@ -1,0 +1,100 @@
+"""paddle.sparse.nn layer classes.
+
+Reference: ``python/paddle/sparse/nn/layer/{activation,conv,norm,pooling}.py``.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as dense_nn
+from paddle_tpu.nn import Layer
+from paddle_tpu.core.autograd import apply_op
+
+from ..creation import SparseCooTensor
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv3D", "SubmConv3D", "MaxPool3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class BatchNorm(dense_nn.BatchNorm1D):
+    """Sparse batch norm: normalizes the values (per last-dim channel)
+    across nonzeros, keeping the pattern (reference:
+    ``sparse/nn/layer/norm.py:28`` — operates on the [nnz, C] values)."""
+
+    def forward(self, x: SparseCooTensor):
+        vals = super().forward(x.values())
+        return SparseCooTensor(x.indices(), vals, x.shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """On TPU, batch-norm stats sync across devices via the compiled
+    psum when the step runs under a mesh — one class covers both."""
+
+
+class _ConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        # reference sparse conv weight layout: [kD, kH, kW, C_in/g, C_out]
+        self.weight = self.create_parameter(
+            shape=list(kernel_size) + [in_channels // groups, out_channels],
+            attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def _run(self, x, fn):
+        return fn(x, self.weight, bias=self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups)
+
+
+class Conv3D(_ConvBase):
+    def forward(self, x):
+        return self._run(x, F.conv3d)
+
+
+class SubmConv3D(_ConvBase):
+    def forward(self, x):
+        return self._run(x, F.subm_conv3d)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._k, stride=self._s, padding=self._p)

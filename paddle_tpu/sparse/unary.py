@@ -1,0 +1,114 @@
+"""Sparse unary ops — zero-preserving functions applied to values.
+
+Reference: ``python/paddle/sparse/unary.py`` (each op has a COO and a CSR
+kernel in ``phi/kernels/sparse/unary_kernel.h``); here a single values-side
+jnp call covers both layouts, keeping the nonzero pattern.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+
+from .creation import SparseCooTensor, SparseCsrTensor, coalesce_
+
+__all__ = ["sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+           "sqrt", "square", "log1p", "abs", "pow", "cast", "neg",
+           "deg2rad", "rad2deg", "expm1", "coalesce", "transpose",
+           "reshape"]
+
+
+def _map_values(sp, fn, op_name):
+    vals = apply_op(fn, sp.values(), op_name=op_name)
+    if isinstance(sp, SparseCooTensor):
+        return SparseCooTensor(sp.indices(), vals, sp.shape)
+    return SparseCsrTensor(sp.crows(), sp.cols(), vals, sp.shape)
+
+
+def _unary(name, jnp_name=None):
+    def op_fn(x):
+        def fn(v):
+            import jax.numpy as jnp
+            return getattr(jnp, jnp_name or name)(v)
+        return _map_values(x, fn, f"sparse_{name}")
+    op_fn.__name__ = name
+    op_fn.__doc__ = f"paddle.sparse.{name}: applied to nonzero values."
+    return op_fn
+
+
+sin = _unary("sin")
+tan = _unary("tan")
+asin = _unary("asin", "arcsin")
+atan = _unary("atan", "arctan")
+sinh = _unary("sinh")
+tanh = _unary("tanh")
+asinh = _unary("asinh", "arcsinh")
+atanh = _unary("atanh", "arctanh")
+sqrt = _unary("sqrt")
+square = _unary("square")
+log1p = _unary("log1p")
+abs = _unary("abs")
+neg = _unary("neg", "negative")
+deg2rad = _unary("deg2rad")
+rad2deg = _unary("rad2deg")
+expm1 = _unary("expm1")
+
+
+def pow(x, factor):
+    def fn(v):
+        import jax.numpy as jnp
+        return jnp.power(v, factor)
+    return _map_values(x, fn, "sparse_pow")
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    """paddle.sparse.cast parity: cast indices and/or values."""
+    vals = x.values().astype(value_dtype) if value_dtype is not None \
+        else x.values()
+    if isinstance(x, SparseCooTensor):
+        idx = x.indices()
+        if index_dtype is not None:
+            idx = idx.astype(index_dtype)
+        return SparseCooTensor(idx, vals, x.shape)
+    crows, cols = x.crows(), x.cols()
+    if index_dtype is not None:
+        crows, cols = crows.astype(index_dtype), cols.astype(index_dtype)
+    return SparseCsrTensor(crows, cols, vals, x.shape)
+
+
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    return coalesce_(x)
+
+
+def transpose(x, perm):
+    """paddle.sparse.transpose (sparse dims only for COO; CSR via COO)."""
+    if isinstance(x, SparseCsrTensor):
+        return transpose(x.to_sparse_coo(), perm).to_sparse_csr()
+    perm = [int(p) for p in perm]
+    if sorted(perm) != list(range(x.sparse_dim)):
+        raise NotImplementedError(
+            "sparse transpose supports permutations of the sparse dims")
+    idx = np.asarray(x.indices().data)[perm]
+    shape = [x.shape[p] for p in perm] + x.shape[x.sparse_dim:]
+    return SparseCooTensor(idx, x.values(), shape)
+
+
+def reshape(x: SparseCooTensor, shape):
+    """paddle.sparse.reshape: recompute coordinates for the new shape
+    (sparse dims only)."""
+    if isinstance(x, SparseCsrTensor):
+        return reshape(x.to_sparse_coo(), shape).to_sparse_csr()
+    if x.dense_dim != 0:
+        raise NotImplementedError("reshape supports pure-sparse COO")
+    old = x.shape
+    shape = list(shape)
+    numel = int(np.prod(old))
+    if -1 in shape:
+        i = shape.index(-1)
+        rest = int(np.prod([s for s in shape if s != -1]))
+        shape[i] = numel // rest
+    flat = np.ravel_multi_index(np.asarray(x.indices().data), old)
+    new_idx = np.stack(np.unravel_index(flat, shape))
+    return SparseCooTensor(new_idx, x.values(), shape)

@@ -1,0 +1,232 @@
+"""paddle.sparse parity tests — numpy-oracle for every op family, plus
+gradient flow through values (the reference's sparse tests live under
+python/paddle/fluid/tests/unittests/test_sparse_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.sparse as sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0, stop_gradient=True):
+    rng = np.random.RandomState(seed)
+    # unique coordinates
+    flat = rng.choice(int(np.prod(shape)), size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(flat, shape)).astype(np.int64)
+    vals = rng.randn(nnz).astype(np.float32)
+    dense = np.zeros(shape, np.float32)
+    dense[tuple(idx)] = vals
+    sp = sparse.sparse_coo_tensor(idx, vals, shape,
+                                  stop_gradient=stop_gradient)
+    return sp, dense
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        sp, dense = _rand_coo()
+        np.testing.assert_allclose(sp.numpy(), dense)
+        assert sp.nnz() == 6
+        assert sp.shape == [4, 5]
+
+    def test_coo_duplicate_coords_sum(self):
+        idx = [[0, 0, 1], [1, 1, 2]]
+        sp = sparse.sparse_coo_tensor(idx, [1.0, 2.0, 3.0], (2, 3))
+        assert sp.numpy()[0, 1] == 3.0  # to_dense sums duplicates
+        co = sp.coalesce()
+        assert co.nnz() == 2
+        np.testing.assert_allclose(co.numpy(), sp.numpy())
+
+    def test_dense_to_sparse_and_back(self):
+        x = pt.to_tensor(np.array([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32))
+        sp = x.to_sparse_coo()
+        assert sp.nnz() == 3
+        np.testing.assert_allclose(sp.numpy(), x.numpy())
+
+    def test_csr_roundtrip(self):
+        sp, dense = _rand_coo()
+        csr = sp.to_sparse_csr()
+        np.testing.assert_allclose(csr.numpy(), dense)
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.numpy(), dense)
+
+    def test_sparse_csr_tensor_ctor(self):
+        # [[1, 0, 2], [0, 3, 0]]
+        csr = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 1],
+                                       [1.0, 2.0, 3.0], (2, 3))
+        np.testing.assert_allclose(
+            csr.numpy(), [[1, 0, 2], [0, 3, 0]])
+
+
+class TestUnary:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("sin", np.sin), ("tanh", np.tanh), ("square", np.square),
+        ("abs", np.abs), ("expm1", np.expm1), ("neg", np.negative),
+    ])
+    def test_values_oracle(self, name, np_fn):
+        sp, dense = _rand_coo(seed=3)
+        out = getattr(sparse, name)(sp)
+        mask = dense != 0
+        expect = np.where(mask, np_fn(dense), 0)
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-6)
+
+    def test_pow_and_cast(self):
+        sp, dense = _rand_coo(seed=4)
+        np.testing.assert_allclose(sparse.pow(sp, 2).numpy(),
+                                   np.where(dense != 0, dense ** 2, 0),
+                                   rtol=1e-6)
+        assert sparse.cast(sp, value_dtype="float16").dtype == pt.float16
+
+    def test_transpose(self):
+        sp, dense = _rand_coo(seed=5)
+        np.testing.assert_allclose(
+            sparse.transpose(sp, [1, 0]).numpy(), dense.T)
+
+    def test_reshape(self):
+        sp, dense = _rand_coo(shape=(4, 6), seed=6)
+        np.testing.assert_allclose(
+            sparse.reshape(sp, [2, 12]).numpy(), dense.reshape(2, 12))
+        np.testing.assert_allclose(
+            sparse.reshape(sp, [8, -1]).numpy(), dense.reshape(8, 3))
+
+
+class TestBinary:
+    def test_add_subtract_union_pattern(self):
+        a, da = _rand_coo(seed=7)
+        b, db = _rand_coo(seed=8)
+        np.testing.assert_allclose(sparse.add(a, b).numpy(), da + db,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(sparse.subtract(a, b).numpy(), da - db,
+                                   rtol=1e-6)
+
+    def test_multiply_same_pattern(self):
+        a, da = _rand_coo(seed=9)
+        b = sparse.sparse_coo_tensor(np.asarray(a.indices().data),
+                                     np.arange(1.0, 7.0, dtype=np.float32),
+                                     a.shape)
+        out = sparse.multiply(a, b)
+        np.testing.assert_allclose(out.numpy(), da * b.numpy(), rtol=1e-6)
+
+    def test_matmul_oracle(self):
+        sp, dense = _rand_coo(shape=(4, 5), seed=10)
+        y = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+        out = sparse.matmul(sp, pt.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_csr_matmul(self):
+        sp, dense = _rand_coo(shape=(4, 5), seed=11)
+        y = np.random.RandomState(2).randn(5, 3).astype(np.float32)
+        out = sparse.matmul(sp.to_sparse_csr(), pt.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_mv(self):
+        sp, dense = _rand_coo(shape=(4, 5), seed=12)
+        v = np.random.RandomState(3).randn(5).astype(np.float32)
+        np.testing.assert_allclose(sparse.mv(sp, pt.to_tensor(v)).numpy(),
+                                   dense @ v, rtol=1e-5, atol=1e-6)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(4)
+        a = rng.randn(4, 6).astype(np.float32)
+        b = rng.randn(6, 5).astype(np.float32)
+        mask, dmask = _rand_coo(shape=(4, 5), seed=13)
+        out = sparse.masked_matmul(pt.to_tensor(a), pt.to_tensor(b), mask)
+        expect = np.where(dmask != 0, a @ b, 0)
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_addmm(self):
+        rng = np.random.RandomState(5)
+        inp = rng.randn(4, 3).astype(np.float32)
+        sp, dense = _rand_coo(shape=(4, 5), seed=14)
+        y = rng.randn(5, 3).astype(np.float32)
+        out = sparse.addmm(pt.to_tensor(inp), sp, pt.to_tensor(y),
+                           beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(out.numpy(), 0.5 * inp + 2.0 * dense @ y,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestAutograd:
+    def test_matmul_grad_flows_to_values_and_dense(self):
+        sp, dense = _rand_coo(shape=(3, 4), nnz=5, seed=15,
+                              stop_gradient=False)
+        y = pt.to_tensor(
+            np.random.RandomState(6).randn(4, 2).astype(np.float32),
+            stop_gradient=False)
+        out = sparse.matmul(sp, y)
+        out.sum().backward()
+        assert sp.grad is not None and sp.grad.shape == [5]
+        # d(sum)/dy[c, j] = sum_r dense[r, c]
+        np.testing.assert_allclose(
+            y.grad.numpy(), np.tile(dense.sum(0)[:, None], (1, 2)),
+            rtol=1e-5, atol=1e-6)
+
+    def test_to_dense_grad(self):
+        sp, _ = _rand_coo(shape=(3, 3), nnz=4, seed=16,
+                          stop_gradient=False)
+        (sp.to_dense() * 2.0).sum().backward()
+        np.testing.assert_allclose(sp.grad.numpy(), np.full(4, 2.0))
+
+    def test_dense_to_sparse_grad(self):
+        x = pt.to_tensor(np.array([[0, 1.0], [2.0, 0]], np.float32),
+                         stop_gradient=False)
+        sp = x.to_sparse_coo()
+        sp.values().sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [[0, 1], [1, 0]])
+
+
+class TestNN:
+    def test_relu_softmax(self):
+        sp, dense = _rand_coo(seed=17)
+        np.testing.assert_allclose(
+            sparse.nn.functional.relu(sp).numpy(),
+            np.where(dense > 0, dense, 0), rtol=1e-6)
+        csr = sp.to_sparse_csr()
+        sm = sparse.nn.functional.softmax(csr)
+        out = sm.numpy()
+        # each row's nonzero entries sum to 1
+        rows = np.unique(np.asarray(sp.coalesce().indices().data)[0])
+        for r in rows:
+            np.testing.assert_allclose(out[r][out[r] != 0].sum(), 1.0,
+                                       rtol=1e-5)
+
+    def test_batchnorm(self):
+        rng = np.random.RandomState(18)
+        idx = np.stack([np.arange(8) % 4, np.arange(8) % 3]).astype(np.int64)
+        vals = rng.randn(8, 5).astype(np.float32)
+        sp = sparse.sparse_coo_tensor(idx, vals, (4, 3, 5))
+        bn = sparse.nn.BatchNorm(5)
+        bn.train()
+        out = bn(sp)
+        got = np.asarray(out.values().data)
+        np.testing.assert_allclose(got.mean(axis=0), 0, atol=1e-5)
+
+    def test_subm_conv3d_keeps_pattern(self):
+        pt.seed(0)
+        rng = np.random.RandomState(19)
+        # one sample, 4x4x4 grid, 2 channels, 5 active sites
+        flat = rng.choice(64, size=5, replace=False)
+        d, h, w = np.unravel_index(flat, (4, 4, 4))
+        idx = np.stack([np.zeros(5, np.int64), d, h, w])
+        vals = rng.randn(5, 2).astype(np.float32)
+        sp = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, 2))
+        conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+        out = conv(sp)
+        assert out.shape == [1, 4, 4, 4, 3]
+        assert out.nnz() == 5  # submanifold: same active sites
+        np.testing.assert_array_equal(
+            np.asarray(out.indices().data), idx)
+
+    def test_conv3d_and_maxpool(self):
+        pt.seed(0)
+        sp, _ = _rand_coo(shape=(1, 4, 4, 4), nnz=6, seed=20)
+        sp5 = sparse.sparse_coo_tensor(
+            np.concatenate([np.asarray(sp.indices().data)], axis=0),
+            np.asarray(sp.values().data)[:, None], (1, 4, 4, 4, 1))
+        conv = sparse.nn.Conv3D(1, 2, kernel_size=2)
+        out = conv(sp5)
+        assert out.shape == [1, 3, 3, 3, 2]
+        pooled = sparse.nn.MaxPool3D(kernel_size=2)(sp5)
+        assert pooled.shape == [1, 2, 2, 2, 1]

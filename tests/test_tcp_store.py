@@ -218,3 +218,50 @@ class TestRobustness:
         t.join(timeout=10)
         assert not t.is_alive()
         assert "err" in out
+
+
+def test_multinode_elastic_restart(tmp_path):
+    """Two launchers (one per 'node') share one store; node 1's trainer
+    fails on epoch 0 — the epoch counter must restart BOTH nodes, and the
+    epoch-namespaced barrier must synchronize all 4 trainers on retry."""
+    from paddle_tpu.distributed import TCPStore
+    from paddle_tpu.distributed.launch import launch
+
+    import os as _os
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from paddle_tpu.distributed import TCPStore\n"
+        "from paddle_tpu.distributed.tcp_store import barrier_via_store\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "epoch = os.environ['PADDLE_RESTART_EPOCH']\n"
+        "host, port = os.environ['PADDLE_MASTER'].rsplit(':', 1)\n"
+        "s = TCPStore(host=host, port=int(port))\n"
+        "s.set(f'reg/{epoch}/{rank}', '1')\n"
+        "barrier_via_store(s, 'init', world)\n"
+        "missing = [r for r in range(world)"
+        " if s.get(f'reg/{epoch}/{r}') is None]\n"
+        "assert not missing, f'epoch {epoch}: missing {missing}'\n"
+        "sys.exit(1 if (epoch == '0' and rank == 3) else 0)\n")
+
+    # reserve an ephemeral port, then let node 0's launcher host the store
+    probe = TCPStore(is_master=True)
+    port = probe.port
+    del probe
+    addr = f"127.0.0.1:{port}"
+    results = {}
+
+    def run_node(nr):
+        results[nr] = launch(str(script), nproc_per_node=2, master=addr,
+                             node_rank=nr, nnodes=2, max_restarts=2)
+
+    threads = [threading.Thread(target=run_node, args=(nr,))
+               for nr in (1, 0)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert results == {0: 0, 1: 0}, results
