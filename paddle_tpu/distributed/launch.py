@@ -107,7 +107,24 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         store = TCPStore(host=mhost, port=int(mport),
                          is_master=(node_rank == 0),
                          world_size=world_size)
+    def _exit(code: int) -> int:
+        # the store-hosting launcher must be last out: peers may be mid-
+        # poll against it. Everyone acks exit; the host waits (bounded)
+        # for all acks before returning, since returning drops the store
+        # and stops the server.
+        try:
+            store.add("__exit_ack", 1)
+            if store._server:
+                deadline = time.monotonic() + 15
+                while int(store.add("__exit_ack", 0)) < nnodes and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.1)
+        except Exception:
+            pass
+        return code
+
     epoch = int(store.add("__restart_epoch", 0))
+    attempts = 0  # local relaunch budget (epoch can over-bump on races)
     while True:
         procs = []
         logs = []
@@ -172,10 +189,11 @@ def launch(script: str, script_args: Optional[List[str]] = None,
                     break
                 if all(store.get(f"__done/{epoch}/{n}") is not None
                        for n in range(nnodes)):
-                    return 0
+                    return _exit(0)
                 time.sleep(0.2)
-        if new_epoch > max_restarts:
-            return fail_code if fail_code is not None else 1
+        attempts += 1
+        if attempts > max_restarts:
+            return _exit(fail_code if fail_code is not None else 1)
         epoch = new_epoch
 
 

@@ -142,6 +142,11 @@ class TCPStore:
             live = {t.ident for t in threading.enumerate()}
             for ident in [i for i in self._fds if i not in live]:
                 self._lib.tcp_store_close(self._fds.pop(ident))
+            # thread idents are reused: a fresh thread with a dead thread's
+            # ident must not silently drop (leak) the old socket
+            prev = self._fds.get(threading.get_ident())
+            if prev is not None:
+                self._lib.tcp_store_close(prev)
             self._fds[threading.get_ident()] = fd
         return fd
 

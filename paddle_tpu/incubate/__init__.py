@@ -1,0 +1,6 @@
+"""paddle.incubate parity — experimental subsystems (reference:
+``python/paddle/incubate/``). Currently: ASP (automatic structured
+sparsity)."""
+from . import asp  # noqa: F401
+
+__all__ = ["asp"]

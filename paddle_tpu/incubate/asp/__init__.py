@@ -1,0 +1,144 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: ``python/paddle/incubate/asp/`` (``asp.py`` decorate/prune_model,
+``utils.py`` mask generation: get_mask_1d / get_mask_2d_greedy,
+check_sparsity). The reference targets NVIDIA 2:4 sparse tensor cores; on
+TPU there is no sparse MXU mode, so ASP here is the *training-time*
+capability — masks are computed the same way, weights are pruned, and the
+decorated optimizer re-applies masks after every step so sparsity survives
+training (the semantics the reference guarantees).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "get_mask_1d", "get_mask_2d_greedy", "check_mask_1d",
+           "ASPHelper", "OptimizerWithSparsityGuarantee"]
+
+_excluded: Dict[int, List[str]] = {}
+_masks: Dict[int, Dict[str, np.ndarray]] = {}
+# id(param) -> (param, mask): lets a decorated optimizer re-mask exactly
+# the params it manages, independent of which model object was pruned
+_param_masks: Dict[int, tuple] = {}
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference asp.py:calculate_density)."""
+    arr = np.asarray(x.data if hasattr(x, "data") else x)
+    return float((arr != 0).sum()) / max(arr.size, 1)
+
+
+def get_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-|w| in every group of m along the last axis
+    (reference utils.py:get_mask_1d)."""
+    flat = mat.reshape(-1, m)
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, order[:, :n], True, axis=1)
+    return mask.reshape(mat.shape)
+
+
+def check_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    flat = (np.asarray(mat) != 0).reshape(-1, m)
+    return bool((flat.sum(axis=1) <= n).all())
+
+
+def get_mask_2d_greedy(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Greedy 2-D n:m mask: at most n nonzeros per m-group along BOTH axes
+    (reference utils.py:get_mask_2d_greedy, simplified greedy)."""
+    h, w = mat.shape
+    mask = np.zeros_like(mat, dtype=bool)
+    absm = np.abs(mat)
+    for i0 in range(0, h, m):
+        for j0 in range(0, w, m):
+            blk = absm[i0:i0 + m, j0:j0 + m]
+            bm = np.zeros_like(blk, dtype=bool)
+            row_cnt = np.zeros(blk.shape[0], dtype=int)
+            col_cnt = np.zeros(blk.shape[1], dtype=int)
+            for idx in np.argsort(-blk, axis=None):
+                r, c = np.unravel_index(idx, blk.shape)
+                if row_cnt[r] < n and col_cnt[c] < n:
+                    bm[r, c] = True
+                    row_cnt[r] += 1
+                    col_cnt[c] += 1
+            mask[i0:i0 + m, j0:j0 + m] = bm
+    return mask
+
+
+def set_excluded_layers(model, param_names: List[str]):
+    _excluded[id(model)] = list(param_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        _excluded.clear()
+    else:
+        _excluded.pop(id(model), None)
+
+
+def _supported(name: str, p) -> bool:
+    # the reference prunes FC/conv weights (>=2-D, last dim % 4 == 0)
+    shape = p.shape
+    return len(shape) >= 2 and shape[-1] % 4 == 0 and "bias" not in name
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Prune every supported weight to n:m sparsity; masks are remembered
+    for the decorated optimizer (reference asp.py:prune_model)."""
+    import jax.numpy as jnp
+    algo = {"mask_1d": get_mask_1d, "mask_2d_greedy": get_mask_2d_greedy}[
+        mask_algo]
+    excluded = set(_excluded.get(id(model), ()))
+    masks = _masks.setdefault(id(model), {})
+    for name, p in model.named_parameters():
+        if name in excluded or not _supported(name, p):
+            continue
+        w = np.asarray(p.data)
+        mat = w.reshape(-1, w.shape[-1])
+        mask = algo(mat, n, m).reshape(w.shape)
+        p.data = jnp.asarray(w * mask)
+        if with_mask:
+            masks[name] = mask
+            _param_masks[id(p)] = (p, mask)
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Wraps an optimizer so every ``step`` re-applies the pruning masks
+    to the params it manages (reference
+    asp.py:OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def step(self, *args, **kwargs):
+        out = self._inner.step(*args, **kwargs)
+        import jax.numpy as jnp
+        for g in self._inner._param_groups:
+            for p in g["params"]:
+                entry = _param_masks.get(id(p))
+                if entry is not None:
+                    p.data = jnp.asarray(np.asarray(p.data) * entry[1])
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def decorate(optimizer):
+    """paddle.incubate.asp.decorate parity: call AFTER prune_model so the
+    masks exist; the wrapper re-masks after every update step."""
+    return OptimizerWithSparsityGuarantee(optimizer)
+
+
+class ASPHelper:
+    """Introspection helper matching the reference class name."""
+
+    @staticmethod
+    def masks_for(model):
+        return dict(_masks.get(id(model), {}))

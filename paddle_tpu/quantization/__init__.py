@@ -1,0 +1,23 @@
+"""paddle.quantization parity — new-style QAT/PTQ framework.
+
+Reference: ``python/paddle/quantization/`` (``config.py`` QuantConfig,
+``qat.py`` QAT, ``ptq.py`` PTQ, ``quanters/abs_max.py``,
+``observers/abs_max.py``, ``wrapper.py``).
+
+TPU notes: fake-quant is a pure elementwise jnp composition (XLA fuses it
+into the surrounding matmul), and the straight-through estimator is the
+classic ``x + stop_gradient(q - x)`` identity — no custom kernel needed.
+"""
+from .config import QuantConfig, SingleLayerConfig  # noqa: F401
+from .factory import QuanterFactory, quanter  # noqa: F401
+from .base import BaseQuanter, BaseObserver  # noqa: F401
+from .quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401
+from .observers import AbsmaxObserver  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .wrapper import QuantedLinear, QuantedConv2D  # noqa: F401
+
+__all__ = ["QuantConfig", "SingleLayerConfig", "QuanterFactory", "quanter",
+           "BaseQuanter", "BaseObserver", "FakeQuanterWithAbsMaxObserver",
+           "AbsmaxObserver", "QAT", "PTQ", "QuantedLinear",
+           "QuantedConv2D"]

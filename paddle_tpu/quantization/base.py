@@ -1,0 +1,32 @@
+"""Quanter/observer base classes (reference:
+``python/paddle/quantization/base_quanter.py``, ``base_observer.py``)."""
+from __future__ import annotations
+
+import abc
+
+from paddle_tpu.nn import Layer
+
+__all__ = ["BaseQuanter", "BaseObserver"]
+
+
+class BaseQuanter(Layer, metaclass=abc.ABCMeta):
+    """A Layer that simulates quantization in forward; exposes the learned
+    scale/zero-point so ``convert`` can bake real quantized weights."""
+
+    @abc.abstractmethod
+    def scales(self):
+        ...
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return getattr(self, "_quant_bits", 8)
+
+
+class BaseObserver(BaseQuanter, metaclass=abc.ABCMeta):
+    """PTQ observer: watches activations during calibration (forward is
+    identity), then reports scales."""

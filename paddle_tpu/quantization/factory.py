@@ -1,0 +1,27 @@
+"""QuanterFactory (reference: ``python/paddle/quantization/factory.py``):
+a deferred constructor so one QuantConfig instantiates fresh quanter
+layers per wrapped layer."""
+from __future__ import annotations
+
+__all__ = ["QuanterFactory", "quanter"]
+
+
+class QuanterFactory:
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self._cls(*self._args, **self._kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return QuanterFactory(self._cls, *args, **kwargs)
+
+
+def quanter(name=None):
+    """Class decorator registering a quanter and giving it a factory
+    constructor (reference factory.py:quanter)."""
+    def deco(cls):
+        return cls
+    return deco

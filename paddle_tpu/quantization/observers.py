@@ -1,0 +1,37 @@
+"""PTQ observers (reference:
+``python/paddle/quantization/observers/abs_max.py`` AbsmaxObserver —
+identity forward that records the running abs-max for calibration)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as pt
+
+from .base import BaseObserver
+from .factory import QuanterFactory
+
+__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer"]
+
+
+class AbsmaxObserverLayer(BaseObserver):
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = int(quant_bits)
+        self.register_buffer("_scale",
+                             pt.to_tensor(np.zeros((), np.float32)))
+
+    def forward(self, x):
+        cur = float(np.abs(np.asarray(x.data)).max()) if x.data.size else 0.0
+        if cur > float(self._scale.numpy()):
+            import jax.numpy as jnp
+            self._scale.data = jnp.float32(cur)
+        return x
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+AbsmaxObserver = QuanterFactory(AbsmaxObserverLayer)

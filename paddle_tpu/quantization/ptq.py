@@ -1,0 +1,56 @@
+"""PTQ — post-training quantization (reference:
+``python/paddle/quantization/ptq.py``): insert observers, run calibration
+batches, then ``convert`` to quanted layers using the observed scales."""
+from __future__ import annotations
+
+import copy
+
+from paddle_tpu.nn import Layer
+
+from .config import SingleLayerConfig
+from .qat import Quantization
+from .quanters import FakeQuanterWithAbsMaxObserverLayer
+from .wrapper import ObserveWrapper
+
+__all__ = ["PTQ"]
+
+
+class PTQ(Quantization):
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def make(child, cfg):
+            obs = cfg.activation._instance(child) \
+                if cfg.activation is not None else None
+            return ObserveWrapper(obs, child)
+        return self._walk_replace(model, make)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Replace observed layers with quanted layers whose activation
+        quanter is frozen at the observed scale."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        mapping = self._config.qat_layer_mappings
+        self._convert_walk(model, mapping)
+        return model
+
+    def _convert_walk(self, model: Layer, mapping):
+        for name, child in list(model._sub_layers.items()):
+            if isinstance(child, ObserveWrapper):
+                observed = child._observed
+                cfg = self._config._get_config_by_layer(observed, name) or \
+                    self._config._global_config
+                # weight quanter from the config; activation quanter is a
+                # fake-quanter FROZEN at the observed calibration scale
+                quanted = mapping[type(observed)](
+                    observed, SingleLayerConfig(None, cfg.weight))
+                if child._observer is not None:
+                    fq = FakeQuanterWithAbsMaxObserverLayer(
+                        bit_length=child._observer.bit_length())
+                    fq._scale.data = child._observer.scales().data
+                    fq.eval()
+                    quanted.activation_quanter = fq
+                model._sub_layers[name] = quanted
+            else:
+                self._convert_walk(child, mapping)

@@ -1,0 +1,68 @@
+"""QAT — quantization-aware training (reference:
+``python/paddle/quantization/qat.py`` + ``quantize.py`` Quantization base:
+walk the model, replace configured layers with their quanted wrappers;
+``convert`` bakes the learned scales into plain layers)."""
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from paddle_tpu.nn import Layer
+
+from .config import QuantConfig
+from .wrapper import _QuantedBase
+
+__all__ = ["QAT"]
+
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def _walk_replace(self, model: Layer, make):
+        for name, child in list(model._sub_layers.items()):
+            if self._config._is_quantifiable(child, name):
+                cfg = self._config._get_config_by_layer(child, name)
+                model._sub_layers[name] = make(child, cfg)
+            else:
+                self._walk_replace(child, make)
+        return model
+
+
+class QAT(Quantization):
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        mapping = self._config.qat_layer_mappings
+
+        def make(child, cfg):
+            return mapping[type(child)](child, cfg)
+        return self._walk_replace(model, make)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Bake fake-quantized weights back into the plain layers for
+        deployment (the reference's onnx-format convert collapses
+        quant/dequant pairs the same way)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        _convert_in_place(model)
+        return model
+
+
+def _convert_in_place(model: Layer):
+    for name, child in list(model._sub_layers.items()):
+        if isinstance(child, _QuantedBase):
+            plain = child._layer
+            if child.weight_quanter is not None:
+                scale = float(child.weight_quanter.scales().numpy())
+                bits = child.weight_quanter.bit_length()
+                if scale > 0:
+                    bound = float(2 ** (bits - 1) - 1)
+                    w = np.asarray(plain.weight.data)
+                    q = np.clip(np.round(w / scale * bound), -bound,
+                                bound) * scale / bound
+                    plain.weight.data = q.astype(w.dtype)
+            model._sub_layers[name] = plain
+        else:
+            _convert_in_place(child)

@@ -1,0 +1,75 @@
+"""Fake quanters (reference: ``python/paddle/quantization/quanters/abs_max.py``
+FakeQuanterWithAbsMaxObserver — moving-average abs-max scale, simulated
+int-k round-trip with a straight-through gradient)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.autograd import apply_op
+
+from .base import BaseQuanter
+from .factory import QuanterFactory
+
+__all__ = ["FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer"]
+
+
+def fake_quant_ste(x, scale, bits):
+    """round(clip(x/s)) * s with the straight-through estimator: the
+    backward is identity (``x + stop_grad(q - x)``), matching the
+    reference's fake_quantize_dequantize kernels."""
+    bound = float(2 ** (bits - 1) - 1)
+
+    def fn(xv):
+        import jax
+        import jax.numpy as jnp
+        s = jnp.maximum(scale, 1e-9)
+        q = jnp.clip(jnp.round(xv / s * bound), -bound, bound) * s / bound
+        return xv + jax.lax.stop_gradient(q - xv)
+    return apply_op(fn, x, op_name="fake_quantize_dequantize")
+
+
+class FakeQuanterWithAbsMaxObserverLayer(BaseQuanter):
+    """QAT activation/weight quanter: tracks a moving-average abs-max and
+    fake-quantizes through it (abs_max.py:FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype: str = "float32", name=None, quant_on_weight=False):
+        super().__init__()
+        self._moving_rate = float(moving_rate)
+        self._quant_bits = int(bit_length)
+        self.register_buffer("_scale",
+                             pt.to_tensor(np.zeros((), np.float32)))
+        self.register_buffer("_state",
+                             pt.to_tensor(np.zeros((), np.float32)))
+
+    def forward(self, x):
+        if self.training:
+            cur = float(np.abs(np.asarray(x.data)).max()) if x.data.size \
+                else 0.0
+            state = float(self._state.numpy())
+            scale = float(self._scale.numpy())
+            r = self._moving_rate
+            new_state = r * state + 1.0
+            new_scale = (r * scale * state + cur) / new_state if state > 0 \
+                else cur
+            import jax.numpy as jnp
+            self._state.data = jnp.float32(new_state)
+            self._scale.data = jnp.float32(new_scale)
+        scale = float(self._scale.numpy())
+        if scale <= 0:
+            return x
+        return fake_quant_ste(x, scale, self._quant_bits)
+
+    def scales(self):
+        return self._scale
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+# public name is a factory, so config authors write
+# FakeQuanterWithAbsMaxObserver(moving_rate=0.9) (reference @quanter deco)
+FakeQuanterWithAbsMaxObserver = QuanterFactory(
+    FakeQuanterWithAbsMaxObserverLayer)

@@ -118,7 +118,7 @@ def mv(x, vec, name=None):
 
 def masked_matmul(x: Tensor, y: Tensor, mask, name=None):
     """(dense @ dense) sampled at ``mask``'s nonzero pattern (SDDMM)."""
-    coo = _to_coo(mask)
+    coo = coalesce_(_to_coo(mask))  # duplicate coords would double-count
     rows, cols = (np.asarray(coo.indices().data[i]) for i in (0, 1))
 
     def sddmm(a, b):

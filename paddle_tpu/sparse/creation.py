@@ -17,9 +17,13 @@ __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
 
 
 def _as_index_tensor(x):
-    # int32 coords: TPU-native index width (int64 would truncate anyway
-    # without jax x64 mode)
+    # default coords are int32 (TPU-native index width; int64 truncates
+    # without jax x64 mode), but a Tensor that already carries an integer
+    # dtype keeps it — sparse.cast(index_dtype=...) must be honored
     if isinstance(x, Tensor):
+        import jax.numpy as jnp
+        if jnp.issubdtype(x.data.dtype, jnp.integer):
+            return Tensor(x.data, stop_gradient=True)
         return Tensor(x.data.astype("int32"), stop_gradient=True)
     return Tensor(np.asarray(x, dtype=np.int32), stop_gradient=True)
 

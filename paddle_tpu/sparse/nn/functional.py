@@ -15,16 +15,10 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu.core.autograd import apply_op
 
 from ..creation import SparseCooTensor, SparseCsrTensor
+from ..unary import _map_values
 
 __all__ = ["relu", "relu6", "leaky_relu", "softmax", "conv3d",
            "subm_conv3d", "max_pool3d"]
-
-
-def _map_values(sp, fn, op_name):
-    vals = apply_op(fn, sp.values(), op_name=op_name)
-    if isinstance(sp, SparseCooTensor):
-        return SparseCooTensor(sp.indices(), vals, sp.shape)
-    return SparseCsrTensor(sp.crows(), sp.cols(), vals, sp.shape)
 
 
 def relu(x, name=None):

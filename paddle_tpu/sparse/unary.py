@@ -6,8 +6,6 @@ jnp call covers both layouts, keeping the nonzero pattern.
 """
 from __future__ import annotations
 
-import builtins
-
 import numpy as np
 
 from paddle_tpu.core.autograd import apply_op

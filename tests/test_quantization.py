@@ -1,0 +1,195 @@
+"""Quantization (QAT/PTQ) and ASP tests — numpy oracles for the quant math,
+training-behavior checks for STE and sparsity guarantees."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.quantization import (
+    QAT, PTQ, QuantConfig, FakeQuanterWithAbsMaxObserver, AbsmaxObserver,
+    QuantedLinear,
+)
+from paddle_tpu.quantization.quanters import (
+    FakeQuanterWithAbsMaxObserverLayer,
+)
+import paddle_tpu.incubate.asp as asp
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.act = nn.ReLU()
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+class TestFakeQuant:
+    def test_fake_quant_oracle(self):
+        q = FakeQuanterWithAbsMaxObserverLayer(bit_length=8)
+        q.train()
+        x = pt.to_tensor(np.array([-1.0, -0.5, 0.0, 0.26, 1.0], np.float32))
+        out = q(x)
+        # scale = absmax = 1.0; q = round(x*127)/127
+        expect = np.round(np.array([-1, -0.5, 0, 0.26, 1]) * 127) / 127
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-6)
+
+    def test_ste_gradient_is_identity(self):
+        q = FakeQuanterWithAbsMaxObserverLayer()
+        q.train()
+        x = pt.to_tensor(np.array([0.3, -0.7, 0.9], np.float32),
+                         stop_gradient=False)
+        q(x).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3), rtol=1e-6)
+
+    def test_moving_average_scale(self):
+        q = FakeQuanterWithAbsMaxObserverLayer(moving_rate=0.9)
+        q.train()
+        q(pt.to_tensor(np.array([2.0], np.float32)))
+        s1 = float(q.scales().numpy())
+        assert s1 == pytest.approx(2.0)
+        q(pt.to_tensor(np.array([4.0], np.float32)))
+        s2 = float(q.scales().numpy())
+        # (0.9*2*1 + 4) / (0.9*1 + 1)
+        assert s2 == pytest.approx((0.9 * 2 + 4) / 1.9)
+
+
+class TestQAT:
+    def test_quantize_replaces_layers(self):
+        pt.seed(0)
+        model = Net()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        q = QAT(cfg)
+        qmodel = q.quantize(model)
+        assert isinstance(qmodel.fc1, QuantedLinear)
+        assert isinstance(qmodel.fc2, QuantedLinear)
+        # original is untouched (inplace=False)
+        assert isinstance(model.fc1, nn.Linear)
+        # no duplicate parameters
+        params = qmodel.parameters()
+        assert len(params) == len({id(p) for p in params})
+
+    def test_qat_trains(self):
+        pt.seed(0)
+        rng = np.random.RandomState(0)
+        model = Net()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        qmodel = QAT(cfg).quantize(model)
+        qmodel.train()
+        x = rng.randn(64, 8).astype(np.float32)
+        w_true = rng.randn(8, 4).astype(np.float32)
+        y = x @ w_true
+        o = opt.Adam(learning_rate=0.01, parameters=qmodel.parameters())
+        losses = []
+        for _ in range(60):
+            pred = qmodel(pt.to_tensor(x))
+            loss = ((pred - pt.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.3
+
+    def test_convert_bakes_quantized_weights(self):
+        pt.seed(1)
+        model = Net()
+        cfg = QuantConfig(activation=None,
+                          weight=FakeQuanterWithAbsMaxObserver())
+        q = QAT(cfg)
+        qmodel = q.quantize(model)
+        qmodel.train()
+        qmodel(pt.to_tensor(np.random.RandomState(1)
+                            .randn(4, 8).astype(np.float32)))
+        deployed = q.convert(qmodel)
+        assert isinstance(deployed.fc1, nn.Linear)
+        w = np.asarray(deployed.fc1.weight.data)
+        scale = np.abs(np.asarray(qmodel.fc1.weight.data)).max()
+        # every weight sits on the 255-level grid
+        grid = w / (scale / 127)
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-4)
+
+    def test_type_and_name_config(self):
+        pt.seed(0)
+        model = Net()
+        cfg = QuantConfig()
+        cfg.add_type_config(nn.Linear,
+                            activation=FakeQuanterWithAbsMaxObserver(),
+                            weight=FakeQuanterWithAbsMaxObserver())
+        qmodel = QAT(cfg).quantize(model)
+        assert isinstance(qmodel.fc1, QuantedLinear)
+
+
+class TestPTQ:
+    def test_ptq_calibrate_and_convert(self):
+        pt.seed(2)
+        rng = np.random.RandomState(2)
+        model = Net()
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        for _ in range(4):
+            observed(pt.to_tensor(rng.randn(16, 8).astype(np.float32)))
+        deployed = ptq.convert(observed)
+        assert isinstance(deployed.fc1, QuantedLinear)
+        fq = deployed.fc1.activation_quanter
+        assert float(fq.scales().numpy()) > 0
+        # deployed forward runs and is close to fp32 on calib data
+        x = rng.randn(16, 8).astype(np.float32)
+        ref = model(pt.to_tensor(x)).numpy()
+        got = deployed(pt.to_tensor(x)).numpy()
+        assert np.abs(ref - got).mean() < 0.1 * np.abs(ref).mean() + 0.05
+
+
+class TestASP:
+    def test_mask_1d(self):
+        rng = np.random.RandomState(3)
+        mat = rng.randn(8, 16).astype(np.float32)
+        mask = asp.get_mask_1d(mat, 2, 4)
+        assert asp.check_mask_1d(mat * mask, 2, 4)
+        # keeps the largest-|w| entries
+        kept = np.abs(mat.reshape(-1, 4) * mask.reshape(-1, 4)).sum()
+        assert kept > 0.5 * np.abs(mat).sum()
+
+    def test_mask_2d_greedy(self):
+        rng = np.random.RandomState(4)
+        mat = rng.randn(8, 8).astype(np.float32)
+        mask = asp.get_mask_2d_greedy(mat, 2, 4)
+        pruned = mat * mask
+        for i0 in range(0, 8, 4):
+            for j0 in range(0, 8, 4):
+                blk = pruned[i0:i0 + 4, j0:j0 + 4] != 0
+                assert (blk.sum(axis=0) <= 2).all()
+                assert (blk.sum(axis=1) <= 2).all()
+
+    def test_prune_and_guaranteed_training(self):
+        pt.seed(3)
+        rng = np.random.RandomState(5)
+        model = Net()
+        asp.prune_model(model, n=2, m=4)
+        assert asp.calculate_density(model.fc1.weight) <= 0.5 + 1e-6
+        o = asp.decorate(opt.SGD(learning_rate=0.05,
+                                 parameters=model.parameters()))
+        x = rng.randn(32, 8).astype(np.float32)
+        y = rng.randn(32, 4).astype(np.float32)
+        for _ in range(5):
+            loss = ((model(pt.to_tensor(x)) - pt.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        # sparsity survives training steps
+        assert asp.check_mask_1d(np.asarray(model.fc1.weight.data), 2, 4)
+        assert asp.calculate_density(model.fc1.weight) <= 0.5 + 1e-6
+
+    def test_excluded_layers(self):
+        pt.seed(4)
+        model = Net()
+        asp.set_excluded_layers(model, ["fc2.weight"])
+        asp.prune_model(model)
+        assert asp.calculate_density(model.fc2.weight) > 0.9
+        asp.reset_excluded_layers(model)
