@@ -46,6 +46,8 @@ from paddle_tpu import vision  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import quantization  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
+from paddle_tpu import inference  # noqa: F401
+from paddle_tpu import utils  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr  # noqa: F401
 
 bool = bool_  # paddle.bool

@@ -10,7 +10,8 @@ training (the semantics the reference guarantees).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import weakref
+from typing import Dict, List
 
 import numpy as np
 
@@ -19,11 +20,27 @@ __all__ = ["calculate_density", "decorate", "prune_model",
            "get_mask_1d", "get_mask_2d_greedy", "check_mask_1d",
            "ASPHelper", "OptimizerWithSparsityGuarantee"]
 
-_excluded: Dict[int, List[str]] = {}
-_masks: Dict[int, Dict[str, np.ndarray]] = {}
-# id(param) -> (param, mask): lets a decorated optimizer re-mask exactly
-# the params it manages, independent of which model object was pruned
-_param_masks: Dict[int, tuple] = {}
+# all registries hold weakrefs: ids are reused by CPython, so a dead
+# model/param must drop out rather than alias a new object at the same
+# address (and masks must not pin every pruned param for process lifetime)
+_excluded: Dict[int, tuple] = {}     # id(model) -> (weakref, [names])
+_masks: Dict[int, tuple] = {}        # id(model) -> (weakref, {name: mask})
+_param_masks: Dict[int, tuple] = {}  # id(param) -> (weakref, mask)
+
+
+def _live(registry: Dict[int, tuple], key) -> bool:
+    entry = registry.get(key)
+    if entry is None:
+        return False
+    if entry[0]() is None:
+        del registry[key]
+        return False
+    return True
+
+
+def _prune_dead(registry: Dict[int, tuple]):
+    for key in [k for k, (ref, _) in registry.items() if ref() is None]:
+        del registry[key]
 
 
 def calculate_density(x) -> float:
@@ -70,7 +87,8 @@ def get_mask_2d_greedy(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
 
 
 def set_excluded_layers(model, param_names: List[str]):
-    _excluded[id(model)] = list(param_names)
+    _prune_dead(_excluded)
+    _excluded[id(model)] = (weakref.ref(model), list(param_names))
 
 
 def reset_excluded_layers(model=None):
@@ -93,8 +111,12 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
     import jax.numpy as jnp
     algo = {"mask_1d": get_mask_1d, "mask_2d_greedy": get_mask_2d_greedy}[
         mask_algo]
-    excluded = set(_excluded.get(id(model), ()))
-    masks = _masks.setdefault(id(model), {})
+    _prune_dead(_param_masks)
+    excluded = set(_excluded[id(model)][1]) if _live(_excluded, id(model)) \
+        else set()
+    if not _live(_masks, id(model)):
+        _masks[id(model)] = (weakref.ref(model), {})
+    masks = _masks[id(model)][1]
     for name, p in model.named_parameters():
         if name in excluded or not _supported(name, p):
             continue
@@ -104,7 +126,7 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
         p.data = jnp.asarray(w * mask)
         if with_mask:
             masks[name] = mask
-            _param_masks[id(p)] = (p, mask)
+            _param_masks[id(p)] = (weakref.ref(p), mask)
     return masks
 
 
@@ -122,7 +144,7 @@ class OptimizerWithSparsityGuarantee:
         for g in self._inner._param_groups:
             for p in g["params"]:
                 entry = _param_masks.get(id(p))
-                if entry is not None:
+                if entry is not None and entry[0]() is p:
                     p.data = jnp.asarray(np.asarray(p.data) * entry[1])
         return out
 
@@ -141,4 +163,6 @@ class ASPHelper:
 
     @staticmethod
     def masks_for(model):
-        return dict(_masks.get(id(model), {}))
+        if _live(_masks, id(model)):
+            return dict(_masks[id(model)][1])
+        return {}

@@ -1,0 +1,158 @@
+"""paddle.inference parity — Config / create_predictor / Predictor.
+
+Reference: ``python/paddle/inference/__init__.py`` binding
+``paddle/fluid/inference/api/analysis_predictor.cc`` (AnalysisPredictor:
+load saved program + params, run analysis passes, execute). TPU shape:
+the saved artifact is already a compiled-serialized XLA program
+(``jit.save`` StableHLO export), so "analysis passes + engine" collapse
+into XLA AOT — the Predictor deserializes, places weights, and runs the
+executable, keeping the reference's handle-based zero-copy API
+(input/output handles are device arrays; ``copy_from_cpu`` is the H2D
+boundary).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "create_predictor", "Predictor", "Tensor",
+           "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    TPU = 1
+
+
+class Config:
+    """paddle.inference.Config parity (api/paddle_analysis_config.h
+    surface, TPU-relevant subset)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._model_dir = prog_file
+        self._params_file = params_file
+        self._device = "tpu"
+        self._enable_memory_optim = True
+        self._switch_ir_optim = True  # XLA owns optimization; kept for API
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.__init__(prog_file, params_file)
+
+    def model_dir(self):
+        return self._model_dir
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._enable_memory_optim = flag
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._switch_ir_optim = flag
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def summary(self) -> str:
+        return (f"Config(model={self._model_dir}, device={self._device}, "
+                f"memory_optim={self._enable_memory_optim})")
+
+
+class Tensor:
+    """Predictor IO handle (reference: ``paddle_infer::Tensor`` —
+    zero-copy views into executor memory)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        assert self._is_input, "copy_from_cpu on an output handle"
+        self._owner._feed[self.name] = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes are static in the exported XLA program
+
+    def copy_to_cpu(self) -> np.ndarray:
+        assert not self._is_input, "copy_to_cpu on an input handle"
+        return np.asarray(self._owner._fetch[self.name])
+
+    def shape(self):
+        if self._is_input:
+            a = self._owner._feed.get(self.name)
+            return list(a.shape) if a is not None else None
+        return list(np.asarray(self._owner._fetch[self.name]).shape)
+
+
+class Predictor:
+    """Runs a ``jit.save`` artifact (reference AnalysisPredictor::Run)."""
+
+    def __init__(self, config: Config):
+        import paddle_tpu as pt
+
+        self._config = config
+        path = config.model_dir()
+        if path is None or not os.path.exists(path + ".pdmodel"):
+            raise FileNotFoundError(
+                f"no inference model at {path}.pdmodel; export one with "
+                "paddle_tpu.jit.save(layer, path, input_spec=...)")
+        self._layer = pt.jit.load(path)
+        n_in = len(self._layer._exported.in_avals)
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._feed = {}
+        self._fetch = {}
+        self._output_names: List[str] = []
+
+    # -- handle API (reference: get_input_handle/get_output_handle) ----------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=True)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=False)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either pass arrays positionally (newer paddle
+        ``predictor.run([x])``) or pre-fill input handles."""
+        if inputs is not None:
+            for name, arr in zip(self._input_names, inputs):
+                self._feed[name] = np.ascontiguousarray(arr)
+        missing = [n for n in self._input_names if n not in self._feed]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        args = [self._feed[n] for n in self._input_names]
+        out = self._layer._exported.call(*args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._fetch = dict(zip(self._output_names, outs))
+        return [np.asarray(o) for o in outs]
+
+    def try_shrink_memory(self):
+        pass
+
+    def clear_intermediate_tensor(self):
+        self._feed.clear()
+        self._fetch.clear()
+
+
+def create_predictor(config: Config) -> Predictor:
+    """paddle.inference.create_predictor parity."""
+    return Predictor(config)

@@ -78,10 +78,15 @@ class QuantConfig:
         return self._customized_leaves
 
     # -- resolution ------------------------------------------------------------
-    def _get_config_by_layer(self, layer,
-                             name: str = "") -> Optional[SingleLayerConfig]:
-        if id(layer) in self._layer2config:
-            return self._layer2config[id(layer)]
+    def _get_config_by_layer(self, layer, name: str = "",
+                             orig_layer=None) -> Optional[SingleLayerConfig]:
+        """``name`` is the FULL dotted path (the reference matches
+        full_name()); ``orig_layer`` is the pre-deepcopy layer so
+        add_layer_config identities survive quantize(inplace=False)."""
+        for key in (id(layer), id(orig_layer) if orig_layer is not None
+                    else None):
+            if key is not None and key in self._layer2config:
+                return self._layer2config[key]
         if name in self._name2config:
             return self._name2config[name]
         for t, cfg in self._type2config.items():
@@ -91,9 +96,10 @@ class QuantConfig:
             return self._global_config
         return None
 
-    def _is_quantifiable(self, layer, name: str = "") -> bool:
-        return self._get_config_by_layer(layer, name) is not None and \
-            type(layer) in self._qat_layer_mapping
+    def _is_quantifiable(self, layer, name: str = "",
+                         orig_layer=None) -> bool:
+        return self._get_config_by_layer(layer, name, orig_layer) \
+            is not None and type(layer) in self._qat_layer_mapping
 
 
 def _default_qat_mapping():

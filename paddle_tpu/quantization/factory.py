@@ -20,8 +20,10 @@ class QuanterFactory:
 
 
 def quanter(name=None):
-    """Class decorator registering a quanter and giving it a factory
-    constructor (reference factory.py:quanter)."""
+    """Class decorator turning a quanter Layer class into a factory
+    constructor (reference factory.py:quanter): ``MyQuanter(bits=8)``
+    then yields a QuanterFactory for QuantConfig, instantiated fresh per
+    wrapped layer."""
     def deco(cls):
-        return cls
+        return QuanterFactory(cls)
     return deco

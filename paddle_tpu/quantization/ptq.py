@@ -17,6 +17,7 @@ __all__ = ["PTQ"]
 
 class PTQ(Quantization):
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        orig = model
         if not inplace:
             model = copy.deepcopy(model)
 
@@ -24,7 +25,7 @@ class PTQ(Quantization):
             obs = cfg.activation._instance(child) \
                 if cfg.activation is not None else None
             return ObserveWrapper(obs, child)
-        return self._walk_replace(model, make)
+        return self._walk_replace(model, make, orig)
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
         """Replace observed layers with quanted layers whose activation
@@ -33,6 +34,7 @@ class PTQ(Quantization):
             model = copy.deepcopy(model)
         mapping = self._config.qat_layer_mappings
         self._convert_walk(model, mapping)
+        model.eval()  # deployment form: quanter scales stay frozen
         return model
 
     def _convert_walk(self, model: Layer, mapping):
@@ -45,6 +47,13 @@ class PTQ(Quantization):
                 # fake-quanter FROZEN at the observed calibration scale
                 quanted = mapping[type(observed)](
                     observed, SingleLayerConfig(None, cfg.weight))
+                if quanted.weight_quanter is not None:
+                    # calibrate the weight scale from the weights now (PTQ
+                    # never trains, so the quanter would otherwise stay at
+                    # scale 0 = no-op)
+                    quanted.weight_quanter.train()
+                    quanted.weight_quanter(observed.weight)
+                    quanted.weight_quanter.eval()
                 if child._observer is not None:
                     fq = FakeQuanterWithAbsMaxObserverLayer(
                         bit_length=child._observer.bit_length())

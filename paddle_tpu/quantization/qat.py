@@ -20,25 +20,34 @@ class Quantization:
     def __init__(self, config: QuantConfig):
         self._config = config
 
-    def _walk_replace(self, model: Layer, make):
+    def _walk_replace(self, model: Layer, make, orig=None, prefix=""):
+        """Walk ``model`` (possibly a deepcopy) in lockstep with ``orig``
+        (the user's original) so id-based add_layer_config still resolves,
+        matching names by full dotted path."""
+        orig = orig if orig is not None else model
         for name, child in list(model._sub_layers.items()):
-            if self._config._is_quantifiable(child, name):
-                cfg = self._config._get_config_by_layer(child, name)
+            ochild = orig._sub_layers.get(name, child)
+            path = f"{prefix}.{name}" if prefix else name
+            if self._config._is_quantifiable(child, path,
+                                             orig_layer=ochild):
+                cfg = self._config._get_config_by_layer(
+                    child, path, orig_layer=ochild)
                 model._sub_layers[name] = make(child, cfg)
             else:
-                self._walk_replace(child, make)
+                self._walk_replace(child, make, ochild, path)
         return model
 
 
 class QAT(Quantization):
     def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        orig = model
         if not inplace:
             model = copy.deepcopy(model)
         mapping = self._config.qat_layer_mappings
 
         def make(child, cfg):
             return mapping[type(child)](child, cfg)
-        return self._walk_replace(model, make)
+        return self._walk_replace(model, make, orig)
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
         """Bake fake-quantized weights back into the plain layers for

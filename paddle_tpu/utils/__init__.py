@@ -1,0 +1,4 @@
+"""paddle.utils parity (reference: ``python/paddle/utils/``)."""
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension"]

@@ -1,0 +1,205 @@
+"""Custom C++ op extension — the native extension seam.
+
+Reference: ``python/paddle/utils/cpp_extension/`` (JIT ``load`` building a
+.so) + ``paddle/fluid/framework/custom_operator.cc:746``
+(RegisterOperatorWithMetaInfo: op registered from a compiled library with
+infer-shape + grad functions).
+
+TPU design: a custom C++ op runs as an XLA *host callback*
+(``jax.pure_callback``) so it composes with jit/to_static, and its
+gradient is wired through ``jax.custom_vjp`` onto the framework tape. This
+is the host-side seam; device-side custom kernels are written in Pallas
+(``paddle_tpu/ops/pallas/``) — the TPU analog of the reference's CUDA
+custom ops.
+
+Limitation (mirrors the reference, where a deployed model needs the
+custom-op .so loaded in the serving process): host callbacks cannot be
+*serialized* into a ``jit.save`` artifact (XLA export has no stable
+encoding for them), so models containing ctypes custom ops deploy via
+``to_static`` in-process, not via ``.pdmodel`` export. Pallas custom
+kernels have no such restriction.
+
+C ABI (the analog of ``paddle/extension.h``):
+
+.. code-block:: c
+
+    extern "C" void my_op(const float** ins, const int64_t** in_shapes,
+                          const int32_t* in_ndims, int32_t n_in,
+                          float** outs, const int64_t** out_shapes,
+                          const int32_t* out_ndims, int32_t n_out);
+
+The grad function (optional, named ``<op>_grad`` by convention) has the
+same signature; it receives ``inputs + output_grads`` as its inputs and
+writes one gradient per forward input.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "CppExtension", "custom_op", "get_build_directory"]
+
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_F32P = ctypes.POINTER(ctypes.c_float)
+_SIG = [ctypes.POINTER(_F32P), ctypes.POINTER(_I64P),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ctypes.POINTER(_F32P), ctypes.POINTER(_I64P),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+
+_build_lock = threading.Lock()
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.expanduser("~/.cache/paddle_tpu/extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Compiled-library handle; ``ops`` maps exported op names to python
+    callables (populated by ``custom_op``)."""
+
+    def __init__(self, name: str, lib: ctypes.CDLL, path: str):
+        self.name = name
+        self.lib = lib
+        self.path = path
+        self.ops = {}
+
+    def __getattr__(self, item):
+        ops = self.__dict__.get("ops", {})
+        if item in ops:
+            return ops[item]
+        raise AttributeError(item)
+
+
+def load(name: str, sources: Sequence[str], extra_cflags: Optional[List[str]]
+         = None, extra_ldflags: Optional[List[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> CppExtension:
+    """JIT-compile C++ sources to a shared library and load it
+    (reference: ``cpp_extension.load`` — same role, g++ instead of the
+    setuptools/nvcc path)."""
+    build = build_directory or get_build_directory()
+    so = os.path.join(build, f"lib{name}.so")
+    with _build_lock:
+        newest_src = max(os.path.getmtime(s) for s in sources)
+        if not os.path.exists(so) or os.path.getmtime(so) < newest_src:
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   *(extra_cflags or []), *sources, "-o",
+                   so + f".tmp{os.getpid()}", *(extra_ldflags or [])]
+            if verbose:
+                print(" ".join(cmd))
+            res = subprocess.run(cmd, capture_output=True, text=True)
+            if res.returncode != 0:
+                raise RuntimeError(
+                    f"cpp_extension build failed:\n{res.stderr}")
+            os.replace(so + f".tmp{os.getpid()}", so)
+    return CppExtension(name, ctypes.CDLL(so), so)
+
+
+def _bind(lib: ctypes.CDLL, symbol: str):
+    fn = getattr(lib, symbol)
+    fn.argtypes = _SIG
+    fn.restype = None
+    return fn
+
+
+def _invoke(cfn, in_arrays: Sequence[np.ndarray],
+            out_shapes: Sequence[tuple]) -> List[np.ndarray]:
+    ins = [np.ascontiguousarray(a, dtype=np.float32) for a in in_arrays]
+    outs = [np.zeros(s, dtype=np.float32) for s in out_shapes]
+    n_in, n_out = len(ins), len(outs)
+
+    in_ptrs = (_F32P * n_in)(*[a.ctypes.data_as(_F32P) for a in ins])
+    in_shape_arrs = [(ctypes.c_int64 * a.ndim)(*a.shape) for a in ins]
+    in_shapes = (_I64P * n_in)(*[ctypes.cast(s, _I64P)
+                                 for s in in_shape_arrs])
+    in_ndims = (ctypes.c_int32 * n_in)(*[a.ndim for a in ins])
+
+    out_ptrs = (_F32P * n_out)(*[a.ctypes.data_as(_F32P) for a in outs])
+    out_shape_arrs = [(ctypes.c_int64 * a.ndim)(*a.shape) for a in outs]
+    out_shapes_c = (_I64P * n_out)(*[ctypes.cast(s, _I64P)
+                                     for s in out_shape_arrs])
+    out_ndims = (ctypes.c_int32 * n_out)(*[a.ndim for a in outs])
+
+    cfn(in_ptrs, in_shapes, in_ndims, n_in,
+        out_ptrs, out_shapes_c, out_ndims, n_out)
+    return outs
+
+
+def custom_op(extension: CppExtension, op_name: str,
+              infer_shape: Callable[..., Sequence],
+              grad_op: Optional[str] = "auto",
+              num_outputs: int = 1) -> Callable:
+    """Register a compiled C function as a framework op.
+
+    ``infer_shape(*input_shapes) -> output shape (or list of shapes)`` is
+    the analog of the reference's SetInferShapeFn. ``grad_op="auto"``
+    looks for ``<op>_grad`` in the library; pass None for a
+    non-differentiable op. Returns an eager callable over Tensors that
+    also works under ``paddle_tpu.jit`` (host callback inside the
+    compiled program).
+    """
+    import jax
+
+    from paddle_tpu.core.autograd import apply_op
+
+    cfwd = _bind(extension.lib, op_name)
+    cbwd = None
+    if grad_op == "auto":
+        try:
+            cbwd = _bind(extension.lib, f"{op_name}_grad")
+        except AttributeError:
+            cbwd = None
+    elif grad_op:
+        cbwd = _bind(extension.lib, grad_op)
+
+    def out_struct(*arrays):
+        shapes = infer_shape(*[tuple(a.shape) for a in arrays])
+        if num_outputs == 1 and shapes and not isinstance(shapes[0],
+                                                          (tuple, list)):
+            shapes = [shapes]
+        return [jax.ShapeDtypeStruct(tuple(s), np.float32) for s in shapes]
+
+    def host_fwd(*arrays):
+        return _invoke(cfwd, arrays,
+                       [s.shape for s in out_struct(*arrays)])
+
+    @jax.custom_vjp
+    def fn(*arrays):
+        res = jax.pure_callback(host_fwd, out_struct(*arrays), *arrays)
+        return res[0] if num_outputs == 1 else tuple(res)
+
+    def fwd(*arrays):
+        return fn(*arrays), arrays
+
+    def bwd(arrays, gouts):
+        if cbwd is None:
+            raise RuntimeError(
+                f"custom op '{op_name}' has no grad function; mark its "
+                "inputs stop_gradient or provide <op>_grad")
+        gouts = (gouts,) if num_outputs == 1 else tuple(gouts)
+
+        def host_bwd(*ins_and_gouts):
+            n = len(arrays)
+            return _invoke(cbwd, ins_and_gouts,
+                           [a.shape for a in ins_and_gouts[:n]])
+        gin_struct = [jax.ShapeDtypeStruct(tuple(a.shape), np.float32)
+                      for a in arrays]
+        gins = jax.pure_callback(host_bwd, gin_struct, *arrays, *gouts)
+        return tuple(gins)
+
+    fn.defvjp(fwd, bwd)
+
+    def op_callable(*tensors):
+        return apply_op(fn, *tensors, op_name=op_name)
+
+    op_callable.__name__ = op_name
+    extension.ops[op_name] = op_callable
+    return op_callable

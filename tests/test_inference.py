@@ -1,0 +1,62 @@
+"""paddle.inference Config/Predictor tests over jit.save artifacts."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.static import InputSpec
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(pt.nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def exported(tmp_path_factory):
+    pt.seed(0)
+    model = Net()
+    model.eval()
+    path = str(tmp_path_factory.mktemp("infer") / "net")
+    pt.jit.save(model, path,
+                input_spec=[InputSpec([2, 8], "float32", "x")])
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    return path, x, model(pt.to_tensor(x)).numpy()
+
+
+def test_run_positional(exported):
+    path, x, ref = exported
+    pred = create_predictor(Config(path))
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_handle_api(exported):
+    path, x, ref = exported
+    pred = create_predictor(Config(path))
+    names = pred.get_input_names()
+    assert len(names) == 1
+    pred.get_input_handle(names[0]).copy_from_cpu(x)
+    pred.run()
+    out_names = pred.get_output_names()
+    out = pred.get_output_handle(out_names[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    assert pred.get_output_handle(out_names[0]).shape() == [2, 4]
+
+
+def test_missing_model_raises(tmp_path):
+    with pytest.raises(FileNotFoundError, match="jit.save"):
+        create_predictor(Config(str(tmp_path / "nope")))
+
+
+def test_pdmodel_suffix_accepted(exported):
+    path, x, ref = exported
+    pred = create_predictor(Config(path + ".pdmodel"))
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)

@@ -193,3 +193,86 @@ class TestASP:
         asp.prune_model(model)
         assert asp.calculate_density(model.fc2.weight) > 0.9
         asp.reset_excluded_layers(model)
+
+
+class TestReviewRegressions:
+    def test_layer_config_survives_deepcopy(self):
+        pt.seed(5)
+        model = Net()
+        cfg = QuantConfig()
+        cfg.add_layer_config(model.fc1,
+                             activation=FakeQuanterWithAbsMaxObserver(),
+                             weight=FakeQuanterWithAbsMaxObserver())
+        qmodel = QAT(cfg).quantize(model)  # inplace=False deepcopies
+        assert isinstance(qmodel.fc1, QuantedLinear)
+        assert isinstance(qmodel.fc2, nn.Linear)
+
+    def test_name_config_uses_full_path(self):
+        pt.seed(6)
+
+        class Outer(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.block = Net()
+
+            def forward(self, x):
+                return self.block(x)
+
+        model = Outer()
+        cfg = QuantConfig()
+        cfg.add_name_config("block.fc1",
+                            activation=FakeQuanterWithAbsMaxObserver(),
+                            weight=FakeQuanterWithAbsMaxObserver())
+        qmodel = QAT(cfg).quantize(model)
+        assert isinstance(qmodel.block.fc1, QuantedLinear)
+        assert isinstance(qmodel.block.fc2, nn.Linear)
+
+    def test_ptq_weight_quanter_calibrated(self):
+        pt.seed(7)
+        rng = np.random.RandomState(7)
+        model = Net()
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        observed(pt.to_tensor(rng.randn(8, 8).astype(np.float32)))
+        deployed = ptq.convert(observed)
+        wq = deployed.fc1.weight_quanter
+        assert wq is not None and float(wq.scales().numpy()) > 0
+        assert not deployed.fc1.activation_quanter.training
+
+    def test_quanter_decorator_makes_factory(self):
+        from paddle_tpu.quantization import quanter, BaseQuanter
+        from paddle_tpu.quantization.factory import QuanterFactory
+
+        @quanter("MyQ")
+        class MyQ(BaseQuanter):
+            def __init__(self, k=1):
+                super().__init__()
+                self.k = k
+
+            def forward(self, x):
+                return x
+
+            def scales(self):
+                return None
+
+        f = MyQ(k=3)
+        assert isinstance(f, QuanterFactory)
+        inst = f._instance(None)
+        assert inst.k == 3
+
+    def test_asp_registry_weakrefs(self):
+        import gc
+        import paddle_tpu.incubate.asp as asp_mod
+        pt.seed(8)
+        gc.collect()
+        asp_mod._prune_dead(asp_mod._param_masks)
+        before = len(asp_mod._param_masks)
+        m = Net()
+        asp.prune_model(m)
+        assert len(asp_mod._param_masks) > before
+        del m
+        gc.collect()
+        asp_mod._prune_dead(asp_mod._param_masks)
+        assert len(asp_mod._param_masks) == before
