@@ -30,3 +30,5 @@ from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model,
 )
 from .tcp_store import TCPStore  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import Engine, Strategy  # noqa: F401

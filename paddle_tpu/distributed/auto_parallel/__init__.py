@@ -1,0 +1,27 @@
+"""Semi-automatic SPMD parallelism (paddle.distributed.auto_parallel).
+
+Reference: ``python/paddle/distributed/auto_parallel/`` — the static-graph
+GSPMD-like planner: users mark tensors with ``ProcessMesh`` + shardings
+(``interface.py:shard_tensor``), a ``Completer`` propagates dist attrs
+through the graph (``completion.py:107``), a ``Partitioner`` splits the
+program per rank (``partitioner.py:38``), a ``Resharder`` inserts comm ops
+(``reshard.py:1006``), and an ``Engine`` drives fit/evaluate/predict
+(``engine.py:56``).
+
+TPU mapping (SURVEY.md §7 step 8): XLA's GSPMD pass IS the
+Completer+Partitioner+Resharder — user annotations become
+``NamedSharding`` constraints on a jitted program, the compiler propagates
+shardings to every intermediate, partitions per device, and inserts the
+collectives. What remains to build is the annotation surface (shard_tensor
+/ reshard, re-exported from the dist API) and the Engine driver, which
+compiles one SPMD train step over the mesh.
+"""
+from paddle_tpu.distributed.mesh import ProcessMesh  # noqa: F401
+from paddle_tpu.distributed.sharding_api import (  # noqa: F401
+    Shard, Replicate, Partial, shard_tensor, reshard,
+)
+from .strategy import Strategy  # noqa: F401
+from .engine import Engine  # noqa: F401
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "reshard", "Strategy", "Engine"]

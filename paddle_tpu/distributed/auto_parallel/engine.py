@@ -1,0 +1,158 @@
+"""auto_parallel Engine (reference:
+``python/paddle/distributed/auto_parallel/engine.py:56`` — Engine drives
+``_build:513 → _plan:670 → _parallel:698`` then fit/evaluate/predict).
+
+Here _build+_plan+_parallel collapse into one SPMD ``TrainStep``
+compilation: the mesh comes from the user (or defaults to pure DP over
+all devices), parameter shardings come from ``shard_tensor`` annotations,
+batch shardings from ``input_spec``, and XLA GSPMD performs the
+completion/partition/reshard the reference implements in Python.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Engine"]
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None):
+        from .strategy import Strategy
+
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = list(metrics) if metrics is not None else []
+        self._strategy = strategy or Strategy()
+        self._mesh = None
+        self._train_step = None
+        self._predict_fn = None
+        self.history = {"loss": []}
+
+    # -- planning --------------------------------------------------------------
+    def _ensure_mesh(self):
+        import paddle_tpu.distributed as dist
+        if self._mesh is None:
+            self._mesh = dist.get_mesh() or dist.init_mesh()
+        return self._mesh
+
+    def prepare(self, mesh=None, input_spec=None):
+        """Fix the mesh (and batch sharding) ahead of fit; optional — fit
+        defaults to sharding batch dim 0 over the mesh's first axis."""
+        import paddle_tpu.distributed as dist
+        if mesh is not None:
+            self._mesh = mesh.to_jax() if hasattr(mesh, "to_jax") else mesh
+            dist.set_mesh(self._mesh)
+        self._input_spec = input_spec
+        return self
+
+    def _loss_fn(self):
+        loss_layer = self._loss
+
+        def fn(model, *batch):
+            *inputs, label = batch
+            out = model(*inputs)
+            return loss_layer(out, label)
+        return fn
+
+    def _build_step(self):
+        import paddle_tpu as pt
+        from paddle_tpu.distributed import P
+
+        mesh = self._ensure_mesh()
+        spec = getattr(self, "_input_spec", None)
+        if spec is None:
+            spec = P(mesh.axis_names[0])
+        self._train_step = pt.jit.TrainStep(
+            self._model, self._loss_fn(), self._optimizer, mesh=mesh,
+            input_spec=spec)
+        return self._train_step
+
+    def _loader(self, data, batch_size):
+        from paddle_tpu.io import DataLoader, Dataset
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=False,
+                              drop_last=True)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _to_tensors(batch):
+        import paddle_tpu as pt
+        from paddle_tpu.core.tensor import Tensor
+        items = batch if isinstance(batch, (list, tuple)) else [batch]
+        return [x if isinstance(x, Tensor) else pt.to_tensor(np.asarray(x))
+                for x in items]
+
+    # -- reference surface (engine.py fit:811 / evaluate / predict) ----------
+    def fit(self, train_data, epochs: int = 1, batch_size: int = 1,
+            steps_per_epoch: Optional[int] = None, log_freq: int = 10,
+            verbose: int = 0):
+        if self._train_step is None:
+            self._build_step()
+        loader = self._loader(train_data, batch_size)
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                loss = self._train_step(*self._to_tensors(batch))
+                val = float(loss.numpy())
+                self.history["loss"].append(val)
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: loss {val:.5f}")
+        return self.history
+
+    def evaluate(self, valid_data, batch_size: int = 1, steps=None,
+                 verbose: int = 0):
+        import paddle_tpu as pt
+        loader = self._loader(valid_data, batch_size)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        with pt.no_grad():
+            for step, batch in enumerate(loader):
+                if steps is not None and step >= steps:
+                    break
+                tensors = self._to_tensors(batch)
+                *inputs, label = tensors
+                out = self._model(*inputs)
+                if self._loss is not None:
+                    losses.append(float(self._loss(out, label).numpy()))
+                for m in self._metrics:
+                    c = m.compute(out, label)
+                    m.update(*(c if isinstance(c, (tuple, list))
+                               else (c,)))
+        results = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            results[m.name()] = m.accumulate()
+        return results
+
+    def predict(self, test_data, batch_size: int = 1, steps=None):
+        import paddle_tpu as pt
+        loader = self._loader(test_data, batch_size)
+        outs = []
+        with pt.no_grad():
+            for step, batch in enumerate(loader):
+                if steps is not None and step >= steps:
+                    break
+                tensors = self._to_tensors(batch)
+                outs.append(self._model(*tensors).numpy())
+        return outs
+
+    def save(self, path: str):
+        import paddle_tpu as pt
+        pt.save(self._model.state_dict(), path + ".pdparams")
+        if self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            pt.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str):
+        import paddle_tpu as pt
+        self._model.set_state_dict(pt.load(path + ".pdparams"))
+        import os
+        if self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(pt.load(path + ".pdopt"))

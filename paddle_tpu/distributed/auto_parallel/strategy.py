@@ -1,0 +1,34 @@
+"""auto_parallel Strategy (reference:
+``python/paddle/distributed/auto_parallel/strategy.py`` — a bag of
+feature configs the planner consults: amp, recompute, sharding,
+gradient_merge...)."""
+from __future__ import annotations
+
+__all__ = ["Strategy"]
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def __repr__(self):
+        return repr(self.__dict__)
+
+
+class Strategy:
+    """Feature toggles consulted by the Engine. Defaults mirror the
+    reference's (everything off)."""
+
+    def __init__(self):
+        self.amp = _Config(enable=False, dtype="bfloat16", level="O1")
+        self.recompute = _Config(enable=False, checkpoints=None)
+        self.sharding = _Config(enable=False, stage=1, degree=1)
+        self.gradient_merge = _Config(enable=False, k_steps=1, avg=True)
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1)
+        self.dataset = _Config(use_cache=False)
+
+    def __repr__(self):
+        return (f"Strategy(amp={self.amp}, recompute={self.recompute}, "
+                f"sharding={self.sharding}, "
+                f"gradient_merge={self.gradient_merge})")

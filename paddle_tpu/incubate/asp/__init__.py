@@ -112,6 +112,8 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
     algo = {"mask_1d": get_mask_1d, "mask_2d_greedy": get_mask_2d_greedy}[
         mask_algo]
     _prune_dead(_param_masks)
+    _prune_dead(_masks)
+    _prune_dead(_excluded)
     excluded = set(_excluded[id(model)][1]) if _live(_excluded, id(model)) \
         else set()
     if not _live(_masks, id(model)):

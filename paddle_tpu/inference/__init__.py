@@ -48,7 +48,12 @@ class Config:
         self._switch_ir_optim = True  # XLA owns optimization; kept for API
 
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
-        self.__init__(prog_file, params_file)
+        # only the model paths change; configured options stay (reference
+        # AnalysisConfig::SetModel semantics)
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._model_dir = prog_file
+        self._params_file = params_file
 
     def model_dir(self):
         return self._model_dir
@@ -133,6 +138,10 @@ class Predictor:
         """Execute. Either pass arrays positionally (newer paddle
         ``predictor.run([x])``) or pre-fill input handles."""
         if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"model expects {len(self._input_names)} inputs, got "
+                    f"{len(inputs)}")
             for name, arr in zip(self._input_names, inputs):
                 self._feed[name] = np.ascontiguousarray(arr)
         missing = [n for n in self._input_names if n not in self._feed]

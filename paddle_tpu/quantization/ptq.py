@@ -24,7 +24,7 @@ class PTQ(Quantization):
         def make(child, cfg):
             obs = cfg.activation._instance(child) \
                 if cfg.activation is not None else None
-            return ObserveWrapper(obs, child)
+            return ObserveWrapper(obs, child, cfg)
         return self._walk_replace(model, make, orig)
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
@@ -41,8 +41,7 @@ class PTQ(Quantization):
         for name, child in list(model._sub_layers.items()):
             if isinstance(child, ObserveWrapper):
                 observed = child._observed
-                cfg = self._config._get_config_by_layer(observed, name) or \
-                    self._config._global_config
+                cfg = child._q_config  # resolved at quantize time
                 # weight quanter from the config; activation quanter is a
                 # fake-quanter FROZEN at the observed calibration scale
                 quanted = mapping[type(observed)](

@@ -55,12 +55,15 @@ class QuantedConv2D(_QuantedBase):
 
 class ObserveWrapper(Layer):
     """PTQ wrapper: observe the input, then run the original layer
-    unchanged (reference wrapper.py:ObserveWrapper)."""
+    unchanged (reference wrapper.py:ObserveWrapper). Carries the resolved
+    quant config so ``PTQ.convert`` needs no re-resolution (which would
+    miss per-layer ids across the quantize deepcopy)."""
 
-    def __init__(self, observer, observed: Layer):
+    def __init__(self, observer, observed: Layer, q_config=None):
         super().__init__()
         self._observer = observer
         self._observed = observed
+        self._q_config = q_config
 
     def forward(self, *args, **kwargs):
         if self._observer is not None and args:

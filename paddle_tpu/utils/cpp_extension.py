@@ -162,9 +162,12 @@ def custom_op(extension: CppExtension, op_name: str,
 
     def out_struct(*arrays):
         shapes = infer_shape(*[tuple(a.shape) for a in arrays])
-        if num_outputs == 1 and shapes and not isinstance(shapes[0],
-                                                          (tuple, list)):
-            shapes = [shapes]
+        if num_outputs == 1:
+            # a single shape arrives bare: (3, 4), [3, 4], or () for a
+            # scalar — wrap unless it is already a list OF shapes
+            if not (isinstance(shapes, (tuple, list)) and len(shapes)
+                    and isinstance(shapes[0], (tuple, list))):
+                shapes = [tuple(shapes)]
         return [jax.ShapeDtypeStruct(tuple(s), np.float32) for s in shapes]
 
     def host_fwd(*arrays):
