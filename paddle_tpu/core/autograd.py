@@ -450,9 +450,11 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
         grad_outputs = [None] * len(outputs)
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
-    cots = [jnp.ones(t.data.shape, t.data.dtype) if g is None
-            else (g.data if isinstance(g, Tensor) else jnp.asarray(g))
-            for t, g in zip(outputs, grad_outputs)]
+    # Tensor-valued cotangents enter the differentiable call as arguments —
+    # the result must stay differentiable w.r.t. them (forward_grad's
+    # vjp-of-vjp construction depends on d(J^T w)/dw; the reference keeps
+    # this linearity because its grads are graph ops over grad_outputs)
+    cot_tensors = [g for g in grad_outputs if isinstance(g, Tensor)]
     # an output that is itself a requested input must resolve to the
     # replay ARGUMENT (grad(y, y) is the identity), not the recomputed value
     out_keys = [("leaf", id(t)) if (id(t) in input_ids or
@@ -472,6 +474,17 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
     all_args = list(inputs) + extras
 
     def g_fn(*arrs):
+        leaf_arrs = arrs[: len(all_args)]
+        cot_arrs = iter(arrs[len(all_args):])
+        cots = []
+        for t, g in zip(outputs, grad_outputs):
+            if isinstance(g, Tensor):
+                cots.append(next(cot_arrs))
+            elif g is None:
+                cots.append(jnp.ones(t.data.shape, t.data.dtype))
+            else:
+                cots.append(jnp.asarray(g))
+
         def replay(*inner):
             env = {}  # (id(node), slot) -> value
             leaf_env = {id(t): a for t, a in zip(all_args, inner)}
@@ -499,10 +512,10 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
                     outs.append(env[key])
             return tuple(outs)
 
-        _, vjp = jax.vjp(replay, *arrs)
+        _, vjp = jax.vjp(replay, *leaf_arrs)
         return vjp(tuple(cots))[: len(inputs)]
 
-    grads = apply_op(g_fn, *all_args, op_name="grad")
+    grads = apply_op(g_fn, *all_args, *cot_tensors, op_name="grad")
     grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
     results = []
     for t, g in zip(inputs, grads):

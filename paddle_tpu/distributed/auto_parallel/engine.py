@@ -71,13 +71,15 @@ class Engine:
             input_spec=spec)
         return self._train_step
 
-    def _loader(self, data, batch_size):
+    def _loader(self, data, batch_size, drop_last=False):
+        # drop_last only for the SPMD fit step (static batch shape);
+        # evaluate/predict must see the tail samples
         from paddle_tpu.io import DataLoader, Dataset
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=False,
-                              drop_last=True)
+                              drop_last=drop_last)
         return data  # any iterable of batches
 
     @staticmethod
@@ -94,7 +96,7 @@ class Engine:
             verbose: int = 0):
         if self._train_step is None:
             self._build_step()
-        loader = self._loader(train_data, batch_size)
+        loader = self._loader(train_data, batch_size, drop_last=True)
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
@@ -128,7 +130,13 @@ class Engine:
                                else (c,)))
         results = {"loss": float(np.mean(losses)) if losses else None}
         for m in self._metrics:
-            results[m.name()] = m.accumulate()
+            name, acc = m.name(), m.accumulate()
+            if isinstance(name, (list, tuple)):  # e.g. Accuracy(topk=(1,5))
+                for n, a in zip(name, acc if isinstance(acc, (list, tuple))
+                                else [acc]):
+                    results[n] = a
+            else:
+                results[name] = acc
         return results
 
     def predict(self, test_data, batch_size: int = 1, steps=None):
@@ -140,6 +148,8 @@ class Engine:
                 if steps is not None and step >= steps:
                     break
                 tensors = self._to_tensors(batch)
+                if isinstance(batch, (list, tuple)) and len(tensors) > 1:
+                    tensors = tensors[:-1]  # (x, y) datasets: drop the label
                 outs.append(self._model(*tensors).numpy())
         return outs
 

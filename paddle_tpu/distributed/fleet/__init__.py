@@ -27,12 +27,14 @@ from .mpu import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,
 )
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
            "worker_num", "worker_index", "mpu", "ColumnParallelLinear",
            "RowParallelLinear", "VocabParallelEmbedding",
-           "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel", "MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ring_attention", "ulysses_attention", "scatter_sequence", "gather_sequence"]
+           "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel", "MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ring_attention", "ulysses_attention", "scatter_sequence", "gather_sequence", "utils", "recompute"]
 
 _state = {"hcg": None, "strategy": None}
 

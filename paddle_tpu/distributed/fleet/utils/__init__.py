@@ -1,0 +1,132 @@
+"""fleet.utils — activation recomputation (gradient checkpointing).
+
+Capability parity with the reference's
+``python/paddle/distributed/fleet/utils/__init__.py`` ``recompute`` (backed by
+``fleet/recompute/recompute.py``: a PyLayer that stashes RNG state + inputs,
+drops activations, and re-runs the forward inside backward).
+
+TPU-native redesign: rematerialization is a *compiler* feature on XLA —
+``jax.checkpoint`` marks the region and XLA re-emits the forward ops inside
+the backward computation, so there is no RNG stash/restore dance (the replayed
+HLO reuses the traced-in RNG values, which is exactly "preserve_rng_state").
+The tape integration is one ``apply_op`` call whose vjp closure is the
+checkpointed function's — saving only the region's *inputs*, not its
+activations, in the GradNode.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from paddle_tpu.core import autograd as _ag
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _owning_layer(function) -> Layer | None:
+    if isinstance(function, Layer):
+        return function
+    bound = getattr(function, "__self__", None)
+    return bound if isinstance(bound, Layer) else None
+
+
+def _wrap_tree(obj):
+    """Rebuild Tensor wrappers around jax arrays for the inner call."""
+    if isinstance(obj, jax.Array) or hasattr(obj, "aval"):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_wrap_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _wrap_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def _unwrap_tree(obj):
+    if isinstance(obj, Tensor):
+        return obj.data
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unwrap_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _unwrap_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def recompute(function, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, **kwargs):
+    """Run ``function(*args, **kwargs)`` without saving its activations;
+    the forward is re-run (by XLA rematerialization) during backward.
+
+    ``function`` may be a ``Layer``, a bound method of a ``Layer`` (its
+    parameters/buffers are threaded through so their gradients flow), or a
+    pure function of its tensor arguments. ``preserve_rng_state`` and
+    ``use_reentrant`` are accepted for API parity; RNG preservation is
+    inherent (see module docstring).
+    """
+    del preserve_rng_state, use_reentrant
+    layer = _owning_layer(function)
+    call = layer.forward if layer is not None and isinstance(function, Layer) \
+        else function
+
+    if layer is not None:
+        from paddle_tpu.jit.functional import swap_state
+        named = list(layer.named_parameters()) + [
+            (n, b) for n, b in layer.named_buffers() if b is not None]
+        names = [n for n, _ in named]
+        state_tensors = [t for _, t in named]
+    else:
+        names, state_tensors = [], []
+
+    def region(state_list, arg_tree, kw_tree):
+        # everything below runs on (possibly traced) jax arrays; the tape
+        # must not record the inner ops — the whole region is ONE tape node
+        with _ag.no_grad():
+            w_args = _wrap_tree(arg_tree)
+            w_kwargs = _wrap_tree(kw_tree)
+            if layer is not None:
+                from paddle_tpu.jit.functional import swap_state
+                with swap_state(layer, dict(zip(names, state_list)),
+                                collect_buffers=False):
+                    out = call(*w_args, **w_kwargs)
+            else:
+                out = call(*w_args, **w_kwargs)
+        return _unwrap_tree(out)
+
+    ckpt = jax.checkpoint(region)
+    return _ag.apply_op(ckpt, list(state_tensors), list(args), dict(kwargs),
+                        op_name="recompute")
+
+
+def recompute_sequential(ctx: Any, functions, *args):
+    """Segment a ``Sequential``-like list of layers and recompute each segment
+    (reference: ``incubate/distributed/fleet/recompute_sequential``).
+
+    ``ctx`` accepts ``{"segments": N}`` (default 1 segment per layer).
+    """
+    layers = list(functions)
+    segments = int((ctx or {}).get("segments", len(layers))) or 1
+    per = max(1, (len(layers) + segments - 1) // segments)
+    out = args
+    for i in range(0, len(layers), per):
+        chunk = layers[i:i + per]
+
+        class _Seg(Layer):
+            def __init__(self, mods):
+                super().__init__()
+                for j, m in enumerate(mods):
+                    setattr(self, f"seg{j}", m)
+                self._mods = mods
+
+            def forward(self, *xs):
+                for m in self._mods:
+                    xs = m(*xs) if isinstance(xs, tuple) else m(xs)
+                    if not isinstance(xs, tuple):
+                        xs = (xs,)
+                return xs if len(xs) > 1 else xs[0]
+
+        seg = _Seg(chunk)
+        res = recompute(seg, *(out if isinstance(out, tuple) else (out,)))
+        out = res
+    return out

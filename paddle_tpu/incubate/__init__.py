@@ -1,6 +1,7 @@
 """paddle.incubate parity — experimental subsystems (reference:
 ``python/paddle/incubate/``). Currently: ASP (automatic structured
-sparsity)."""
+sparsity) and functional/forward-mode autodiff (``incubate.autograd``)."""
 from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
 
-__all__ = ["asp"]
+__all__ = ["asp", "autograd"]

@@ -1,0 +1,272 @@
+"""Functional / forward-mode autodiff ("prim") APIs.
+
+Capability parity with the reference's ``python/paddle/incubate/autograd/``
+(``primapi.py:25 forward_grad``, ``:108 grad``, ``functional.py`` jvp/vjp/
+Jacobian/Hessian; SURVEY.md §2.3 "prim (composite ops)").
+
+TPU-native redesign: the reference lowers ops to "primitive" ops so its static
+autodiff can transform them (``primx.py``, ``composite_rules.py``). On XLA that
+decomposition layer is the compiler's job, so here the functional transforms
+are direct applications of jax's forward/reverse AD over a purified view of
+the user function, and the tape-based ``forward_grad`` uses the
+double-reverse (vjp-of-vjp) construction over the eager tape — which the
+tape's ``create_graph`` replay already supports.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import autograd as _ag
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad",
+    "enable_prim", "disable_prim", "prim_enabled",
+]
+
+# ---------------------------------------------------------------------------
+# prim switch — the reference toggles static-graph op lowering
+# (primapi enable_prim/disable_prim). Under XLA the decomposition happens in
+# the compiler unconditionally, so the flag only tracks user intent.
+_prim_state = {"enabled": False}
+
+
+def enable_prim():
+    _prim_state["enabled"] = True
+
+
+def disable_prim():
+    _prim_state["enabled"] = False
+
+
+def prim_enabled() -> bool:
+    return _prim_state["enabled"]
+
+
+# ---------------------------------------------------------------------------
+# purification: Tensor-level callable -> jax-array-level callable
+
+
+def _as_seq(x) -> List:
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _purify(func: Callable, n_in: int):
+    """Wrap a Tensor->Tensor function as a pure jax-array function.
+
+    The body runs under ``no_grad`` so the eager tape records nothing while
+    jax traces through the ops (apply_op takes its non-recording path and the
+    tracer arrays flow straight through the jnp calls).
+    """
+
+    meta = {"multi": False}
+
+    def pure(*arrays):
+        with _ag.no_grad():
+            xs = [Tensor(a) for a in arrays]
+            out = func(*xs)
+        outs = _as_seq(out)
+        meta["multi"] = isinstance(out, (list, tuple))
+        return tuple(o.data if isinstance(o, Tensor) else jnp.asarray(o)
+                     for o in outs)
+
+    return pure, meta
+
+
+def _wrap_out(arrays, multi: bool):
+    ts = [Tensor(a) for a in arrays]
+    return ts if multi else ts[0]
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode Jacobian-vector product.
+
+    Returns ``(func(xs), J @ v)``; ``v`` defaults to all-ones like the
+    reference (``incubate/autograd/functional.py`` jvp).
+    """
+    xs_l = _as_seq(xs)
+    arrays = [x.data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs_l]
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        tangents = [t.data if isinstance(t, Tensor) else jnp.asarray(t)
+                    for t in _as_seq(v)]
+    pure, meta = _purify(func, len(arrays))
+    out, jvp_out = jax.jvp(pure, tuple(arrays), tuple(tangents))
+    return _wrap_out(out, meta["multi"]), _wrap_out(jvp_out, meta["multi"])
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode vector-Jacobian product.
+
+    Returns ``(func(xs), v^T @ J)``; ``v`` defaults to all-ones like the
+    reference.
+    """
+    xs_l = _as_seq(xs)
+    multi_in = isinstance(xs, (list, tuple))
+    arrays = [x.data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs_l]
+    pure, meta = _purify(func, len(arrays))
+    out, vjp_fn = jax.vjp(pure, *arrays)
+    if v is None:
+        cots = tuple(jnp.ones_like(o) for o in out)
+    else:
+        cots = tuple(t.data if isinstance(t, Tensor) else jnp.asarray(t)
+                     for t in _as_seq(v))
+    in_cots = vjp_fn(cots)
+    outs = _wrap_out(out, meta["multi"])
+    grads = [Tensor(g) for g in in_cots]
+    return outs, (grads if multi_in else grads[0])
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference ``incubate/autograd/functional.py``
+    Jacobian).
+
+    Non-batched: ``func: R^N -> R^M`` gives shape ``[M, N]``.
+    Batched (``is_batched=True``): leading dim of ``xs`` is a batch dim B and
+    the result is ``[B, M, N]``.
+
+    The full matrix is computed on first access (via ``jax.jacrev`` — one
+    compiled sweep, not a Python loop) and cached; indexing slices it.
+    """
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = xs if isinstance(xs, Tensor) else Tensor(jnp.asarray(xs))
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        pure, _ = _purify(lambda x: self._func(x), 1)
+
+        def single(a):
+            out = pure(a)[0]
+            return out.reshape(-1)
+
+        a = self._xs.data
+        if self._is_batched:
+            def per_sample(s):
+                return single(s)
+            jac = jax.vmap(jax.jacrev(per_sample))(a)
+            b = a.shape[0]
+            self._mat = jac.reshape(b, jac.shape[1], -1)
+        else:
+            jac = jax.jacrev(single)(a)
+            self._mat = jac.reshape(jac.shape[0], -1)
+        return self._mat
+
+    @property
+    def shape(self):
+        return list(self._compute().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._compute()[idx])
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._compute())
+
+
+class Hessian:
+    """Lazy Hessian of a scalar function (reference Hessian).
+
+    Non-batched: ``func: R^N -> R`` gives ``[N, N]``; batched gives
+    ``[B, N, N]`` with ``func`` mapping each batch row to a scalar.
+    """
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = xs if isinstance(xs, Tensor) else Tensor(jnp.asarray(xs))
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is not None:
+            return self._mat
+        pure, _ = _purify(lambda x: self._func(x), 1)
+
+        def scalar(a):
+            out = pure(a)[0]
+            return out.reshape(())
+
+        a = self._xs.data
+        if self._is_batched:
+            def per_sample(s):
+                flat = jax.hessian(lambda q: scalar(q))(s)
+                n = s.size
+                return flat.reshape(n, n)
+            self._mat = jax.vmap(per_sample)(a)
+        else:
+            h = jax.hessian(scalar)(a)
+            n = a.size
+            self._mat = h.reshape(n, n)
+        return self._mat
+
+    @property
+    def shape(self):
+        return list(self._compute().shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._compute()[idx])
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._compute())
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode gradients over the *eager tape* (reference
+    ``primapi.py:25 forward_grad``, which requires prim static mode).
+
+    Computed by the standard double-reverse construction: with
+    ``u = (∂y/∂x)^T w`` (reverse pass, differentiable in ``w``), the
+    forward-mode product is ``J v = ∂/∂w <u, v>`` (second reverse pass) —
+    both passes ride the tape's ``create_graph`` replay.
+    """
+    ys = _as_seq(outputs)
+    xs = _as_seq(inputs)
+    if grad_inputs is None:
+        vs = [Tensor(jnp.ones_like(x.data)) for x in xs]
+    else:
+        vs = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+              for t in _as_seq(grad_inputs)]
+
+    ws = []
+    for y in ys:
+        w = Tensor(jnp.zeros_like(y.data), stop_gradient=False)
+        ws.append(w)
+    # u_j = sum_i w_i^T (dy_i/dx_j): linear in w, differentiable via replay
+    us = _ag.grad(ys, xs, grad_outputs=ws, create_graph=True,
+                  allow_unused=True)
+    from paddle_tpu import ops as _ops
+    total = None
+    for u, v in zip(us, vs):
+        if u is None:
+            continue
+        term = _ops.sum(_ops.multiply(u, v))
+        total = term if total is None else _ops.add(total, term)
+    if total is None:
+        out = [Tensor(jnp.zeros_like(y.data)) for y in ys]
+        return out if isinstance(outputs, (list, tuple)) else out[0]
+    gs = _ag.grad([total], ws, allow_unused=True)
+    out = []
+    for g, y in zip(gs, ys):
+        out.append(g if g is not None else Tensor(jnp.zeros_like(y.data)))
+    return out if isinstance(outputs, (list, tuple)) else out[0]
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Differentiable reverse-mode grad (reference ``primapi.py:108`` — prim
+    grads stay differentiable for higher orders; here that is the tape's
+    ``create_graph`` replay)."""
+    res = _ag.grad(_as_seq(outputs), _as_seq(inputs),
+                   grad_outputs=grad_outputs, create_graph=True,
+                   allow_unused=True)
+    return res if isinstance(inputs, (list, tuple)) else res[0]

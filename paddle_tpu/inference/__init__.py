@@ -39,11 +39,8 @@ class Config:
 
     def __init__(self, prog_file: Optional[str] = None,
                  params_file: Optional[str] = None):
-        if prog_file is not None and prog_file.endswith(".pdmodel"):
-            prog_file = prog_file[: -len(".pdmodel")]
-        self._model_dir = prog_file
-        self._params_file = params_file
         self._device = "tpu"
+        self.set_model(prog_file, params_file)
         self._enable_memory_optim = True
         self._switch_ir_optim = True  # XLA owns optimization; kept for API
 

@@ -1,0 +1,106 @@
+"""incubate.autograd (prim) — jvp/vjp/Jacobian/Hessian/forward_grad.
+
+Oracle parity with the reference's ``python/paddle/incubate/autograd``
+functional API, checked against analytic numpy derivatives.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate import autograd as pag
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def test_jvp_matches_analytic():
+    x = pt.to_tensor(np.array([0.3, 1.1, -0.4], np.float32))
+    v = pt.to_tensor(np.array([1.0, -2.0, 0.5], np.float32))
+    out, dot = pag.jvp(lambda t: pt.ops.sin(t), x, v)
+    np.testing.assert_allclose(_np(out), np.sin(_np(x)), rtol=1e-6)
+    np.testing.assert_allclose(_np(dot), np.cos(_np(x)) * _np(v), rtol=1e-6)
+
+
+def test_jvp_default_tangent_is_ones():
+    x = pt.to_tensor(np.array([2.0, 3.0], np.float32))
+    _, dot = pag.jvp(lambda t: pt.ops.multiply(t, t), x)
+    np.testing.assert_allclose(_np(dot), 2 * _np(x), rtol=1e-6)
+
+
+def test_vjp_matches_analytic():
+    x = pt.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    v = pt.to_tensor(np.ones((2, 2), np.float32))
+    out, g = pag.vjp(lambda t: pt.ops.multiply(t, t), x, v)
+    np.testing.assert_allclose(_np(out), _np(x) ** 2, rtol=1e-6)
+    np.testing.assert_allclose(_np(g), 2 * _np(x), rtol=1e-6)
+
+
+def test_vjp_multiple_inputs():
+    a = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = pt.to_tensor(np.array([3.0, 4.0], np.float32))
+    (out, (ga, gb)) = pag.vjp(lambda x, y: pt.ops.multiply(x, y), [a, b])
+    np.testing.assert_allclose(_np(out), _np(a) * _np(b), rtol=1e-6)
+    np.testing.assert_allclose(_np(ga), _np(b), rtol=1e-6)
+    np.testing.assert_allclose(_np(gb), _np(a), rtol=1e-6)
+
+
+def test_jacobian_dense():
+    W = np.array([[1.0, 2.0, 0.0], [0.5, -1.0, 3.0]], np.float32)
+    x = pt.to_tensor(np.array([0.2, -0.3, 0.7], np.float32))
+    jac = pag.Jacobian(lambda t: pt.ops.matmul(
+        pt.to_tensor(W), t), x)
+    np.testing.assert_allclose(jac.numpy(), W, rtol=1e-6)
+    assert jac.shape == [2, 3]
+    np.testing.assert_allclose(np.asarray(jac[0, :].data), W[0], rtol=1e-6)
+
+
+def test_jacobian_batched():
+    x = pt.to_tensor(np.random.RandomState(0).randn(4, 3).astype(np.float32))
+    jac = pag.Jacobian(lambda t: pt.ops.multiply(t, t), x, is_batched=True)
+    got = jac.numpy()
+    assert got.shape == (4, 3, 3)
+    for b in range(4):
+        np.testing.assert_allclose(got[b], np.diag(2 * np.asarray(x.data)[b]),
+                                   rtol=1e-5)
+
+
+def test_hessian_quadratic():
+    A = np.array([[2.0, 1.0], [1.0, 4.0]], np.float32)
+
+    def f(t):
+        At = pt.ops.matmul(pt.to_tensor(A), t)
+        return pt.ops.multiply(pt.to_tensor(np.float32(0.5)),
+                               pt.ops.sum(pt.ops.multiply(t, At)))
+
+    x = pt.to_tensor(np.array([0.3, -0.2], np.float32))
+    hess = pag.Hessian(f, x)
+    # Hessian of 0.5 x^T A x (A symmetric) is A
+    np.testing.assert_allclose(hess.numpy(), A, rtol=1e-5)
+
+
+def test_forward_grad_on_tape():
+    x = pt.to_tensor(np.array([0.5, 1.5], np.float32))
+    x.stop_gradient = False
+    y = pt.ops.sum(pt.ops.multiply(pt.ops.sin(x), x))
+    v = pt.to_tensor(np.array([1.0, -1.0], np.float32))
+    (jv,) = pag.forward_grad([y], [x], [v])
+    expect = np.sum((np.cos(_np(x)) * _np(x) + np.sin(_np(x))) * _np(v))
+    np.testing.assert_allclose(np.asarray(jv.data), expect, rtol=1e-5)
+
+
+def test_prim_grad_differentiable():
+    x = pt.to_tensor(np.array(1.2, np.float32))
+    x.stop_gradient = False
+    y = pt.ops.multiply(pt.ops.multiply(x, x), x)  # x^3
+    g = pag.grad(y, x)  # 3x^2, still differentiable
+    g2 = pag.grad(g, x)  # 6x
+    np.testing.assert_allclose(np.asarray(g2.data), 6 * 1.2, rtol=1e-5)
+
+
+def test_prim_toggle():
+    assert not pag.prim_enabled()
+    pag.enable_prim()
+    assert pag.prim_enabled()
+    pag.disable_prim()
+    assert not pag.prim_enabled()

@@ -91,7 +91,9 @@ class TestRendezvous:
                  for r in range(4)]
         for p in procs:
             p.start()
-        results = [q.get(timeout=60) for _ in range(4)]
+        # each spawned worker pays a full jax import (~10s cold); under
+        # whole-suite CPU load 60s has proven flaky
+        results = [q.get(timeout=240) for _ in range(4)]
         for p in procs:
             p.join(timeout=30)
         for rank, vals in results:
