@@ -148,8 +148,13 @@ class Engine:
                 if steps is not None and step >= steps:
                     break
                 tensors = self._to_tensors(batch)
-                if isinstance(batch, (list, tuple)) and len(tensors) > 1:
-                    tensors = tensors[:-1]  # (x, y) datasets: drop the label
+                # drop a trailing label only for engines configured with a
+                # loss (fit/evaluate-style (inputs..., label) datasets);
+                # loss-less engines are pure predictors — every element is
+                # a model input (e.g. DiT's (x, t, y))
+                if self._loss is not None and \
+                        isinstance(batch, (list, tuple)) and len(tensors) > 1:
+                    tensors = tensors[:-1]
                 outs.append(self._model(*tensors).numpy())
         return outs
 

@@ -33,6 +33,40 @@ def _owning_layer(function) -> Layer | None:
     return bound if isinstance(bound, Layer) else None
 
 
+def _collect_state(function, layer):
+    """Every Tensor whose storage must be threaded through the checkpoint
+    region so its gradient flows: the owning Layer's params/buffers, or —
+    for a plain function — Layers/Tensors captured by its closure (the
+    ``recompute(lambda x: self.mlp(x), h)`` idiom; without this the closed-
+    over weights would trace as constants and silently stop training)."""
+    tensors, seen = [], set()
+
+    def add(t):
+        if t is not None and id(t) not in seen:
+            seen.add(id(t))
+            tensors.append(t)
+
+    def add_layer(lay):
+        for _, p in lay.named_parameters():
+            add(p)
+        for _, b in lay.named_buffers():
+            add(b)
+
+    if layer is not None:
+        add_layer(layer)
+        return tensors
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            obj = cell.cell_contents
+        except ValueError:  # empty cell
+            continue
+        if isinstance(obj, Layer):
+            add_layer(obj)
+        elif isinstance(obj, Tensor):
+            add(obj)
+    return tensors
+
+
 def _wrap_tree(obj):
     """Rebuild Tensor wrappers around jax arrays for the inner call."""
     if isinstance(obj, jax.Array) or hasattr(obj, "aval"):
@@ -69,29 +103,20 @@ def recompute(function, *args, preserve_rng_state: bool = True,
     layer = _owning_layer(function)
     call = layer.forward if layer is not None and isinstance(function, Layer) \
         else function
-
-    if layer is not None:
-        from paddle_tpu.jit.functional import swap_state
-        named = list(layer.named_parameters()) + [
-            (n, b) for n, b in layer.named_buffers() if b is not None]
-        names = [n for n, _ in named]
-        state_tensors = [t for _, t in named]
-    else:
-        names, state_tensors = [], []
+    state_tensors = _collect_state(function, layer)
 
     def region(state_list, arg_tree, kw_tree):
         # everything below runs on (possibly traced) jax arrays; the tape
         # must not record the inner ops — the whole region is ONE tape node
-        with _ag.no_grad():
-            w_args = _wrap_tree(arg_tree)
-            w_kwargs = _wrap_tree(kw_tree)
-            if layer is not None:
-                from paddle_tpu.jit.functional import swap_state
-                with swap_state(layer, dict(zip(names, state_list)),
-                                collect_buffers=False):
-                    out = call(*w_args, **w_kwargs)
-            else:
-                out = call(*w_args, **w_kwargs)
+        saved = [t._data for t in state_tensors]
+        for t, a in zip(state_tensors, state_list):
+            t._data = a
+        try:
+            with _ag.no_grad():
+                out = call(*_wrap_tree(arg_tree), **_wrap_tree(kw_tree))
+        finally:
+            for t, s in zip(state_tensors, saved):
+                t._data = s
         return _unwrap_tree(out)
 
     ckpt = jax.checkpoint(region)

@@ -123,6 +123,39 @@ def vjp(func: Callable, xs, v=None):
     return outs, (grads if multi_in else grads[0])
 
 
+def _flatten_inputs(func, xs, is_batched):
+    """Normalize Jacobian/Hessian inputs: a single Tensor passes through;
+    a list of Tensors is flattened into one vector (columns ordered by xs,
+    matching the reference) and ``func`` is re-wrapped to take the pieces.
+
+    Returns (wrapped_func, flat_array, split_fn) where ``split_fn`` maps a
+    flat array back to the per-input arrays.
+    """
+    if isinstance(xs, Tensor):
+        return (lambda x: func(x)), xs.data, (lambda a: (a,))
+    if not isinstance(xs, (list, tuple)):
+        return (lambda x: func(x)), jnp.asarray(xs), (lambda a: (a,))
+    if is_batched:
+        raise NotImplementedError(
+            "is_batched=True supports a single input tensor; flatten your "
+            "inputs or call per-input")
+    parts = [x.data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]
+    shapes = [p.shape for p in parts]
+    sizes = [int(jnp.size(p)) for p in parts]
+    offsets = []
+    off = 0
+    for s in sizes:
+        offsets.append(off)
+        off += s
+    flat = jnp.concatenate([p.reshape(-1) for p in parts])
+
+    def split(a):
+        return tuple(a[o:o + s].reshape(sh)
+                     for o, s, sh in zip(offsets, sizes, shapes))
+
+    return (lambda *x: func(*x)), flat, split
+
+
 class Jacobian:
     """Lazy Jacobian matrix (reference ``incubate/autograd/functional.py``
     Jacobian).
@@ -136,25 +169,24 @@ class Jacobian:
     """
 
     def __init__(self, func: Callable, xs, is_batched: bool = False):
-        self._func = func
-        self._xs = xs if isinstance(xs, Tensor) else Tensor(jnp.asarray(xs))
+        self._func, self._flat_x, self._split = _flatten_inputs(func, xs,
+                                                               is_batched)
         self._is_batched = is_batched
         self._mat = None
 
     def _compute(self):
         if self._mat is not None:
             return self._mat
-        pure, _ = _purify(lambda x: self._func(x), 1)
+        func, split = self._func, self._split
+        pure, _ = _purify(lambda *x: func(*x), 1)
 
         def single(a):
-            out = pure(a)[0]
+            out = pure(*split(a))[0]
             return out.reshape(-1)
 
-        a = self._xs.data
+        a = self._flat_x
         if self._is_batched:
-            def per_sample(s):
-                return single(s)
-            jac = jax.vmap(jax.jacrev(per_sample))(a)
+            jac = jax.vmap(jax.jacrev(single))(a)
             b = a.shape[0]
             self._mat = jac.reshape(b, jac.shape[1], -1)
         else:
@@ -182,24 +214,25 @@ class Hessian:
     """
 
     def __init__(self, func: Callable, xs, is_batched: bool = False):
-        self._func = func
-        self._xs = xs if isinstance(xs, Tensor) else Tensor(jnp.asarray(xs))
+        self._func, self._flat_x, self._split = _flatten_inputs(func, xs,
+                                                               is_batched)
         self._is_batched = is_batched
         self._mat = None
 
     def _compute(self):
         if self._mat is not None:
             return self._mat
-        pure, _ = _purify(lambda x: self._func(x), 1)
+        func, split = self._func, self._split
+        pure, _ = _purify(lambda *x: func(*x), 1)
 
         def scalar(a):
-            out = pure(a)[0]
+            out = pure(*split(a))[0]
             return out.reshape(())
 
-        a = self._xs.data
+        a = self._flat_x
         if self._is_batched:
             def per_sample(s):
-                flat = jax.hessian(lambda q: scalar(q))(s)
+                flat = jax.hessian(scalar)(s)
                 n = s.size
                 return flat.reshape(n, n)
             self._mat = jax.vmap(per_sample)(a)

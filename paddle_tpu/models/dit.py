@@ -1,0 +1,176 @@
+"""DiT — diffusion transformer (BASELINE.md "DiT/SD-3" config).
+
+Standard DiT-style architecture: patchify → N adaLN-Zero transformer blocks
+conditioned on (timestep, class) → unpatchify to the noise prediction. The
+reference ecosystem runs this family through PaddleMIX; in-tree the relevant
+capability seam is the fused attention stack (SURVEY.md §2.10 item 6), which
+here is the same flash-attention path the LLM families use.
+
+TPU notes: all shapes are static (patch grid fixed by config), timestep
+embedding is a single fused tape node, and adaLN modulation is elementwise —
+XLA fuses it into the surrounding matmuls.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu import ops
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+__all__ = ["DiTConfig", "DiT"]
+
+
+@dataclass
+class DiTConfig:
+    input_size: int = 32          # latent spatial size
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    depth: int = 28
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    learn_sigma: bool = True
+
+    @staticmethod
+    def dit_xl_2(**kw) -> "DiTConfig":
+        return DiTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "DiTConfig":
+        return DiTConfig(input_size=8, patch_size=2, in_channels=4,
+                         hidden_size=32, depth=2, num_heads=2,
+                         num_classes=10, **kw)
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep embedding, [B] -> [B, dim]."""
+    def f(ta):
+        half = dim // 2
+        freqs = jnp.exp(-math.log(max_period) *
+                        jnp.arange(half, dtype=jnp.float32) / half)
+        args = ta.astype(jnp.float32)[:, None] * freqs[None]
+        return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    return apply_op(f, t, op_name="timestep_embedding")
+
+
+def _modulate(x, shift, scl):
+    # x: [B,N,H], shift/scl: [B,H]
+    return ops.add(ops.multiply(x, ops.unsqueeze(ops.add(
+        ops.ones_like(scl), scl), 1)), ops.unsqueeze(shift, 1))
+
+
+class TimestepEmbedder(nn.Layer):
+    def __init__(self, hidden_size, freq_dim=256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.mlp = nn.Sequential(nn.Linear(freq_dim, hidden_size), nn.Silu(),
+                                 nn.Linear(hidden_size, hidden_size))
+
+    def forward(self, t):
+        return self.mlp(timestep_embedding(t, self.freq_dim))
+
+
+class LabelEmbedder(nn.Layer):
+    def __init__(self, num_classes, hidden_size):
+        super().__init__()
+        # +1 slot: the null/unconditional class for CFG dropout
+        self.embedding_table = nn.Embedding(num_classes + 1, hidden_size)
+
+    def forward(self, labels):
+        return self.embedding_table(labels)
+
+
+class DiTBlock(nn.Layer):
+    """Transformer block with adaLN-Zero conditioning."""
+
+    def __init__(self, hidden_size, num_heads, mlp_ratio):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(hidden_size, weight_attr=False,
+                                  bias_attr=False)
+        self.attn = nn.MultiHeadAttention(hidden_size, num_heads)
+        self.norm2 = nn.LayerNorm(hidden_size, weight_attr=False,
+                                  bias_attr=False)
+        mlp_dim = int(hidden_size * mlp_ratio)
+        self.mlp = nn.Sequential(nn.Linear(hidden_size, mlp_dim), nn.GELU(),
+                                 nn.Linear(mlp_dim, hidden_size))
+        # adaLN-Zero: projection initialized to zero so each block starts
+        # as identity
+        self.adaLN_modulation = nn.Sequential(
+            nn.Silu(), nn.Linear(hidden_size, 6 * hidden_size,
+                                 weight_attr=nn.initializer.Constant(0.0),
+                                 bias_attr=nn.initializer.Constant(0.0)))
+
+    def forward(self, x, c):
+        mods = ops.chunk(self.adaLN_modulation(c), 6, axis=-1)
+        shift_msa, scale_msa, gate_msa, shift_mlp, scale_mlp, gate_mlp = mods
+        h = _modulate(self.norm1(x), shift_msa, scale_msa)
+        x = ops.add(x, ops.multiply(ops.unsqueeze(gate_msa, 1),
+                                    self.attn(h)))
+        h = _modulate(self.norm2(x), shift_mlp, scale_mlp)
+        x = ops.add(x, ops.multiply(ops.unsqueeze(gate_mlp, 1), self.mlp(h)))
+        return x
+
+
+class FinalLayer(nn.Layer):
+    def __init__(self, hidden_size, patch_size, out_channels):
+        super().__init__()
+        self.norm_final = nn.LayerNorm(hidden_size, weight_attr=False,
+                                       bias_attr=False)
+        self.linear = nn.Linear(hidden_size,
+                                patch_size * patch_size * out_channels,
+                                weight_attr=nn.initializer.Constant(0.0),
+                                bias_attr=nn.initializer.Constant(0.0))
+        self.adaLN_modulation = nn.Sequential(
+            nn.Silu(), nn.Linear(hidden_size, 2 * hidden_size,
+                                 weight_attr=nn.initializer.Constant(0.0),
+                                 bias_attr=nn.initializer.Constant(0.0)))
+
+    def forward(self, x, c):
+        shift, scl = ops.chunk(self.adaLN_modulation(c), 2, axis=-1)
+        return self.linear(_modulate(self.norm_final(x), shift, scl))
+
+
+class DiT(nn.Layer):
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.out_channels = cfg.in_channels * (2 if cfg.learn_sigma else 1)
+        self.x_embedder = nn.Conv2D(cfg.in_channels, cfg.hidden_size,
+                                    kernel_size=cfg.patch_size,
+                                    stride=cfg.patch_size)
+        self.t_embedder = TimestepEmbedder(cfg.hidden_size)
+        self.y_embedder = LabelEmbedder(cfg.num_classes, cfg.hidden_size)
+        n_patches = (cfg.input_size // cfg.patch_size) ** 2
+        self.pos_embed = self.create_parameter(
+            shape=[1, n_patches, cfg.hidden_size],
+            default_initializer=nn.initializer.Normal(std=0.02))
+        self.blocks = nn.LayerList([
+            DiTBlock(cfg.hidden_size, cfg.num_heads, cfg.mlp_ratio)
+            for _ in range(cfg.depth)])
+        self.final_layer = FinalLayer(cfg.hidden_size, cfg.patch_size,
+                                      self.out_channels)
+
+    def unpatchify(self, x):
+        c, p = self.out_channels, self.cfg.patch_size
+        hw = self.cfg.input_size // p
+        x = ops.reshape(x, [x.shape[0], hw, hw, p, p, c])
+        x = ops.transpose(x, [0, 5, 1, 3, 2, 4])  # [B,C,hw,p,hw,p]
+        return ops.reshape(x, [x.shape[0], c, hw * p, hw * p])
+
+    def forward(self, x, t, y):
+        """x: [B,C,H,W] latents; t: [B] timesteps; y: [B] class ids."""
+        x = self.x_embedder(x)                       # [B,H,h',w']
+        B, H = x.shape[0], x.shape[1]
+        x = ops.transpose(ops.reshape(x, [B, H, -1]), [0, 2, 1])  # [B,N,H]
+        x = ops.add(x, self.pos_embed)
+        c = ops.add(self.t_embedder(t), self.y_embedder(y))
+        for block in self.blocks:
+            x = block(x, c)
+        x = self.final_layer(x, c)
+        return self.unpatchify(x)

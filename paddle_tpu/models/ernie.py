@@ -1,0 +1,132 @@
+"""ERNIE family (BASELINE.md "ERNIE pretraining MFU" config).
+
+BERT-shaped bidirectional encoder with ERNIE's task heads; parity target is
+the paddle ecosystem's ErnieModel surface (the reference repo's NLP zoo lives
+in PaddleNLP; its in-tree seam is the transformer layer set,
+``python/paddle/nn/layer/transformer.py``). Built on paddle_tpu's own
+TransformerEncoder so attention rides the same flash/XLA path as Llama.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from paddle_tpu import ops
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForPretraining"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def tiny(**kw) -> "ErnieConfig":
+        return ErnieConfig(vocab_size=128, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           intermediate_size=64,
+                           max_position_embeddings=64, type_vocab_size=2,
+                           **kw)
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        S = input_ids.shape[1]
+        pos = ops.arange(0, S, dtype="int64")
+        x = self.word_embeddings(input_ids)
+        x = ops.add(x, self.position_embeddings(pos))
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        x = ops.add(x, self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieModel(nn.Layer):
+    """Returns (sequence_output [B,S,H], pooled_output [B,H])."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        x = self.encoder(x, src_mask=attention_mask)
+        pooled = ops.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes: int = 2,
+                 dropout: float = None):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob
+                                  if dropout is None else dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return logits, F.cross_entropy(logits, labels)
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM + sentence-order heads (ERNIE pretraining objective shape)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.sop_classifier = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, masked_lm_labels=None,
+                sop_labels=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        # decode against the (tied) word embedding matrix
+        w = self.ernie.embeddings.word_embeddings.weight
+        mlm_logits = ops.matmul(h, ops.transpose(w, [1, 0]))
+        sop_logits = self.sop_classifier(pooled)
+        if masked_lm_labels is None:
+            return mlm_logits, sop_logits
+        loss = F.cross_entropy(
+            ops.reshape(mlm_logits, [-1, mlm_logits.shape[-1]]),
+            ops.reshape(masked_lm_labels, [-1]), ignore_index=-100)
+        if sop_labels is not None:
+            loss = ops.add(loss, F.cross_entropy(sop_logits, sop_labels))
+        return mlm_logits, sop_logits, loss

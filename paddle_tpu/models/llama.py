@@ -1,0 +1,241 @@
+"""Llama-3 family (BASELINE.md north-star model).
+
+Capability parity target: the PaddleNLP Llama recipe the reference runs for
+its headline numbers (the reference repo itself carries no LLM zoo; its
+fused-attention seam is ``paddle/phi/kernels/gpu/flash_attn_kernel.cu``).
+
+TPU-first design decisions:
+  * attention goes through ``nn.functional.flash_attention`` → the Pallas
+    flash kernel on TPU;
+  * GQA (num_key_value_heads < num_attention_heads) is a reshape +
+    broadcast, no repeat_interleave materialization;
+  * with ``tensor_parallel=True`` the projections are mpu Column/Row
+    parallel layers and the embedding is vocab-parallel — GSPMD places the
+    collectives (SURVEY.md §7 principle 3);
+  * rotary embedding is a single fused tape node (one jnp body), cached
+    per (seq, dim, dtype).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu import ops
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    tensor_parallel: bool = False
+    recompute: bool = False
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_70b(**kw) -> "LlamaConfig":
+        return LlamaConfig(hidden_size=8192, intermediate_size=28672,
+                           num_hidden_layers=80, num_attention_heads=64,
+                           num_key_value_heads=8, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-size config: runs forward+backward in <1s on CPU."""
+        base = dict(vocab_size=256, hidden_size=64,
+                    intermediate_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, num_key_value_heads=2,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _rope_cache(seq_len: int, dim: int, theta: float, dtype):
+    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [S, dim/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(q, k, theta: float = 500000.0):
+    """Rotate q,k ([B,S,H,D]) by position. One tape node, fused by XLA."""
+    def f(qa, ka):
+        s, d = qa.shape[1], qa.shape[-1]
+        cos, sin = _rope_cache(s, d, theta, qa.dtype)
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+
+        def rot(x):
+            x1, x2 = x[..., 0::2], x[..., 1::2]
+            r1 = x1 * cos - x2 * sin
+            r2 = x2 * cos + x1 * sin
+            # re-interleave even/odd lanes
+            return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+        return rot(qa), rot(ka)
+    return apply_op(f, q, k, op_name="rotary_embedding")
+
+
+def _linear_cls(cfg: LlamaConfig, kind: str):
+    if not cfg.tensor_parallel:
+        return None
+    from paddle_tpu.distributed.fleet import (
+        ColumnParallelLinear, RowParallelLinear)
+    return ColumnParallelLinear if kind == "col" else RowParallelLinear
+
+
+def _make_linear(cfg, d_in, d_out, kind):
+    cls = _linear_cls(cfg, kind)
+    if cls is None:
+        return nn.Linear(d_in, d_out, bias_attr=False)
+    if kind == "col":
+        return cls(d_in, d_out, has_bias=False, gather_output=False)
+    return cls(d_in, d_out, has_bias=False, input_is_parallel=True)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.n_heads = cfg.num_attention_heads
+        self.n_kv = cfg.num_key_value_heads
+        self.q_proj = _make_linear(cfg, cfg.hidden_size,
+                                   self.n_heads * self.head_dim, "col")
+        self.k_proj = _make_linear(cfg, cfg.hidden_size,
+                                   self.n_kv * self.head_dim, "col")
+        self.v_proj = _make_linear(cfg, cfg.hidden_size,
+                                   self.n_kv * self.head_dim, "col")
+        self.o_proj = _make_linear(cfg, self.n_heads * self.head_dim,
+                                   cfg.hidden_size, "row")
+
+    def forward(self, x):
+        B, S = x.shape[0], x.shape[1]
+        q = ops.reshape(self.q_proj(x), [B, S, self.n_heads, self.head_dim])
+        k = ops.reshape(self.k_proj(x), [B, S, self.n_kv, self.head_dim])
+        v = ops.reshape(self.v_proj(x), [B, S, self.n_kv, self.head_dim])
+        q, k = apply_rotary(q, k, self.cfg.rope_theta)
+        if self.n_kv != self.n_heads:
+            # GQA: expand KV heads by broadcast (free under XLA)
+            rep = self.n_heads // self.n_kv
+            k = ops.reshape(
+                ops.expand(ops.unsqueeze(k, 3), [B, S, self.n_kv, rep,
+                                                 self.head_dim]),
+                [B, S, self.n_heads, self.head_dim])
+            v = ops.reshape(
+                ops.expand(ops.unsqueeze(v, 3), [B, S, self.n_kv, rep,
+                                                 self.head_dim]),
+                [B, S, self.n_heads, self.head_dim])
+        out = F.flash_attention(q, k, v, causal=True)
+        return self.o_proj(ops.reshape(out, [B, S, -1]))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = _make_linear(cfg, cfg.hidden_size,
+                                      cfg.intermediate_size, "col")
+        self.up_proj = _make_linear(cfg, cfg.hidden_size,
+                                    cfg.intermediate_size, "col")
+        self.down_proj = _make_linear(cfg, cfg.intermediate_size,
+                                      cfg.hidden_size, "row")
+
+    def forward(self, x):
+        return self.down_proj(
+            ops.multiply(F.silu(self.gate_proj(x)), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                          epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = ops.add(x, self.self_attn(self.input_layernorm(x)))
+        x = ops.add(x, self.mlp(self.post_attention_layernorm(x)))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            from paddle_tpu.distributed.fleet import VocabParallelEmbedding
+            self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
+                                                       cfg.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            if self.cfg.recompute and self.training:
+                from paddle_tpu.distributed.fleet import recompute
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = _make_linear(cfg, cfg.hidden_size,
+                                        cfg.vocab_size, "col")
+
+    def forward(self, input_ids, labels=None):
+        h = self.model(input_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = ops.matmul(h, ops.transpose(
+                self.model.embed_tokens.weight, [1, 0]))
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            ops.reshape(logits, [-1, logits.shape[-1]]),
+            ops.reshape(labels, [-1]))
+        return logits, loss
+
+    @staticmethod
+    def flops_per_token(cfg: LlamaConfig) -> float:
+        """Analytic fwd FLOPs/token (2 MAC) — feeds MFU accounting."""
+        d, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+        hd = d // cfg.num_attention_heads
+        kv = cfg.num_key_value_heads * hd
+        per_layer = 2 * d * (d + 2 * kv + d) + 2 * 3 * d * f
+        return L * per_layer + 2 * d * cfg.vocab_size

@@ -1,0 +1,153 @@
+"""Mixture-of-Experts decoder LMs (BASELINE.md DeepSeekMoE / Qwen2-MoE
+configs).
+
+Reference capability: ``python/paddle/incubate/distributed/models/moe/
+moe_layer.py:261`` (MoELayer + global_scatter/gather) — here the expert
+dispatch is the expert-parallel ``fleet.moe.MoELayer`` (GShard-style
+combine/dispatch einsums, expert axis sharded on the mesh).
+
+The decoder reuses the Llama attention stack; only the FFN differs:
+  * ``num_shared_experts > 0`` adds DeepSeekMoE's always-on shared experts
+    alongside the routed ones;
+  * Qwen2-MoE shape = shared expert + fine-grained routed experts with
+    top-k gating — both are config points of the same block.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from paddle_tpu import ops
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from .llama import LlamaAttention, LlamaConfig, LlamaMLP
+
+__all__ = ["MoeConfig", "MoeDecoderLayer", "MoeForCausalLM"]
+
+
+@dataclass
+class MoeConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    intermediate_size: int = 5632       # shared-expert / dense FFN width
+    moe_intermediate_size: int = 1408   # per routed expert
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    num_shared_experts: int = 1
+    first_k_dense_replace: int = 1      # DeepSeekMoE: first layers stay dense
+    rope_theta: float = 1000000.0
+    rms_norm_eps: float = 1e-6
+    aux_loss_weight: float = 0.01
+    tensor_parallel: bool = False
+
+    @staticmethod
+    def qwen2_moe_a14b(**kw) -> "MoeConfig":
+        return MoeConfig(hidden_size=3584, intermediate_size=18944,
+                         moe_intermediate_size=2560, num_hidden_layers=28,
+                         num_attention_heads=28, num_key_value_heads=4,
+                         num_experts=64, num_experts_per_tok=8,
+                         first_k_dense_replace=0, **kw)
+
+    @staticmethod
+    def deepseek_moe_16b(**kw) -> "MoeConfig":
+        return MoeConfig(vocab_size=102400, hidden_size=2048,
+                         intermediate_size=10944, moe_intermediate_size=1408,
+                         num_hidden_layers=28, num_attention_heads=16,
+                         num_key_value_heads=16, num_experts=64,
+                         num_experts_per_tok=6, num_shared_experts=2,
+                         first_k_dense_replace=1, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "MoeConfig":
+        return MoeConfig(vocab_size=128, hidden_size=32,
+                         intermediate_size=64, moe_intermediate_size=32,
+                         num_hidden_layers=2, num_attention_heads=2,
+                         num_key_value_heads=2, num_experts=4,
+                         num_experts_per_tok=2, num_shared_experts=1,
+                         first_k_dense_replace=1, **kw)
+
+    def _attn_cfg(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            rope_theta=self.rope_theta, rms_norm_eps=self.rms_norm_eps,
+            tensor_parallel=self.tensor_parallel)
+
+
+class MoeDecoderLayer(nn.Layer):
+    def __init__(self, cfg: MoeConfig, layer_idx: int):
+        super().__init__()
+        acfg = cfg._attn_cfg()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                          epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(acfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   epsilon=cfg.rms_norm_eps)
+        self.is_dense = layer_idx < cfg.first_k_dense_replace
+        if self.is_dense:
+            self.mlp = LlamaMLP(acfg)
+        else:
+            from paddle_tpu.distributed.fleet import MoELayer
+            self.mlp = MoELayer(cfg.hidden_size, cfg.moe_intermediate_size,
+                                cfg.num_experts, gate="gshard",
+                                top_k=cfg.num_experts_per_tok,
+                                activation="silu")
+            if cfg.num_shared_experts > 0:
+                shared_cfg = cfg._attn_cfg()
+                shared_cfg.intermediate_size = (
+                    cfg.moe_intermediate_size * cfg.num_shared_experts)
+                self.shared_expert = LlamaMLP(shared_cfg)
+            else:
+                self.shared_expert = None
+
+    def forward(self, x):
+        x = ops.add(x, self.self_attn(self.input_layernorm(x)))
+        h = self.post_attention_layernorm(x)
+        if self.is_dense:
+            return ops.add(x, self.mlp(h))
+        routed = self.mlp(h)
+        if self.shared_expert is not None:
+            routed = ops.add(routed, self.shared_expert(h))
+        return ops.add(x, routed)
+
+
+class MoeForCausalLM(nn.Layer):
+    """Decoder-only MoE LM; ``forward(ids, labels)`` returns
+    (logits, loss) with the gate-balance aux loss folded in."""
+
+    def __init__(self, cfg: MoeConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList([MoeDecoderLayer(cfg, i)
+                                    for i in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 bias_attr=False)
+
+    def aux_loss(self):
+        total = None
+        for layer in self.layers:
+            la = getattr(layer.mlp, "l_aux", None)
+            if la is not None:
+                total = la if total is None else ops.add(total, la)
+        return total
+
+    def forward(self, input_ids, labels=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x)
+        logits = self.lm_head(self.norm(x))
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(
+            ops.reshape(logits, [-1, logits.shape[-1]]),
+            ops.reshape(labels, [-1]))
+        aux = self.aux_loss()
+        if aux is not None:
+            loss = ops.add(loss, ops.scale(aux, self.cfg.aux_loss_weight))
+        return logits, loss

@@ -1,0 +1,124 @@
+"""PP-OCR-style text recognition model (BASELINE.md "PP-OCRv4" config —
+the conv-path exercise).
+
+Shape of the real PP-OCRv4 rec pipeline: a light conv backbone
+(MobileNet-ish depthwise blocks) → im2seq neck with a small recurrent/mixer
+encoder → CTC head. The reference runs this through PaddleOCR on the
+in-tree conv/pool/CTC kernels (``phi/kernels``); here conv lowers to
+``lax.conv_general_dilated`` (XLA picks the TPU conv strategy) and CTC is
+``nn.functional.ctc_loss``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from paddle_tpu import ops
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+__all__ = ["PPOCRRecConfig", "PPOCRRecModel"]
+
+
+@dataclass
+class PPOCRRecConfig:
+    in_channels: int = 3
+    num_classes: int = 6625      # charset + blank
+    hidden_size: int = 120
+    img_height: int = 48
+    widths: tuple = (32, 64, 128, 256)
+
+    @staticmethod
+    def tiny(**kw) -> "PPOCRRecConfig":
+        return PPOCRRecConfig(num_classes=16, hidden_size=32,
+                              img_height=16, widths=(8, 16, 24, 32), **kw)
+
+
+class ConvBNLayer(nn.Layer):
+    def __init__(self, cin, cout, kernel=3, stride=1, groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, kernel, stride=stride,
+                              padding=kernel // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+
+    def forward(self, x):
+        return F.hardswish(self.bn(self.conv(x)))
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = ConvBNLayer(cin, cin, 3, stride=stride, groups=cin)
+        self.pw = ConvBNLayer(cin, cout, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetBackbone(nn.Layer):
+    """Downsamples height to 1 and width by 4 (rec-model convention:
+    stride (2,1) blocks keep the sequence length usable)."""
+
+    def __init__(self, cfg: PPOCRRecConfig):
+        super().__init__()
+        w = cfg.widths
+        self.stem = ConvBNLayer(cfg.in_channels, w[0], 3, stride=2)
+        self.block1 = DepthwiseSeparable(w[0], w[1], stride=1)
+        self.block2 = DepthwiseSeparable(w[1], w[2], stride=2)
+        self.block3 = DepthwiseSeparable(w[2], w[3], stride=(2, 1))
+        self.pool_h = cfg.img_height // 8
+
+    def forward(self, x):
+        x = self.block3(self.block2(self.block1(self.stem(x))))
+        # collapse the remaining height: [B,C,h,W'] -> [B,C,1,W']
+        return F.max_pool2d(x, kernel_size=[self.pool_h, 1])
+
+
+class Im2Seq(nn.Layer):
+    def forward(self, x):
+        # [B,C,1,W] -> [B,W,C]
+        B, C = x.shape[0], x.shape[1]
+        return ops.transpose(ops.reshape(x, [B, C, -1]), [0, 2, 1])
+
+
+class SequenceEncoder(nn.Layer):
+    def __init__(self, cin, hidden):
+        super().__init__()
+        self.lstm = nn.LSTM(cin, hidden, num_layers=2,
+                            direction="bidirect")
+
+    def forward(self, x):
+        out, _ = self.lstm(x)
+        return out
+
+
+class CTCHead(nn.Layer):
+    def __init__(self, cin, num_classes):
+        super().__init__()
+        self.fc = nn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class PPOCRRecModel(nn.Layer):
+    """forward(images [B,C,H,W]) -> logits [B, W/4, num_classes];
+    ``loss(logits, labels, label_lengths)`` is the CTC objective."""
+
+    def __init__(self, cfg: PPOCRRecConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.backbone = MobileNetBackbone(cfg)
+        self.neck = Im2Seq()
+        self.encoder = SequenceEncoder(cfg.widths[-1], cfg.hidden_size)
+        self.head = CTCHead(2 * cfg.hidden_size, cfg.num_classes)
+
+    def forward(self, images):
+        return self.head(self.encoder(self.neck(self.backbone(images))))
+
+    def loss(self, logits, labels, label_lengths):
+        B, T = logits.shape[0], logits.shape[1]
+        log_probs = ops.transpose(F.log_softmax(logits, axis=-1), [1, 0, 2])
+        input_lengths = ops.full([B], T, dtype="int64")
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=0)

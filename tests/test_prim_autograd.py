@@ -104,3 +104,23 @@ def test_prim_toggle():
     assert pag.prim_enabled()
     pag.disable_prim()
     assert not pag.prim_enabled()
+
+
+def test_jacobian_multiple_inputs():
+    a = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = pt.to_tensor(np.array([3.0], np.float32))
+    jac = pag.Jacobian(lambda x, y: pt.ops.multiply(
+        x, pt.ops.expand(y, [2])), [a, b])
+    got = jac.numpy()  # [2, 3]: d(x*y)/dx = diag(y), d/dy = x
+    assert got.shape == (2, 3)
+    np.testing.assert_allclose(got[:, :2], np.diag([3.0, 3.0]), rtol=1e-6)
+    np.testing.assert_allclose(got[:, 2], [1.0, 2.0], rtol=1e-6)
+
+
+def test_hessian_multiple_inputs():
+    a = pt.to_tensor(np.array([1.0], np.float32))
+    b = pt.to_tensor(np.array([2.0], np.float32))
+    hess = pag.Hessian(lambda x, y: pt.ops.sum(
+        pt.ops.multiply(pt.ops.multiply(x, x), y)), [a, b])
+    got = hess.numpy()  # f = x^2 y: [[2y, 2x], [2x, 0]]
+    np.testing.assert_allclose(got, [[4.0, 2.0], [2.0, 0.0]], rtol=1e-5)

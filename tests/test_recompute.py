@@ -88,3 +88,15 @@ def test_recompute_with_dropout_is_consistent():
     # parity is inherent to XLA remat (same traced RNG values)
     for _, p in model.named_parameters():
         assert np.all(np.isfinite(np.asarray(p.grad.data)))
+
+
+def test_recompute_closure_over_layer_gets_grads():
+    """The ``recompute(lambda x: self.mlp(x), h)`` idiom: closed-over
+    Layer params must receive gradients."""
+    model = _mlp(seed=9)
+    x = pt.to_tensor(np.random.RandomState(9).randn(2, 8).astype(np.float32))
+    out = recompute(lambda t: model(t), x)
+    pt.ops.sum(out).backward()
+    for n, p in model.named_parameters():
+        assert p.grad is not None, n
+        assert float(np.abs(np.asarray(p.grad.data)).sum()) > 0, n
