@@ -48,6 +48,9 @@ from paddle_tpu import quantization  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import utils  # noqa: F401
+from paddle_tpu import text  # noqa: F401
+from paddle_tpu import audio  # noqa: F401
+from paddle_tpu import models  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr  # noqa: F401
 
 bool = bool_  # paddle.bool

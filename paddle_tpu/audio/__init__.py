@@ -1,0 +1,13 @@
+"""paddle.audio parity (reference: ``python/paddle/audio/``):
+``functional`` (mel scales, filterbanks, DCT, windows), ``features``
+(Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers), ``backends``
+(wav IO)."""
+from . import backends  # noqa: F401
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+from .features import (  # noqa: F401
+    MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram,
+)
+
+__all__ = ["backends", "features", "functional", "Spectrogram",
+           "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,70 @@
+"""paddle.audio.backends parity — wav load/save (reference:
+``python/paddle/audio/backends/`` wave_backend).
+
+stdlib ``wave`` + numpy: 16-bit PCM round-trip, no external audio lib.
+"""
+from __future__ import annotations
+
+import wave
+from typing import Tuple
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["load", "save", "info"]
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         8 * f.getsampwidth())
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """Returns (waveform [C, T] (or [T, C] if not channels_first), sr)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n_ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        count = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, n_ch)
+    if width == 1:  # 8-bit wav is unsigned
+        data = data.astype(np.int16) - 128
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    wavef = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(wavef)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         bits_per_sample: int = 16):
+    if bits_per_sample != 16:
+        raise NotImplementedError("only 16-bit PCM save is supported")
+    arr = np.asarray(src.data if isinstance(src, Tensor) else src)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    if channels_first:
+        arr = arr.T  # -> [T, C]
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

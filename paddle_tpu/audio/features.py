@@ -1,0 +1,148 @@
+"""paddle.audio.features parity — Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC layers (reference:
+``python/paddle/audio/features/layers.py:25,107,207,310``).
+
+TPU-first: the STFT is one fused tape node (frame gather + window multiply
++ rfft in a single jnp body — XLA fuses the elementwise work into the FFT's
+neighborhood), fully differentiable back to the waveform.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_power(x, window, n_fft, hop_length, center, pad_mode, power):
+    """[B, T] (or [T]) waveform -> [B, n_fft//2+1, frames] power spec."""
+    def f(wav, win):
+        w = wav if wav.ndim == 2 else wav[None]
+        if center:
+            pad = n_fft // 2
+            w = jnp.pad(w, ((0, 0), (pad, pad)), mode=pad_mode)
+        T = w.shape[-1]
+        frames = 1 + (T - n_fft) // hop_length
+        idx = (jnp.arange(frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])            # [F, n_fft]
+        seg = w[:, idx] * win[None, None, :]            # [B, F, n_fft]
+        spec = jnp.fft.rfft(seg, axis=-1)               # [B, F, n_fft/2+1]
+        mag = jnp.abs(spec)
+        out = mag if power == 1.0 else mag ** power
+        out = jnp.swapaxes(out, 1, 2)                   # [B, freq, F]
+        return out if wav.ndim == 2 else out[0]
+    return apply_op(f, x, window, op_name="stft")
+
+
+class Spectrogram(Layer):
+    """Reference: features/layers.py:25."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = np.asarray(AF.get_window(window, self.win_length).numpy())
+        if self.win_length < n_fft:  # center-pad the window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = np.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self.window = Tensor(jnp.asarray(w.astype(dtype)))
+
+    def forward(self, x):
+        return _stft_power(x, self.window, self.n_fft, self.hop_length,
+                           self.center, self.pad_mode, self.power)
+
+
+class MelSpectrogram(Layer):
+    """Reference: features/layers.py:107."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank_matrix = AF.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+
+        def f(fb, s):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+        return apply_op(f, self.fbank_matrix, spec, op_name="mel_fbank")
+
+
+class LogMelSpectrogram(Layer):
+    """Reference: features/layers.py:207."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self._melspectrogram(x),
+                              ref_value=self.ref_value, amin=self.amin,
+                              top_db=self.top_db)
+
+
+class MFCC(Layer):
+    """Reference: features/layers.py:310."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        assert n_mfcc <= n_mels, "n_mfcc cannot be larger than n_mels"
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = AF.create_dct(n_mfcc=n_mfcc, n_mels=n_mels,
+                                        dtype=dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)
+
+        def f(dct, s):
+            return jnp.einsum("mk,...mt->...kt", dct, s)
+        return apply_op(f, self.dct_matrix, logmel, op_name="mfcc_dct")

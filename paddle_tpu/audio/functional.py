@@ -1,0 +1,176 @@
+"""paddle.audio.functional parity (reference:
+``python/paddle/audio/functional/functional.py`` and ``window.py``).
+
+Pure array math (mel scales, filterbanks, DCT, windows) — computed with
+numpy/jnp and returned as Tensors; these feed the feature layers where the
+differentiable path matters.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _as_np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.data)
+    return np.asarray(x)
+
+
+def _wrap(x, dtype="float32"):
+    return Tensor(jnp.asarray(np.asarray(x, dtype)))
+
+
+def hz_to_mel(freq: Union[Tensor, float], htk: bool = False):
+    """Reference: functional.py:22 — slaney scale by default."""
+    f = _as_np(freq).astype(np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, mel)
+    return _wrap(mel) if isinstance(freq, Tensor) else float(mel)
+
+
+def mel_to_hz(mel: Union[Tensor, float], htk: bool = False):
+    """Reference: functional.py:78."""
+    m = _as_np(mel).astype(np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return _wrap(hz) if isinstance(mel, Tensor) else float(hz)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 10000.0, htk: bool = False,
+                    dtype: str = "float32"):
+    """Reference: functional.py:123."""
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return _wrap(_as_np(mel_to_hz(Tensor(jnp.asarray(mels)), htk)), dtype)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    """Reference: functional.py:163."""
+    return _wrap(np.linspace(0, sr / 2, 1 + n_fft // 2), dtype)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """Triangular mel filterbank, [n_mels, 1 + n_fft//2]
+    (reference: functional.py:186)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fftfreqs = np.asarray(_as_np(fft_frequencies(sr, n_fft, "float64")))
+    mel_f = np.asarray(_as_np(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk, "float64")))
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        length = np.linalg.norm(weights, ord=norm, axis=-1, keepdims=True)
+        weights = weights / np.maximum(length, 1e-10)
+    return _wrap(weights, dtype)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """10*log10(S/ref) with a dynamic-range floor
+    (reference: functional.py:259). Differentiable (runs on the tape)."""
+    from paddle_tpu.core.autograd import apply_op
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if top_db is not None and top_db < 0:
+        raise ValueError("top_db must be non-negative")
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    if isinstance(spect, Tensor):
+        return apply_op(f, spect, op_name="power_to_db")
+    return _wrap(f(jnp.asarray(spect)))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """DCT-II transform matrix [n_mels, n_mfcc]
+    (reference: functional.py:303)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm is None:
+        dct = dct * 2.0
+    elif norm == "ortho":
+        dct[:, 0] *= math.sqrt(1.0 / n_mels)
+        dct[:, 1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        raise ValueError(f"unsupported norm: {norm}")
+    return _wrap(dct, dtype)
+
+
+def _window_vals(name: str, M: int, sym: bool) -> np.ndarray:
+    """Window of length M; periodic form = symmetric of M+1 truncated
+    (scipy/reference window.py convention)."""
+    if M <= 1:
+        return np.ones(max(M, 0))
+    if not sym:
+        return _window_vals(name, M + 1, True)[:-1]
+    n = np.arange(M, dtype=np.float64)
+    d = M - 1
+    if name in ("hann", "hanning"):
+        return 0.5 - 0.5 * np.cos(2 * math.pi * n / d)
+    if name == "hamming":
+        return 0.54 - 0.46 * np.cos(2 * math.pi * n / d)
+    if name == "blackman":
+        return (0.42 - 0.5 * np.cos(2 * math.pi * n / d)
+                + 0.08 * np.cos(4 * math.pi * n / d))
+    if name in ("bartlett", "triang"):
+        return 1.0 - np.abs(2.0 * n / d - 1.0)
+    if name == "cosine":
+        return np.sin(math.pi * (n + 0.5) / M)
+    if name in ("rect", "rectangular", "boxcar", "ones"):
+        return np.ones(M)
+    raise ValueError(f"unsupported window: {name}")
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float32"):
+    """Reference: window.py:328 get_window. ``fftbins=True`` (default)
+    gives the periodic/DFT-even form."""
+    if isinstance(window, tuple):
+        window = window[0]  # parameterized forms collapse to the base name
+    return _wrap(_window_vals(window, win_length, sym=not fftbins), dtype)

@@ -32,3 +32,5 @@ from .sharding import (  # noqa: F401
 from .tcp_store import TCPStore  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import Engine, Strategy  # noqa: F401
+from . import rpc  # noqa: F401
+from .fleet.utils import recompute  # noqa: F401

@@ -22,6 +22,9 @@ from paddle_tpu.distributed.sharding_api import (  # noqa: F401
 )
 from .strategy import Strategy  # noqa: F401
 from .engine import Engine  # noqa: F401
+from .converter import Converter  # noqa: F401
+from .cost_model import Cluster, CommCost, CostEstimator  # noqa: F401
 
 __all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
-           "reshard", "Strategy", "Engine"]
+           "reshard", "Strategy", "Engine", "Converter", "Cluster",
+           "CommCost", "CostEstimator"]

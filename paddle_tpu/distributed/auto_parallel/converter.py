@@ -1,0 +1,120 @@
+"""Checkpoint resharding across parallel plans (reference:
+``python/paddle/distributed/auto_parallel/converter.py`` Converter — merge
+per-rank shards under the previous distributed attributes, re-slice under
+the current ones; SURVEY.md §5 names this "the piece a TPU build must own
+well").
+
+Dist-attr schema matches the reference: ``{"process_shape": [..],
+"process_group": [ranks..], "dims_mapping": [mesh-dim per tensor-dim,
+-1 = replicated]}``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+__all__ = ["Converter"]
+
+
+def _rank_coord(rank_pos: int, process_shape: Sequence[int]) -> List[int]:
+    coord = []
+    rem = rank_pos
+    for s in reversed(process_shape):
+        coord.append(rem % s)
+        rem //= s
+    return list(reversed(coord))
+
+
+def _shard_slices(full_shape, dims_mapping, process_shape, rank_pos):
+    coord = _rank_coord(rank_pos, process_shape)
+    slices = []
+    for dim, size in enumerate(full_shape):
+        m = dims_mapping[dim] if dim < len(dims_mapping) else -1
+        if m == -1:
+            slices.append(slice(None))
+        else:
+            parts = process_shape[m]
+            if size % parts != 0:
+                raise ValueError(
+                    f"dim {dim} of size {size} not divisible by mesh dim "
+                    f"{m} ({parts} parts)")
+            step = size // parts
+            start = coord[m] * step
+            slices.append(slice(start, start + step))
+    return tuple(slices)
+
+
+class Converter:
+    """``convert()`` turns per-rank shard lists saved under ``pre_strategy``
+    into the shards required by ``cur_strategy`` (reference surface:
+    converter.py Converter.__init__/convert)."""
+
+    def __init__(self, tensors_dict: Dict[str, List[np.ndarray]],
+                 pre_strategy: Dict[str, dict],
+                 cur_strategy: Dict[str, dict]):
+        if not tensors_dict:
+            raise ValueError("tensors_dict is empty")
+        if not pre_strategy:
+            raise ValueError("pre_strategy is empty")
+        if not cur_strategy:
+            raise ValueError("cur_strategy is empty")
+        self._tensors_dict = tensors_dict
+        self._pre_strategy = pre_strategy
+        self._cur_strategy = cur_strategy
+
+    # -- merge: shards + old dist attr -> full tensor ------------------------
+    @staticmethod
+    def merge_with_dist_attr(shards: List[np.ndarray],
+                             dist_attr: dict) -> np.ndarray:
+        process_shape = dist_attr["process_shape"]
+        group = dist_attr["process_group"]
+        dims_mapping = dist_attr["dims_mapping"]
+        if len(shards) != len(group):
+            raise ValueError(
+                f"{len(shards)} shards for a process group of {len(group)}")
+        s0 = np.asarray(shards[0])
+        full_shape = list(s0.shape)
+        for dim, m in enumerate(dims_mapping):
+            if m != -1:
+                full_shape[dim] = s0.shape[dim] * process_shape[m]
+        full = np.empty(full_shape, s0.dtype)
+        for pos, shard in enumerate(shards):
+            full[_shard_slices(full_shape, dims_mapping, process_shape,
+                               pos)] = np.asarray(shard)
+        return full
+
+    # -- slice: full tensor + new dist attr -> this rank's shard -------------
+    @staticmethod
+    def slice_with_dist_attr(tensor: np.ndarray, dist_attr: dict,
+                             rank: int) -> np.ndarray:
+        process_shape = dist_attr["process_shape"]
+        group = dist_attr["process_group"]
+        dims_mapping = dist_attr["dims_mapping"]
+        if rank not in group:
+            raise ValueError(f"rank {rank} not in process group {group}")
+        pos = group.index(rank)
+        return np.ascontiguousarray(
+            tensor[_shard_slices(tensor.shape, dims_mapping, process_shape,
+                                 pos)])
+
+    def convert(self, rank: int = 0,
+                strict: bool = True) -> Dict[str, np.ndarray]:
+        """Merge every tensor under pre_strategy and slice it for ``rank``
+        under cur_strategy. With ``strict=False`` tensors missing from
+        either strategy pass through unchanged (reference
+        convert_with_prefix_match relaxation)."""
+        out = {}
+        for name, shards in self._tensors_dict.items():
+            pre = self._pre_strategy.get(name)
+            cur = self._cur_strategy.get(name)
+            if pre is None or cur is None:
+                if strict:
+                    raise ValueError(
+                        f"tensor '{name}' missing from "
+                        f"{'pre' if pre is None else 'cur'}_strategy")
+                out[name] = np.asarray(shards[0])
+                continue
+            full = self.merge_with_dist_attr(shards, pre)
+            out[name] = self.slice_with_dist_attr(full, cur, rank)
+        return out

@@ -1,0 +1,121 @@
+"""Cost model for parallel-plan comparison (reference:
+``python/paddle/distributed/auto_parallel/cost_model.py`` + ``cost/`` — the
+reference replays a 2021 GPU op-benchmark JSON
+(``python/paddle/cost_model/static_op_benchmark.json``) per op).
+
+TPU-native redesign: XLA already knows the cost of a compiled program —
+``jit(fn).lower(...).compile().cost_analysis()`` reports flops and bytes
+accessed, so compute cost comes from the compiler instead of a stale
+benchmark table. Collective cost uses the standard ring/bidirectional
+ICI model (α-β: latency + size/bandwidth — the scaling-book recipe).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+__all__ = ["Cluster", "CommCost", "CostEstimator", "estimate_step_cost"]
+
+
+@dataclass
+class Cluster:
+    """Per-chip hardware description (reference analog:
+    ``auto_parallel/cluster.py``). Defaults are public TPU v5p numbers."""
+
+    peak_flops: float = 459e12        # bf16 FLOP/s per chip
+    hbm_bandwidth: float = 2765e9     # bytes/s
+    ici_bandwidth: float = 90e9       # bytes/s per link direction
+    ici_latency: float = 1e-6         # seconds per hop
+    dcn_bandwidth: float = 25e9       # bytes/s per host
+    num_devices: int = 1
+
+
+@dataclass
+class CommCost:
+    """α-β collective cost on a ring of ``n`` devices."""
+
+    cluster: Cluster = field(default_factory=Cluster)
+
+    def all_reduce(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        c = self.cluster
+        return 2 * (n - 1) / n * nbytes / c.ici_bandwidth \
+            + 2 * (n - 1) * c.ici_latency
+
+    def all_gather(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        c = self.cluster
+        return (n - 1) / n * nbytes / c.ici_bandwidth \
+            + (n - 1) * c.ici_latency
+
+    reduce_scatter = all_gather
+
+    def all_to_all(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        c = self.cluster
+        # each device keeps 1/n locally; bisection-limited on a ring
+        return (n - 1) / n * nbytes / c.ici_bandwidth / 2 \
+            + (n - 1) * c.ici_latency
+
+    def p2p(self, nbytes: float) -> float:
+        c = self.cluster
+        return nbytes / c.ici_bandwidth + c.ici_latency
+
+
+class CostEstimator:
+    """Estimate a jittable function's step cost from XLA's own analysis
+    (the reference Engine consults its cost model the same way when
+    choosing a plan, ``auto_parallel/engine.py`` _plan)."""
+
+    def __init__(self, cluster: Optional[Cluster] = None):
+        self.cluster = cluster or Cluster()
+        self.comm = CommCost(self.cluster)
+
+    def analyze(self, fn: Callable, *example_args) -> Dict[str, float]:
+        """Compile ``fn`` and return {'flops', 'bytes_accessed',
+        'compute_seconds', 'memory_seconds', 'seconds'} — seconds is the
+        roofline max of the two."""
+        import jax
+
+        compiled = jax.jit(fn).lower(*example_args).compile()
+        analyses = compiled.cost_analysis()
+        ca = analyses[0] if isinstance(analyses, (list, tuple)) else analyses
+        flops = float(ca.get("flops", 0.0))
+        nbytes = float(ca.get("bytes accessed", 0.0))
+        t_compute = flops / self.cluster.peak_flops
+        t_memory = nbytes / self.cluster.hbm_bandwidth
+        return {
+            "flops": flops,
+            "bytes_accessed": nbytes,
+            "compute_seconds": t_compute,
+            "memory_seconds": t_memory,
+            "seconds": max(t_compute, t_memory),
+        }
+
+    def compare(self, candidates: Dict[str, tuple]) -> str:
+        """candidates: name -> (fn, args). Returns the cheapest name."""
+        best, best_t = None, float("inf")
+        for name, (fn, args) in candidates.items():
+            t = self.analyze(fn, *args)["seconds"]
+            if t < best_t:
+                best, best_t = name, t
+        return best
+
+
+def estimate_step_cost(flops_per_token: float, tokens_per_step: int,
+                       dp: int = 1, param_bytes: float = 0.0,
+                       cluster: Optional[Cluster] = None) -> Dict[str, float]:
+    """Analytic train-step estimate: 3x forward flops (fwd + 2x bwd) on the
+    roofline plus a DP gradient all-reduce — the formula the bench harness
+    and the planner share."""
+    c = cluster or Cluster()
+    comm = CommCost(c)
+    t_compute = 3 * flops_per_token * tokens_per_step / c.peak_flops
+    t_comm = comm.all_reduce(param_bytes, dp)
+    return {"compute_seconds": t_compute, "comm_seconds": t_comm,
+            "seconds": max(t_compute, t_comm),
+            "tokens_per_second": tokens_per_step
+            / max(t_compute, t_comm, 1e-12)}

@@ -15,6 +15,7 @@ activations, in the GradNode.
 """
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -52,18 +53,42 @@ def _collect_state(function, layer):
         for _, b in lay.named_buffers():
             add(b)
 
-    if layer is not None:
-        add_layer(layer)
-        return tensors
-    for cell in getattr(function, "__closure__", None) or ():
-        try:
-            obj = cell.cell_contents
-        except ValueError:  # empty cell
-            continue
+    visited = set()
+
+    def scan(obj, depth):
+        if depth > 3 or id(obj) in visited:
+            return
+        visited.add(id(obj))
         if isinstance(obj, Layer):
             add_layer(obj)
         elif isinstance(obj, Tensor):
             add(obj)
+        elif isinstance(obj, functools.partial):
+            scan(obj.func, depth + 1)
+            for a in obj.args:
+                scan(a, depth + 1)
+            for v in obj.keywords.values():
+                scan(v, depth + 1)
+        elif isinstance(obj, (list, tuple, set)):
+            for o in obj:
+                scan(o, depth + 1)
+        elif isinstance(obj, dict):
+            for o in obj.values():
+                scan(o, depth + 1)
+        elif callable(obj):
+            bound = getattr(obj, "__self__", None)
+            if isinstance(bound, Layer):
+                add_layer(bound)
+            for cell in getattr(obj, "__closure__", None) or ():
+                try:
+                    scan(cell.cell_contents, depth + 1)
+                except ValueError:  # empty cell
+                    pass
+
+    if layer is not None:
+        add_layer(layer)
+        return tensors
+    scan(function, 0)
     return tensors
 
 

@@ -20,7 +20,6 @@ import jax.numpy as jnp
 from paddle_tpu.core.autograd import apply_op
 from paddle_tpu import ops
 from paddle_tpu import nn
-from paddle_tpu.nn import functional as F
 
 __all__ = ["DiTConfig", "DiT"]
 

@@ -17,11 +17,11 @@ TPU-first design decisions:
 """
 from __future__ import annotations
 
-import math
-from dataclasses import dataclass, field
-from typing import Optional
+import functools
+from dataclasses import dataclass
 
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.autograd import apply_op
 from paddle_tpu import ops
@@ -67,24 +67,25 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
 
-import functools
-
-
 @functools.lru_cache(maxsize=32)
-def _rope_cache(seq_len: int, dim: int, theta: float, dtype):
-    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
-    t = jnp.arange(seq_len, dtype=jnp.float32)
-    freqs = jnp.outer(t, inv)  # [S, dim/2]
-    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+def _rope_cache(seq_len: int, dim: int, theta: float, dtype_name: str):
+    # numpy on purpose: this cache is shared across traces, so it must
+    # never hold jax tracers (a traced entry would leak into later traces
+    # as an UnexpectedTracerError); the arrays become XLA constants at use
+    inv = 1.0 / (theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    t = np.arange(seq_len, dtype=np.float64)
+    freqs = np.outer(t, inv)  # [S, dim/2]
+    to = jnp.dtype(dtype_name)
+    return (np.cos(freqs).astype(to), np.sin(freqs).astype(to))
 
 
 def apply_rotary(q, k, theta: float = 500000.0):
     """Rotate q,k ([B,S,H,D]) by position. One tape node, fused by XLA."""
     def f(qa, ka):
         s, d = qa.shape[1], qa.shape[-1]
-        cos, sin = _rope_cache(s, d, theta, qa.dtype)
-        cos = cos[None, :, None, :]
-        sin = sin[None, :, None, :]
+        cos, sin = _rope_cache(s, d, theta, str(qa.dtype))
+        cos = jnp.asarray(cos)[None, :, None, :]
+        sin = jnp.asarray(sin)[None, :, None, :]
 
         def rot(x):
             x1, x2 = x[..., 0::2], x[..., 1::2]

@@ -1,0 +1,110 @@
+"""paddle.text parity — Viterbi decoding + text datasets.
+
+Reference: ``python/paddle/text/`` (``viterbi_decode.py``, ``datasets/``).
+The decode kernel parity target is ``paddle/phi/kernels/cpu/
+viterbi_decode_kernel.cc:154`` — reimplemented as one ``lax.scan`` forward
+pass + reversed backtrace scan (TPU-friendly: static shapes, no per-step
+host sync; the reference loops on host over time steps).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.nn.layer_base import Layer
+from . import datasets  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Highest-scoring tag sequence under emission ``potentials``
+    [B, T, N] and ``transition_params`` [N, N]; per-sample ``lengths`` [B].
+
+    Returns ``(scores [B], paths [B, max(lengths)])``. With
+    ``include_bos_eos_tag``, row N-1 of the transitions is the start tag
+    and row N-2 the stop tag (kernel parity:
+    ``viterbi_decode_kernel.cc:245-280``).
+    """
+    def f(pot, trans, lens):
+        B, T, N = pot.shape
+        lens_ = lens.astype(jnp.int32)
+        start_trans = trans[N - 1]  # transition out of BOS
+        stop_trans = trans[N - 2]   # transition into EOS
+
+        alpha0 = pot[:, 0, :]
+        if include_bos_eos_tag:
+            alpha0 = alpha0 + start_trans[None, :]
+            alpha0 = alpha0 + jnp.where((lens_ == 1)[:, None],
+                                        stop_trans[None, :], 0.0)
+        left0 = lens_ - 1  # steps remaining after consuming t=0
+
+        def fwd(carry, logit):
+            alpha, left = carry
+            # alpha_trn_sum[b, i, j] = alpha[b, i] + trans[i, j]
+            s = alpha[:, :, None] + trans[None, :, :]
+            hist = jnp.argmax(s, axis=1)          # [B, N]
+            alpha_max = jnp.max(s, axis=1)
+            nxt = alpha_max + logit
+            active = (left > 0)[:, None]
+            alpha = jnp.where(active, nxt, alpha)
+            if include_bos_eos_tag:
+                alpha = alpha + jnp.where((left == 1)[:, None],
+                                          stop_trans[None, :], 0.0)
+            return (alpha, left - 1), hist
+
+        (alpha, _), historys = jax.lax.scan(
+            fwd, (alpha0, left0), jnp.swapaxes(pot, 0, 1)[1:])
+        scores = jnp.max(alpha, axis=-1)
+        last_ids = jnp.argmax(alpha, axis=-1).astype(jnp.int32)
+
+        # backtrace, newest history first (kernel parity,
+        # viterbi_decode_kernel.cc:283-313: ``left`` tracks each sample's
+        # distance below its own final position — positions past the length
+        # emit 0, the final tag lands exactly at index len-1, and samples
+        # whose frontier is not yet reached hold their last_ids)
+        def bwd(carry, hist):
+            last, left = carry
+            left = left + 1
+            upd = jnp.take_along_axis(hist, last[:, None], axis=1)[:, 0]
+            upd = jnp.where(left > 0, upd, 0)
+            upd = jnp.where(left == 0, last, upd)
+            new_last = jnp.where(left < 0, last, upd)
+            return (new_last, left), upd
+
+        left_bt = lens_ - T
+        _, rev_path = jax.lax.scan(
+            bwd, (last_ids, left_bt), historys, reverse=True)
+        tail = (last_ids * (left_bt >= 0))[:, None]  # position T-1
+        path = jnp.concatenate([jnp.swapaxes(rev_path, 0, 1), tail], axis=1)
+        return scores, path.astype(jnp.int64)
+
+    scores, path = apply_op(f, potentials, transition_params, lengths,
+                            op_name="viterbi_decode")
+    # paddle sizes the path to the batch max length (eager arrays are
+    # concrete, so the host-side slice is free)
+    try:
+        max_len = int(jnp.max(lengths.data if isinstance(lengths, Tensor)
+                              else jnp.asarray(lengths)))
+        path = Tensor(path.data[:, :max_len])
+    except Exception:
+        pass  # traced: keep the static [B, T] width
+    return scores, path
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference: text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -100,3 +100,26 @@ def test_recompute_closure_over_layer_gets_grads():
     for n, p in model.named_parameters():
         assert p.grad is not None, n
         assert float(np.abs(np.asarray(p.grad.data)).sum()) > 0, n
+
+
+def test_recompute_partial_and_container_closures():
+    """functools.partial and container-held layers must also get grads."""
+    import functools
+    model = _mlp(seed=11)
+    x = pt.to_tensor(np.random.RandomState(11).randn(2, 8)
+                     .astype(np.float32))
+
+    def run(layer, t):
+        return layer(t)
+
+    out = recompute(functools.partial(run, model), x)
+    pt.ops.sum(out).backward()
+    for n, p in model.named_parameters():
+        assert p.grad is not None, n
+
+    model2 = _mlp(seed=12)
+    layers = [model2]
+    out2 = recompute(lambda t: layers[0](t), x)
+    pt.ops.sum(out2).backward()
+    for n, p in model2.named_parameters():
+        assert p.grad is not None, n

@@ -1,0 +1,139 @@
+"""distributed.rpc, auto_parallel cost model, checkpoint Converter."""
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_parallel import (
+    Cluster, CommCost, Converter, CostEstimator,
+)
+
+
+# ------------------------------------------------------------- converter
+def test_converter_tp_to_replicated():
+    """Merge 4 column shards (TP degree 4) back to the full weight."""
+    full = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    shards = [full[:, i * 4:(i + 1) * 4] for i in range(4)]
+    pre = {"w": {"process_shape": [4], "process_group": [0, 1, 2, 3],
+                 "dims_mapping": [-1, 0]}}
+    cur = {"w": {"process_shape": [1], "process_group": [0],
+                 "dims_mapping": [-1, -1]}}
+    conv = Converter({"w": shards}, pre, cur)
+    out = conv.convert(rank=0)
+    np.testing.assert_array_equal(out["w"], full)
+
+
+def test_converter_replicated_to_2d():
+    """Re-slice a replicated tensor onto a 2x2 mesh (both dims sharded)."""
+    full = np.arange(4 * 8, dtype=np.float32).reshape(4, 8)
+    pre = {"w": {"process_shape": [1], "process_group": [0],
+                 "dims_mapping": [-1, -1]}}
+    cur = {"w": {"process_shape": [2, 2], "process_group": [0, 1, 2, 3],
+                 "dims_mapping": [0, 1]}}
+    for rank in range(4):
+        out = Converter({"w": [full]}, pre, cur).convert(rank=rank)
+        r, c = rank // 2, rank % 2
+        np.testing.assert_array_equal(
+            out["w"], full[r * 2:(r + 1) * 2, c * 4:(c + 1) * 4])
+
+
+def test_converter_tp4_to_tp2():
+    """The headline case: reshard a TP=4 checkpoint to TP=2."""
+    full = np.random.RandomState(0).randn(6, 8).astype(np.float32)
+    shards4 = [full[:, i * 2:(i + 1) * 2] for i in range(4)]
+    pre = {"w": {"process_shape": [4], "process_group": [0, 1, 2, 3],
+                 "dims_mapping": [-1, 0]}}
+    cur = {"w": {"process_shape": [2], "process_group": [0, 1],
+                 "dims_mapping": [-1, 0]}}
+    out_r0 = Converter({"w": shards4}, pre, cur).convert(rank=0)
+    out_r1 = Converter({"w": shards4}, pre, cur).convert(rank=1)
+    np.testing.assert_array_equal(out_r0["w"], full[:, :4])
+    np.testing.assert_array_equal(out_r1["w"], full[:, 4:])
+
+
+def test_converter_errors():
+    with pytest.raises(ValueError):
+        Converter({}, {"w": {}}, {"w": {}})
+    full = np.zeros((4, 4), np.float32)
+    with pytest.raises(ValueError):
+        Converter.slice_with_dist_attr(
+            full, {"process_shape": [2], "process_group": [0, 1],
+                   "dims_mapping": [0, -1]}, rank=7)
+
+
+# ------------------------------------------------------------- cost model
+def test_comm_cost_formulas():
+    c = CommCost(Cluster(ici_bandwidth=100e9, ici_latency=0.0))
+    gb = 1e9
+    # ring all-reduce moves 2(n-1)/n of the data
+    assert c.all_reduce(gb, 4) == pytest.approx(2 * 3 / 4 * gb / 100e9)
+    assert c.all_gather(gb, 4) == pytest.approx(3 / 4 * gb / 100e9)
+    assert c.all_reduce(gb, 1) == 0.0
+
+
+def test_cost_estimator_flops_from_xla():
+    import jax.numpy as jnp
+    est = CostEstimator(Cluster(peak_flops=1e12, hbm_bandwidth=1e12))
+    n = 256
+    a = np.zeros((n, n), np.float32)
+
+    def f(x):
+        return x @ x
+
+    r = est.analyze(f, a)
+    # XLA reports ~2*n^3 flops for a matmul
+    assert r["flops"] == pytest.approx(2 * n ** 3, rel=0.2)
+    assert r["seconds"] > 0
+
+
+def test_estimate_step_cost():
+    from paddle_tpu.distributed.auto_parallel.cost_model import (
+        estimate_step_cost)
+    r = estimate_step_cost(flops_per_token=1e9, tokens_per_step=1e6,
+                           dp=8, param_bytes=16e9)
+    assert r["seconds"] >= r["compute_seconds"]
+    assert r["tokens_per_second"] > 0
+
+
+# ------------------------------------------------------------------ rpc
+def _square(x):
+    return x * x
+
+
+def _fail():
+    raise RuntimeError("remote boom")
+
+
+def _rpc_worker(rank, world, port, q):
+    import paddle_tpu.distributed.rpc as rpc
+    rpc.init_rpc(f"worker{rank}", rank=rank, world_size=world,
+                 master_endpoint=f"127.0.0.1:{port}")
+    if rank == 0:
+        out = rpc.rpc_sync("worker1", _square, args=(7,))
+        fut = rpc.rpc_async("worker1", _square, args=(9,))
+        got_err = False
+        try:
+            rpc.rpc_sync("worker1", _fail)
+        except RuntimeError:
+            got_err = True
+        q.put((out, fut.wait(), got_err))
+    rpc.shutdown()
+
+
+def test_rpc_two_workers():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rpc_worker, args=(r, 2, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    out, fut_out, got_err = q.get(timeout=240)
+    for p in procs:
+        p.join(timeout=60)
+    assert out == 49 and fut_out == 81 and got_err

@@ -1,0 +1,185 @@
+"""paddle.text (viterbi_decode) and paddle.audio (features/functional/
+backends) — numpy/scipy oracles, kernel-parity checks."""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import audio, text
+
+
+# ---------------------------------------------------------------- viterbi
+def _brute_force_viterbi(emission, trans, length, bos_eos):
+    """Exhaustive search oracle over all tag paths of one sample."""
+    n = emission.shape[1]
+    best_score, best_path = -np.inf, None
+    import itertools
+    for path in itertools.product(range(n), repeat=length):
+        s = emission[0, path[0]]
+        if bos_eos:
+            s += trans[n - 1, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emission[t, path[t]]
+        if bos_eos:
+            # kernel convention (viterbi_decode_kernel.cc:273-280): the
+            # stop contribution is ROW n-2 of the transitions, added to
+            # alpha over the current tag
+            s += trans[n - 2, path[length - 1]]
+        if s > best_score:
+            best_score, best_path = s, path
+    return best_score, list(best_path)
+
+
+@pytest.mark.parametrize("bos_eos", [False, True])
+def test_viterbi_matches_brute_force(bos_eos):
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    emission = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lengths = np.array([5, 3, 1], np.int64)
+    scores, paths = text.viterbi_decode(
+        pt.to_tensor(emission), pt.to_tensor(trans), pt.to_tensor(lengths),
+        include_bos_eos_tag=bos_eos)
+    scores = np.asarray(scores.data)
+    paths = np.asarray(paths.data)
+    assert paths.shape == (B, 5)  # batch max length
+    for b in range(B):
+        L = int(lengths[b])
+        want_s, want_p = _brute_force_viterbi(emission[b], trans, L, bos_eos)
+        np.testing.assert_allclose(scores[b], want_s, rtol=1e-5,
+                                   err_msg=f"sample {b}")
+        assert list(paths[b][:L]) == want_p, (b, paths[b], want_p)
+        assert np.all(paths[b][L:] == 0)
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(1)
+    trans = rng.randn(3, 3).astype(np.float32)
+    dec = text.ViterbiDecoder(pt.to_tensor(trans), include_bos_eos_tag=False)
+    em = pt.to_tensor(rng.randn(2, 4, 3).astype(np.float32))
+    lens = pt.to_tensor(np.array([4, 4], np.int64))
+    scores, paths = dec(em, lens)
+    assert list(paths.shape) == [2, 4]
+
+
+# ---------------------------------------------------------------- audio fn
+def test_mel_scale_roundtrip():
+    for htk in (False, True):
+        for f in (60.0, 440.0, 4000.0):
+            m = audio.functional.hz_to_mel(f, htk)
+            back = audio.functional.mel_to_hz(m, htk)
+            assert abs(back - f) < 1e-6 * max(f, 1), (htk, f, back)
+
+
+def test_fbank_matrix_vs_librosa_math():
+    fb = audio.functional.compute_fbank_matrix(sr=16000, n_fft=512,
+                                               n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert np.all(fb >= 0)
+    # every interior filter must have some support
+    assert (fb.sum(axis=1) > 0).sum() >= 38
+
+
+def test_windows_match_scipy():
+    from scipy.signal import get_window as sp_get_window
+    for name in ("hann", "hamming", "blackman", "cosine"):
+        for fftbins in (True, False):
+            got = audio.functional.get_window(name, 32, fftbins).numpy()
+            want = sp_get_window(name, 32, fftbins)
+            np.testing.assert_allclose(got, want, atol=1e-6,
+                                       err_msg=f"{name} fftbins={fftbins}")
+
+
+def test_create_dct_orthonormal():
+    d = audio.functional.create_dct(13, 40).numpy()
+    assert d.shape == (40, 13)
+    np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-6)
+
+
+def test_power_to_db_oracle():
+    s = pt.to_tensor(np.array([1.0, 0.1, 1e-12], np.float32))
+    db = audio.functional.power_to_db(s, top_db=80.0).numpy()
+    np.testing.assert_allclose(db[0], 0.0, atol=1e-5)
+    np.testing.assert_allclose(db[1], -10.0, atol=1e-4)
+    assert db[2] == pytest.approx(-80.0, abs=1e-4)  # floored by top_db
+
+
+# ------------------------------------------------------------ audio layers
+def test_spectrogram_parseval_and_peak():
+    """A pure sine's spectrogram must peak at its own frequency bin."""
+    sr, n_fft = 8000, 256
+    t = np.arange(sr, dtype=np.float32) / sr
+    freq = 1000.0
+    wav = np.sin(2 * math.pi * freq * t)[None, :2048]
+    spec = audio.Spectrogram(n_fft=n_fft, hop_length=128)(
+        pt.to_tensor(wav))
+    s = np.asarray(spec.data)[0]      # [freq, frames]
+    peak_bin = int(s.mean(axis=1).argmax())
+    want_bin = int(round(freq * n_fft / sr))
+    assert abs(peak_bin - want_bin) <= 1
+
+
+def test_spectrogram_matches_scipy_stft():
+    from scipy.signal import stft as sp_stft
+    rng = np.random.RandomState(2)
+    wav = rng.randn(1024).astype(np.float32)
+    n_fft, hop = 128, 64
+    spec = audio.Spectrogram(n_fft=n_fft, hop_length=hop, power=1.0,
+                             center=True, pad_mode="reflect")(
+        pt.to_tensor(wav[None]))
+    got = np.asarray(spec.data)[0]
+    _, _, Z = sp_stft(wav, nperseg=n_fft, noverlap=n_fft - hop,
+                      window="hann", boundary="even", padded=False)
+    want = np.abs(Z) * (n_fft / 2)  # scipy normalizes by window.sum()
+    k = min(got.shape[1], want.shape[1])
+    np.testing.assert_allclose(got[:, 1:k - 1], want[:, 1:k - 1],
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_mfcc_pipeline_shapes_and_grad():
+    mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)
+    wav = pt.to_tensor(np.random.RandomState(3).randn(2, 2048)
+                       .astype(np.float32))
+    wav.stop_gradient = False
+    out = mfcc(wav)
+    assert out.shape[0] == 2 and out.shape[1] == 13
+    pt.ops.sum(out).backward()   # differentiable back to the waveform
+    assert wav.grad is not None
+    assert np.all(np.isfinite(np.asarray(wav.grad.data)))
+
+
+# -------------------------------------------------------------- backends
+def test_wav_roundtrip(tmp_path):
+    sr = 8000
+    t = np.arange(1600, dtype=np.float32) / sr
+    wav = 0.5 * np.sin(2 * math.pi * 440 * t)[None, :]  # [1, T]
+    path = os.path.join(tmp_path, "tone.wav")
+    audio.backends.save(path, wav, sr)
+    meta = audio.backends.info(path)
+    assert meta.sample_rate == sr and meta.num_channels == 1
+    loaded, sr2 = audio.backends.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(loaded.data)[0], wav[0],
+                               atol=1e-3)
+
+
+# ------------------------------------------------------------ text datasets
+def test_uci_housing_local_file(tmp_path):
+    rng = np.random.RandomState(4)
+    rows = np.hstack([rng.rand(50, 13), rng.rand(50, 1) * 50])
+    f = os.path.join(tmp_path, "housing.data")
+    np.savetxt(f, rows)
+    ds = text.datasets.UCIHousing(data_file=f, mode="train")
+    assert len(ds) == 40  # 80% split
+    x, y = ds[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.min() >= 0 and x.max() <= 1  # normalized
+
+
+def test_datasets_require_local_file():
+    with pytest.raises(FileNotFoundError):
+        text.datasets.Imdb()
+    with pytest.raises(FileNotFoundError):
+        text.datasets.WMT14()
