@@ -1,0 +1,120 @@
+// Lock-free host event recorder for the profiler.
+//
+// TPU-native analog of the reference's HostEventRecorder
+// (paddle/fluid/platform/profiler/host_event_recorder.h: thread-local
+// event buffers drained by the HostTracer) — here a single fixed-capacity
+// ring written with one atomic fetch_add per event, so instrumented op
+// dispatch never takes a lock and never allocates on the hot path.
+// Python drains it after Profiler.stop() via ht_read.
+//
+// Concurrency contract:
+//   * writers reserve a slot with fetch_add, fill it, then publish it via
+//     a per-slot ready flag (release); readers check the flag (acquire),
+//     so a torn/in-progress slot is never observed;
+//   * ht_stop spins until in-flight writers have left before freeing, so
+//     a writer that raced past the enabled check cannot touch freed
+//     memory.
+//
+// C ABI (ctypes-consumed by paddle_tpu/profiler):
+//   ht_start(capacity)            allocate + reset the ring
+//   ht_record(name,start,end,tid) append one span (lock-free, truncates
+//                                 name to 63 chars)
+//   ht_count()                    events recorded (may exceed capacity;
+//                                 ring keeps the first `capacity`)
+//   ht_read(i, ...)               copy out event i (fails on unpublished)
+//   ht_stop()                     quiesce writers + free the ring
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+namespace {
+
+struct Event {
+  char name[64];
+  uint64_t start_ns;
+  uint64_t end_ns;
+  uint64_t tid;
+};
+
+Event* g_ring = nullptr;
+std::atomic<uint8_t>* g_ready = nullptr;
+uint64_t g_capacity = 0;
+std::atomic<uint64_t> g_count{0};
+std::atomic<bool> g_enabled{false};
+std::atomic<uint64_t> g_writers{0};
+
+}  // namespace
+
+extern "C" {
+
+int ht_start(uint64_t capacity) {
+  if (g_enabled.load(std::memory_order_acquire)) return -1;
+  delete[] g_ring;
+  delete[] g_ready;
+  g_ring = new (std::nothrow) Event[capacity];
+  g_ready = new (std::nothrow) std::atomic<uint8_t>[capacity];
+  if (!g_ring || !g_ready) {
+    delete[] g_ring;
+    delete[] g_ready;
+    g_ring = nullptr;
+    g_ready = nullptr;
+    return -1;
+  }
+  for (uint64_t i = 0; i < capacity; ++i)
+    g_ready[i].store(0, std::memory_order_relaxed);
+  g_capacity = capacity;
+  g_count.store(0, std::memory_order_relaxed);
+  g_enabled.store(true, std::memory_order_release);
+  return 0;
+}
+
+void ht_record(const char* name, uint64_t start_ns, uint64_t end_ns,
+               uint64_t tid) {
+  g_writers.fetch_add(1, std::memory_order_acquire);
+  if (g_enabled.load(std::memory_order_acquire)) {
+    uint64_t idx = g_count.fetch_add(1, std::memory_order_relaxed);
+    if (idx < g_capacity) {
+      Event& e = g_ring[idx];
+      std::strncpy(e.name, name ? name : "", sizeof(e.name) - 1);
+      e.name[sizeof(e.name) - 1] = '\0';
+      e.start_ns = start_ns;
+      e.end_ns = end_ns;
+      e.tid = tid;
+      g_ready[idx].store(1, std::memory_order_release);  // publish
+    }
+  }
+  g_writers.fetch_sub(1, std::memory_order_release);
+}
+
+uint64_t ht_count() { return g_count.load(std::memory_order_relaxed); }
+
+uint64_t ht_capacity() { return g_capacity; }
+
+int ht_read(uint64_t i, char* name_out, uint64_t name_cap,
+            uint64_t* start_ns, uint64_t* end_ns, uint64_t* tid) {
+  if (!g_ring || i >= g_capacity) return -1;
+  if (g_ready[i].load(std::memory_order_acquire) == 0) return -1;
+  const Event& e = g_ring[i];
+  std::strncpy(name_out, e.name, name_cap - 1);
+  name_out[name_cap - 1] = '\0';
+  *start_ns = e.start_ns;
+  *end_ns = e.end_ns;
+  *tid = e.tid;
+  return 0;
+}
+
+void ht_stop() {
+  g_enabled.store(false, std::memory_order_release);
+  // quiesce: wait for racing writers to drain before freeing
+  while (g_writers.load(std::memory_order_acquire) != 0) {
+  }
+  delete[] g_ring;
+  delete[] g_ready;
+  g_ring = nullptr;
+  g_ready = nullptr;
+  g_capacity = 0;
+  g_count.store(0, std::memory_order_relaxed);
+}
+
+}  // extern "C"

@@ -57,7 +57,8 @@ def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
         raise NotImplementedError("only 16-bit PCM save is supported")
     arr = np.asarray(src.data if isinstance(src, Tensor) else src)
     if arr.ndim == 1:
-        arr = arr[None, :]
+        # a bare waveform is one channel regardless of layout convention
+        arr = arr[None, :] if channels_first else arr[:, None]
     if channels_first:
         arr = arr.T  # -> [T, C]
     if np.issubdtype(arr.dtype, np.floating):

@@ -1,0 +1,200 @@
+"""Parameter server (reference: ``paddle/fluid/distributed/ps/`` ~32K LoC
+brpc client/server + table stack; Python driver
+``python/paddle/distributed/ps/the_one_ps.py:1031``).
+
+## Design doc — the TPU mapping (SURVEY.md §7 "what we do not rebuild")
+
+The reference PS exists to train CTR models whose embedding tables exceed
+single-host memory: dense compute runs on workers while sparse embedding
+rows live in a brpc KV service with optimizers executed *inside* the table
+(accessors), SSD spill, and GeoSGD async modes. On a TPU pod the dense
+path is SPMD over the mesh, and the large-embedding problem is served
+first by sharding the table across HBM (``VocabParallelEmbedding`` — ICI
+lookup beats host RPC by orders of magnitude). The PS shape is still part
+of the capability surface for beyond-HBM tables, so this module keeps the
+reference's architecture at host level:
+
+  * ``SparseTable`` / ``DenseTable`` — in-memory KV tables with
+    in-table optimizers (SGD/Adagrad accessor analog,
+    ref ``table/memory_sparse_table.cc``); lazy row init.
+  * ``PSServer`` — hosts tables, serves pull/push via
+    ``paddle_tpu.distributed.rpc`` (the brpc replacement).
+  * ``PSClient`` — worker-side pull_sparse/push_sparse_grad/
+    pull_dense/push_dense_grad.
+  * ``fleet``-style lifecycle: ``init_server/run_server/init_worker/
+    stop_worker`` free functions.
+
+Not rebuilt (out of TPU scope, revisit on demand): SSD/rocksdb spill,
+GeoSGD async replication, HeterPS GPU hash tables, FL coordinator.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
+           "init_server", "run_server", "init_worker", "stop_worker"]
+
+
+class SparseTable:
+    """id -> embedding row, rows created on first touch (reference:
+    memory_sparse_table.cc); optimizer runs in-table on push (accessor
+    analog)."""
+
+    def __init__(self, dim: int, initializer: str = "uniform",
+                 init_scale: float = 0.01, optimizer: str = "sgd",
+                 lr: float = 0.01, seed: int = 0):
+        self.dim = dim
+        self.lr = lr
+        self.optimizer = optimizer
+        self._rows: Dict[int, np.ndarray] = {}
+        self._accum: Dict[int, np.ndarray] = {}  # adagrad state
+        self._rng = np.random.RandomState(seed)
+        self._init_scale = init_scale
+        self._initializer = initializer
+        self._lock = threading.Lock()
+
+    def _row(self, key: int) -> np.ndarray:
+        r = self._rows.get(key)
+        if r is None:
+            if self._initializer == "zeros":
+                r = np.zeros(self.dim, np.float32)
+            else:
+                r = self._rng.uniform(-self._init_scale, self._init_scale,
+                                      self.dim).astype(np.float32)
+            self._rows[key] = r
+        return r
+
+    def pull(self, keys) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(k)) for k in np.asarray(keys)])
+
+    def push(self, keys, grads) -> None:
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            for k, g in zip(np.asarray(keys), grads):
+                k = int(k)
+                row = self._row(k)
+                if self.optimizer == "adagrad":
+                    acc = self._accum.setdefault(
+                        k, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-10)
+                else:  # sgd
+                    row -= self.lr * g
+
+    def size(self) -> int:
+        return len(self._rows)
+
+
+class DenseTable:
+    """Flat dense parameter block (reference: common dense table)."""
+
+    def __init__(self, shape, lr: float = 0.01):
+        self.param = np.zeros(shape, np.float32)
+        self.lr = lr
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.param.copy()
+
+    def push(self, grad) -> None:
+        with self._lock:
+            self.param -= self.lr * np.asarray(grad, np.float32)
+
+
+class PSServer:
+    """Hosts tables; request handlers are invoked via distributed.rpc."""
+
+    _instance: Optional["PSServer"] = None
+
+    def __init__(self):
+        self.sparse: Dict[str, SparseTable] = {}
+        self.dense: Dict[str, DenseTable] = {}
+        PSServer._instance = self
+
+    def add_sparse_table(self, name: str, dim: int, **kw):
+        self.sparse[name] = SparseTable(dim, **kw)
+
+    def add_dense_table(self, name: str, shape, **kw):
+        self.dense[name] = DenseTable(shape, **kw)
+
+    # rpc entry points (module-level fns resolve the singleton so they
+    # pickle by reference)
+
+
+def _srv() -> PSServer:
+    if PSServer._instance is None:
+        raise RuntimeError("PSServer not initialized on this rank")
+    return PSServer._instance
+
+
+def _pull_sparse(table: str, keys):
+    return _srv().sparse[table].pull(keys)
+
+
+def _push_sparse(table: str, keys, grads):
+    _srv().sparse[table].push(keys, grads)
+    return True
+
+
+def _pull_dense(table: str):
+    return _srv().dense[table].pull()
+
+
+def _push_dense(table: str, grad):
+    _srv().dense[table].push(grad)
+    return True
+
+
+class PSClient:
+    """Worker-side API (reference: brpc_ps_client.cc surface)."""
+
+    def __init__(self, server_name: str):
+        self.server = server_name
+
+    def pull_sparse(self, table: str, keys) -> np.ndarray:
+        from .. import rpc
+        return rpc.rpc_sync(self.server, _pull_sparse,
+                            args=(table, np.asarray(keys)))
+
+    def push_sparse_grad(self, table: str, keys, grads) -> None:
+        from .. import rpc
+        rpc.rpc_sync(self.server, _push_sparse,
+                     args=(table, np.asarray(keys), np.asarray(grads)))
+
+    def pull_dense(self, table: str) -> np.ndarray:
+        from .. import rpc
+        return rpc.rpc_sync(self.server, _pull_dense, args=(table,))
+
+    def push_dense_grad(self, table: str, grad) -> None:
+        from .. import rpc
+        rpc.rpc_sync(self.server, _push_dense, args=(table, grad))
+
+
+# -- fleet-style lifecycle (the_one_ps.py surface) ---------------------------
+_runtime = {"server": None}
+
+
+def init_server(**_kw) -> PSServer:
+    _runtime["server"] = PSServer()
+    return _runtime["server"]
+
+
+def run_server():
+    """The rpc service thread already serves requests; kept for surface
+    parity with fleet.run_server()."""
+    if _runtime["server"] is None:
+        raise RuntimeError("call init_server() first")
+
+
+def init_worker(server_name: str = "ps0") -> PSClient:
+    return PSClient(server_name)
+
+
+def stop_worker():
+    from .. import rpc
+    rpc.shutdown()

@@ -91,6 +91,26 @@ def _handle(conn):
         conn.close()
 
 
+def _advertised_ip(master_host: str) -> str:
+    """The IP other hosts should dial: PADDLE_LOCAL_IP override, else the
+    interface that routes toward the master (UDP connect trick — no
+    packets are sent), else loopback for single-host runs."""
+    import os
+    ip = os.environ.get("PADDLE_LOCAL_IP")
+    if ip:
+        return ip
+    if master_host in ("127.0.0.1", "localhost"):
+        return "127.0.0.1"
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.connect((master_host, 1))
+        ip = probe.getsockname()[0]
+        probe.close()
+        return ip
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
 def init_rpc(name: str, rank: Optional[int] = None,
              world_size: Optional[int] = None,
              master_endpoint: Optional[str] = None):
@@ -108,9 +128,10 @@ def init_rpc(name: str, rank: Optional[int] = None,
 
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("127.0.0.1", 0))
+    srv.bind(("0.0.0.0", 0))  # reachable cross-host, not just loopback
     srv.listen(128)
     my_port = srv.getsockname()[1]
+    my_ip = _advertised_ip(host)
 
     _state.store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
                             world_size=world_size)
@@ -122,7 +143,7 @@ def init_rpc(name: str, rank: Optional[int] = None,
                                             daemon=True)
     _state.server_thread.start()
 
-    info = WorkerInfo(name, rank, "127.0.0.1", my_port)
+    info = WorkerInfo(name, rank, my_ip, my_port)
     _state.store.set(f"rpc/worker/{rank}",
                      pickle.dumps((name, rank, info.ip, my_port)))
     for r in range(world_size):

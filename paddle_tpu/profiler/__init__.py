@@ -25,6 +25,72 @@ __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "make_scheduler",
 _state = {"active": None}
 
 
+class _NativeTracer:
+    """ctypes binding to the C++ lock-free event ring
+    (``native/host_tracer.cpp`` — the reference HostEventRecorder analog,
+    ``platform/profiler/host_event_recorder.h``). Compiled on first use;
+    None when the toolchain is unavailable (pure-Python fallback)."""
+
+    _lib = None
+    _failed = False
+
+    @classmethod
+    def load(cls):
+        if cls._lib is not None or cls._failed:
+            return cls._lib
+        import ctypes
+        import subprocess
+        try:
+            here = os.path.dirname(os.path.dirname(os.path.abspath(
+                __file__)))
+            src = os.path.join(os.path.dirname(here), "native",
+                               "host_tracer.cpp")
+            build = os.path.join(os.path.dirname(src), "build")
+            os.makedirs(build, exist_ok=True)
+            so = os.path.join(build, "libhost_tracer.so")
+            if not os.path.exists(so) or \
+                    os.path.getmtime(so) < os.path.getmtime(src):
+                tmp = so + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src,
+                     "-o", tmp], check=True, capture_output=True)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+            u64 = ctypes.c_uint64
+            lib.ht_start.argtypes = [u64]
+            lib.ht_start.restype = ctypes.c_int
+            lib.ht_record.argtypes = [ctypes.c_char_p, u64, u64, u64]
+            lib.ht_count.restype = u64
+            lib.ht_capacity.restype = u64
+            lib.ht_read.argtypes = [u64, ctypes.c_char_p, u64,
+                                    ctypes.POINTER(u64), ctypes.POINTER(u64),
+                                    ctypes.POINTER(u64)]
+            lib.ht_read.restype = ctypes.c_int
+            cls._lib = lib
+        except Exception:
+            cls._failed = True
+        return cls._lib
+
+    @classmethod
+    def drain(cls, into: list):
+        """Copy every recorded event out of the ring and free it."""
+        import ctypes
+        lib = cls._lib
+        if lib is None:
+            return
+        n = min(lib.ht_count(), lib.ht_capacity())
+        buf = ctypes.create_string_buffer(64)
+        s = ctypes.c_uint64()
+        e = ctypes.c_uint64()
+        t = ctypes.c_uint64()
+        for i in range(n):
+            if lib.ht_read(i, buf, 64, ctypes.byref(s), ctypes.byref(e),
+                           ctypes.byref(t)) == 0:
+                into.append(_Event(buf.value.decode(errors="replace"),
+                                   s.value, e.value, t.value))
+        lib.ht_stop()
+
+
 class ProfilerTarget:
     CPU = "cpu"
     GPU = "gpu"
@@ -58,9 +124,14 @@ class RecordEvent:
     def end(self):
         prof = _state["active"]
         if prof is not None and self._t0 is not None:
-            prof._events.append(_Event(
-                self.name, self._t0, time.perf_counter_ns(),
-                threading.get_ident()))
+            if prof._native_lib is not None:
+                prof._native_lib.ht_record(
+                    self.name.encode(), self._t0, time.perf_counter_ns(),
+                    threading.get_ident())
+            else:
+                prof._events.append(_Event(
+                    self.name, self._t0, time.perf_counter_ns(),
+                    threading.get_ident()))
             self._t0 = None
 
     def __enter__(self):
@@ -117,6 +188,7 @@ class Profiler:
         self._step = 0
         self._recording = False
         self._device_trace_dir: Optional[str] = None
+        self._native_lib = None
 
     # -- lifecycle ------------------------------------------------------------
     def start(self):
@@ -144,6 +216,9 @@ class Profiler:
 
     def _start_recording(self):
         self._recording = True
+        lib = _NativeTracer.load()
+        if lib is not None and lib.ht_start(1 << 20) == 0:
+            self._native_lib = lib
         _state["active"] = self
         if ProfilerTarget.TPU in self._targets or \
                 ProfilerTarget.GPU in self._targets:
@@ -161,6 +236,9 @@ class Profiler:
         self._recording = False
         if _state["active"] is self:
             _state["active"] = None
+        if self._native_lib is not None:
+            _NativeTracer.drain(self._events)
+            self._native_lib = None
         if self._device_trace_dir is not None:
             try:
                 import jax

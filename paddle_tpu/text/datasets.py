@@ -71,44 +71,35 @@ class Imdb(Dataset):
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
                  cutoff: int = 150):
         data_file = _require(data_file, "Imdb")
-        pat = re.compile(rf"aclImdb/{mode}/pos/.*\.txt$")
-        neg_pat = re.compile(rf"aclImdb/{mode}/neg/.*\.txt$")
-        self.word_idx = self._build_dict(data_file, mode, cutoff)
-        self.docs: List[np.ndarray] = []
-        self.labels: List[int] = []
-        self._load(data_file, pat, 0)
-        self._load(data_file, neg_pat, 1)
+        # single pass over the tar: cache (tokens, label) per review, then
+        # build the dict from the cached token lists (the 80k-file archive
+        # is expensive to decompress; never scan it twice)
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        samples: List[tuple] = []
+        freq: dict = {}
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                toks = self._tokenize(
+                    tf.extractfile(member).read().decode())
+                samples.append((toks, 0 if m.group(1) == "pos" else 1))
+                for w in toks:
+                    freq[w] = freq.get(w, 0) + 1
+        words = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c >= cutoff] if cutoff > 1 else sorted(freq)
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(w, unk) for w in toks],
+                              np.int64) for toks, _ in samples]
+        self.labels = [label for _, label in samples]
 
     @staticmethod
     def _tokenize(text: str) -> List[str]:
         return re.sub(r"[^a-zA-Z0-9\s]", "", text.lower()).split()
-
-    def _build_dict(self, data_file, mode, cutoff):
-        freq = {}
-        with tarfile.open(data_file) as tf:
-            pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
-            for member in tf.getmembers():
-                if pat.match(member.name):
-                    for w in self._tokenize(
-                            tf.extractfile(member).read().decode()):
-                        freq[w] = freq.get(w, 0) + 1
-        words = [w for w, c in sorted(freq.items(),
-                                      key=lambda kv: (-kv[1], kv[0]))
-                 if c >= cutoff] if cutoff > 1 else sorted(freq)
-        idx = {w: i for i, w in enumerate(words)}
-        idx["<unk>"] = len(idx)
-        return idx
-
-    def _load(self, data_file, pat, label):
-        unk = self.word_idx["<unk>"]
-        with tarfile.open(data_file) as tf:
-            for member in tf.getmembers():
-                if pat.match(member.name):
-                    toks = self._tokenize(
-                        tf.extractfile(member).read().decode())
-                    self.docs.append(np.array(
-                        [self.word_idx.get(w, unk) for w in toks], np.int64))
-                    self.labels.append(label)
 
     def __getitem__(self, idx):
         return self.docs[idx], np.int64(self.labels[idx])
@@ -208,19 +199,36 @@ class Conll05st(_LocalArchiveDataset):
 
 
 class Movielens(_LocalArchiveDataset):
-    """ml-1m ratings (reference: datasets/movielens.py): yields
-    (user_id, gender, age, job, movie_id, title_ids, categories, rating)."""
+    """ml-1m ratings joined with user/movie metadata (reference:
+    datasets/movielens.py): yields
+    (user_id, gender, age, job, movie_id, title, categories, rating)."""
 
     _NAME = "Movielens"
 
     def _parse(self):
+        def read(tf, base, name):
+            return tf.extractfile(f"{base}/{name}").read().decode(
+                errors="ignore").strip().split("\n")
+
         with tarfile.open(self._file) as tf:
             base = tf.getnames()[0].split("/")[0]
-            ratings = tf.extractfile(
-                f"{base}/ratings.dat").read().decode(errors="ignore")
-        for line in ratings.strip().split("\n"):
-            uid, mid, rating, _ = line.split("::")
-            self.data.append((np.int64(uid), np.int64(mid),
+            ratings = read(tf, base, "ratings.dat")
+            users_raw = read(tf, base, "users.dat")
+            movies_raw = read(tf, base, "movies.dat")
+        users = {}
+        for line in users_raw:
+            uid, gender, age, job, _zip = line.split("::")
+            users[uid] = (gender, np.int64(age), np.int64(job))
+        movies = {}
+        for line in movies_raw:
+            mid, title, genres = line.split("::")
+            movies[mid] = (title, genres.split("|"))
+        for line in ratings:
+            uid, mid, rating, _ts = line.split("::")
+            gender, age, job = users[uid]
+            title, cats = movies[mid]
+            self.data.append((np.int64(uid), gender, age, job,
+                              np.int64(mid), title, cats,
                               np.float32(rating)))
 
 
@@ -230,13 +238,9 @@ class _WMT(_LocalArchiveDataset):
     def _parse(self):
         opener = gzip.open if self._file.endswith(".gz") else open
         if tarfile.is_tarfile(self._file):
-            with tarfile.open(self._file) as tf:
-                for n in tf.getnames():
-                    if n.endswith((".src", ".trg", ".en", ".de", ".fr")):
-                        continue
-                raise ValueError(
-                    f"{self._NAME}: pass the extracted parallel text file, "
-                    "not the archive")
+            raise ValueError(
+                f"{self._NAME}: pass the extracted parallel text file "
+                "(tab- or '|||'-separated), not the archive")
         with opener(self._file, "rt", errors="ignore") as f:
             for line in f:
                 line = line.strip()

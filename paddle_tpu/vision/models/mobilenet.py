@@ -1,0 +1,136 @@
+"""MobileNetV1/V2 (reference: ``python/paddle/vision/models/
+mobilenetv1.py`` / ``mobilenetv2.py``)."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNReLU(nn.Sequential):
+    def __init__(self, cin, cout, kernel=3, stride=1, groups=1,
+                 activation=True):
+        pad = (kernel - 1) // 2
+        layers = [nn.Conv2D(cin, cout, kernel, stride=stride, padding=pad,
+                            groups=groups, bias_attr=False),
+                  nn.BatchNorm2D(cout)]
+        if activation:
+            layers.append(nn.ReLU6())
+        super().__init__(*layers)
+
+
+class DepthwiseSeparable(nn.Layer):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.dw = ConvBNReLU(cin, cin, 3, stride=stride, groups=cin)
+        self.pw = ConvBNReLU(cin, cout, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    """Reference: mobilenetv1.py MobileNetV1."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: max(int(c * scale), 8)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        blocks = [ConvBNReLU(3, s(32), stride=2)]
+        for cin, cout, stride in cfg:
+            blocks.append(DepthwiseSeparable(s(cin), s(cout), stride))
+        self.features = nn.Sequential(*blocks)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(s(1024), num_classes) if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.fc is not None:
+            x = self.fc(nn.Flatten(1)(x))
+        return x
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, cin, cout, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(cin * expand_ratio))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(cin, hidden, 1))
+        layers += [ConvBNReLU(hidden, hidden, 3, stride=stride,
+                              groups=hidden),
+                   ConvBNReLU(hidden, cout, 1, activation=False)]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        if self.use_res:
+            import paddle_tpu.ops as ops
+            return ops.add(x, out)
+        return out
+
+
+class MobileNetV2(nn.Layer):
+    """Reference: mobilenetv2.py MobileNetV2."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        cin = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        blocks = [ConvBNReLU(3, cin, stride=2)]
+        for t, c, n, s in cfg:
+            cout = _make_divisible(c * scale)
+            for i in range(n):
+                blocks.append(InvertedResidual(cin, cout,
+                                               s if i == 0 else 1, t))
+                cin = cout
+        blocks.append(ConvBNReLU(cin, last, 1))
+        self.features = nn.Sequential(*blocks)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.2), nn.Linear(last, num_classes)) \
+            if num_classes > 0 else None
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.classifier is not None:
+            x = self.classifier(nn.Flatten(1)(x))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network download; load a local "
+            "state_dict with set_state_dict")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights require network download; load a local "
+            "state_dict with set_state_dict")
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,139 @@
+"""PS tables + client/server over rpc, vision model zoo additions,
+native host tracer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# ------------------------------------------------------------------- PS
+def test_sparse_table_lazy_init_and_sgd():
+    from paddle_tpu.distributed.ps import SparseTable
+    t = SparseTable(dim=4, lr=0.1, seed=0)
+    rows = t.pull([5, 9, 5])
+    assert rows.shape == (3, 4)
+    np.testing.assert_array_equal(rows[0], rows[2])  # same id, same row
+    before = rows[0].copy()
+    g = np.ones((3, 4), np.float32)
+    t.push([5, 9, 5], g)
+    after = t.pull([5])[0]
+    # id 5 appears twice in the push: two SGD steps of lr*1
+    np.testing.assert_allclose(after, before - 0.2, rtol=1e-6)
+    assert t.size() == 2
+
+
+def test_sparse_table_adagrad():
+    from paddle_tpu.distributed.ps import SparseTable
+    t = SparseTable(dim=2, lr=1.0, optimizer="adagrad",
+                    initializer="zeros")
+    t.push([1], np.array([[3.0, 4.0]], np.float32))
+    row = t.pull([1])[0]
+    # adagrad step: -lr * g / sqrt(g^2) = -sign(g)
+    np.testing.assert_allclose(row, [-1.0, -1.0], rtol=1e-4)
+
+
+def test_dense_table():
+    from paddle_tpu.distributed.ps import DenseTable
+    t = DenseTable((2, 3), lr=0.5)
+    t.push(np.ones((2, 3), np.float32))
+    np.testing.assert_allclose(t.pull(), -0.5 * np.ones((2, 3)))
+
+
+def test_ps_client_server_over_rpc():
+    """Single-process loopback: this rank is both server and worker
+    (rpc serves from a daemon thread)."""
+    import socket
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed import ps
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    rpc.init_rpc("ps0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        server = ps.init_server()
+        server.add_sparse_table("emb", dim=8, lr=0.1, seed=1)
+        server.add_dense_table("w", (4,), lr=0.1)
+        ps.run_server()
+        client = ps.init_worker("ps0")
+        rows = client.pull_sparse("emb", [3, 7])
+        assert rows.shape == (2, 8)
+        client.push_sparse_grad("emb", [3], np.ones((1, 8), np.float32))
+        rows2 = client.pull_sparse("emb", [3])
+        np.testing.assert_allclose(rows2[0], rows[0] - 0.1, rtol=1e-5)
+        client.push_dense_grad("w", np.ones(4, np.float32))
+        np.testing.assert_allclose(client.pull_dense("w"), -0.1 *
+                                   np.ones(4), rtol=1e-6)
+    finally:
+        rpc.shutdown()
+
+
+# ---------------------------------------------------------------- vision
+def test_vgg16_forward():
+    from paddle_tpu.vision.models import vgg16
+    m = vgg16(num_classes=10)
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(0).randn(1, 3, 64, 64)
+                     .astype(np.float32))
+    out = m(x)
+    assert list(out.shape) == [1, 10]
+
+
+def test_mobilenet_v2_forward_backward():
+    from paddle_tpu.vision.models import mobilenet_v2
+    m = mobilenet_v2(scale=0.25, num_classes=4)
+    x = pt.to_tensor(np.random.RandomState(1).randn(2, 3, 32, 32)
+                     .astype(np.float32))
+    out = m(x)
+    assert list(out.shape) == [2, 4]
+    pt.ops.sum(out).backward()
+    grads = [p.grad for _, p in m.named_parameters() if p.grad is not None]
+    assert len(grads) > 20
+
+
+def test_mobilenet_v1_forward():
+    from paddle_tpu.vision.models import mobilenet_v1
+    m = mobilenet_v1(scale=0.25, num_classes=3)
+    m.eval()
+    x = pt.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    assert list(m(x).shape) == [1, 3]
+
+
+def test_pretrained_raises():
+    from paddle_tpu.vision.models import vgg11, mobilenet_v2
+    with pytest.raises(NotImplementedError):
+        vgg11(pretrained=True)
+    with pytest.raises(NotImplementedError):
+        mobilenet_v2(pretrained=True)
+
+
+# ---------------------------------------------------------- native tracer
+def test_native_host_tracer_drains_events():
+    from paddle_tpu.profiler import Profiler, _NativeTracer
+    p = Profiler().start()
+    x = pt.to_tensor(np.ones((4, 4), np.float32))
+    for _ in range(3):
+        pt.ops.sum(pt.ops.multiply(x, x))
+    p.stop()
+    names = [e.name for e in p.events]
+    assert "multiply" in names and "sum" in names
+    assert len(names) >= 6
+    # the native ring must actually have been the recorder (compiled ok)
+    assert _NativeTracer._lib is not None
+    # spans carry sane timestamps
+    for e in p.events:
+        assert e.end >= e.start > 0
+
+
+def test_native_tracer_capacity_drop():
+    from paddle_tpu.profiler import _NativeTracer
+    lib = _NativeTracer.load()
+    assert lib is not None
+    assert lib.ht_start(4) == 0
+    for i in range(10):
+        lib.ht_record(f"ev{i}".encode(), i, i + 1, 0)
+    assert lib.ht_count() == 10  # counts all
+    out = []
+    _NativeTracer.drain(out)
+    assert len(out) == 4  # ring kept the first `capacity`

@@ -183,3 +183,33 @@ def test_datasets_require_local_file():
         text.datasets.Imdb()
     with pytest.raises(FileNotFoundError):
         text.datasets.WMT14()
+
+
+def test_imdb_single_pass_local_tar(tmp_path):
+    """Tiny synthetic aclImdb tar: dict built + docs loaded in one scan."""
+    import io
+    import tarfile
+    path = os.path.join(tmp_path, "aclImdb.tar.gz")
+    reviews = {"aclImdb/train/pos/0_9.txt": b"great great movie",
+               "aclImdb/train/pos/1_8.txt": b"great fun",
+               "aclImdb/train/neg/0_2.txt": b"terrible movie"}
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in reviews.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    ds = text.datasets.Imdb(data_file=path, mode="train", cutoff=1)
+    assert len(ds) == 3
+    labels = sorted(int(l) for _, l in [ds[i] for i in range(3)])
+    assert labels == [0, 0, 1]
+    assert "great" in ds.word_idx and "terrible" in ds.word_idx
+
+
+def test_wav_save_1d_channels_last(tmp_path):
+    """1-D waveform with channels_first=False must still be one channel."""
+    sr = 8000
+    wav = np.zeros(1600, np.float32)
+    path = os.path.join(tmp_path, "flat.wav")
+    audio.backends.save(path, wav, sr, channels_first=False)
+    meta = audio.backends.info(path)
+    assert meta.num_channels == 1 and meta.num_frames == 1600
