@@ -71,8 +71,11 @@ int ht_start(uint64_t capacity) {
 
 void ht_record(const char* name, uint64_t start_ns, uint64_t end_ns,
                uint64_t tid) {
-  g_writers.fetch_add(1, std::memory_order_acquire);
-  if (g_enabled.load(std::memory_order_acquire)) {
+  g_writers.fetch_add(1, std::memory_order_seq_cst);
+  // seq_cst pairing with ht_stop's (enabled store, writers load): either
+  // this thread sees enabled==false and skips, or ht_stop's writers load
+  // sees our increment and waits — store-load reordering is excluded
+  if (g_enabled.load(std::memory_order_seq_cst)) {
     uint64_t idx = g_count.fetch_add(1, std::memory_order_relaxed);
     if (idx < g_capacity) {
       Event& e = g_ring[idx];
@@ -105,9 +108,10 @@ int ht_read(uint64_t i, char* name_out, uint64_t name_cap,
 }
 
 void ht_stop() {
-  g_enabled.store(false, std::memory_order_release);
-  // quiesce: wait for racing writers to drain before freeing
-  while (g_writers.load(std::memory_order_acquire) != 0) {
+  g_enabled.store(false, std::memory_order_seq_cst);
+  // quiesce: wait for racing writers to drain before freeing (seq_cst —
+  // see the pairing note in ht_record)
+  while (g_writers.load(std::memory_order_seq_cst) != 0) {
   }
   delete[] g_ring;
   delete[] g_ready;

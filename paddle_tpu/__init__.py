@@ -52,48 +52,46 @@ from paddle_tpu import text  # noqa: F401
 from paddle_tpu import audio  # noqa: F401
 from paddle_tpu import models  # noqa: F401
 from paddle_tpu.param_attr import ParamAttr  # noqa: F401
+from paddle_tpu import device  # noqa: F401
+from paddle_tpu.device import (  # noqa: F401
+    device_count, get_device, set_device, is_compiled_with_cuda,
+    is_compiled_with_xpu,
+)
+from paddle_tpu import fft  # noqa: F401
+from paddle_tpu import distribution  # noqa: F401
+from paddle_tpu import geometric  # noqa: F401
+from paddle_tpu import callbacks  # noqa: F401
+from paddle_tpu import hub  # noqa: F401
+from paddle_tpu import onnx  # noqa: F401
+from paddle_tpu import reader  # noqa: F401
+from paddle_tpu import sysconfig  # noqa: F401
+from paddle_tpu import version  # noqa: F401
+from paddle_tpu.batch import batch  # noqa: F401
+from paddle_tpu import linalg  # noqa: F401
+from paddle_tpu import signal  # noqa: F401
 
 bool = bool_  # paddle.bool
-
-
-def is_compiled_with_cuda() -> bool:
-    return False
-
-
-def is_compiled_with_xpu() -> bool:
-    return False
 
 
 def is_compiled_with_tpu() -> bool:
     return True
 
 
-def device_count() -> int:
-    import jax
-    return jax.device_count()
-
-
-def get_device() -> str:
-    import jax
-    d = jax.devices()[0]
-    return f"{d.platform}:{d.id}"
-
-
-def set_device(device: str) -> str:
-    # single-logical-device eager; placement is mesh/sharding driven on TPU
-    return device
+_mode = {"dynamic": True}
 
 
 def enable_static():
-    raise NotImplementedError(
-        "global static mode is replaced by trace-based capture: decorate "
-        "with paddle_tpu.jit.to_static, export with paddle_tpu.jit.save "
-        "(paddle_tpu.static keeps InputSpec)")
+    """Enter the static-graph workflow (reference paddle.enable_static).
+
+    Graph construction still executes ops once on placeholder values —
+    that run records the tape, and ``static.Executor.run`` replays it as
+    one jit-compiled XLA program (see paddle_tpu/static/graph.py)."""
+    _mode["dynamic"] = False
 
 
 def disable_static():
-    pass
+    _mode["dynamic"] = True
 
 
 def in_dynamic_mode() -> bool:
-    return True
+    return _mode["dynamic"]

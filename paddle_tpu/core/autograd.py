@@ -426,6 +426,57 @@ def _topo_nodes(outputs):
     return order
 
 
+def make_replay_fn(outputs, leaves):
+    """Rebuild the recorded computation reaching ``outputs`` as one pure
+    jax function of ``leaves``' values (the static-graph executor's seam;
+    the reference's analog is running a captured Program through
+    InterpreterCore, SURVEY.md §2.3).
+
+    Returns ``fn(*leaf_arrays) -> tuple(output_arrays)``. Tensors not in
+    ``leaves`` take their recorded values; requires the tape's replay
+    metadata (i.e. no backward(retain_graph=False) ran over this graph).
+    """
+    nodes = _topo_nodes(outputs)
+    if any(n.fwd is None for n in nodes):
+        raise RuntimeError(
+            "replay requires the recorded forward functions; part of this "
+            "graph was freed (backward without retain_graph?)")
+    # an output that is itself a leaf argument resolves to the replay
+    # ARGUMENT (grad(y, y) is the identity), not its recomputed value
+    leaf_ids = {id(t) for t in leaves}
+    out_keys = [("leaf", id(t)) if (id(t) in leaf_ids
+                                    or t._grad_node is None)
+                else (id(t._grad_node), t._out_idx) for t in outputs]
+
+    def replay(*inner):
+        env = {}
+        leaf_env = {id(t): a for t, a in zip(leaves, inner)}
+        for node in nodes:
+            vals = []
+            for t, recorded in zip(node.input_tensors, node.input_vals):
+                if id(t) in leaf_env:
+                    vals.append(leaf_env[id(t)])
+                elif t._grad_node is not None and \
+                        (id(t._grad_node), t._out_idx) in env:
+                    vals.append(env[(id(t._grad_node), t._out_idx)])
+                else:
+                    vals.append(recorded)
+            res = node.fwd(*vals)
+            res_list = list(res) if isinstance(res, (tuple, list)) \
+                else [res]
+            for slot, v in enumerate(res_list):
+                env[(id(node), slot)] = v
+        outs = []
+        for key, t in zip(out_keys, outputs):
+            if key[0] == "leaf":
+                outs.append(leaf_env.get(id(t), t.data))
+            else:
+                outs.append(env[key])
+        return tuple(outs)
+
+    return replay
+
+
 def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
     """Higher-order paddle.grad: rebuild the recorded computation as one
     pure jax function (replaying each node's stored forward), differentiate
@@ -438,7 +489,6 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
         raise RuntimeError(
             "create_graph requires the recorded forward functions; part of "
             "this graph was freed (backward without retain_graph?)")
-    input_ids = {id(t): i for i, t in enumerate(inputs)}
     # connectivity check for allow_unused semantics (outputs themselves
     # are reachable: grad(y, y) is the identity cotangent)
     reachable = {id(t) for t in outputs}
@@ -455,16 +505,11 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
     # vjp-of-vjp construction depends on d(J^T w)/dw; the reference keeps
     # this linearity because its grads are graph ops over grad_outputs)
     cot_tensors = [g for g in grad_outputs if isinstance(g, Tensor)]
-    # an output that is itself a requested input must resolve to the
-    # replay ARGUMENT (grad(y, y) is the identity), not the recomputed value
-    out_keys = [("leaf", id(t)) if (id(t) in input_ids or
-                                    t._grad_node is None)
-                else (id(t._grad_node), t._out_idx) for t in outputs]
 
     # every OTHER differentiable leaf also enters the replay as an argument
     # so the returned grads stay differentiable w.r.t. them (mixed partials
     # like d2z/dxdy where only x was requested in the first grad call)
-    extras, seen_extra = [], set(input_ids)
+    extras, seen_extra = [], {id(t) for t in inputs}
     for n in nodes:
         for t in n.input_tensors:
             if not t.stop_gradient and id(t) not in seen_extra and \
@@ -472,6 +517,7 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
                 seen_extra.add(id(t))
                 extras.append(t)
     all_args = list(inputs) + extras
+    replay = make_replay_fn(outputs, all_args)
 
     def g_fn(*arrs):
         leaf_arrs = arrs[: len(all_args)]
@@ -484,34 +530,6 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
                 cots.append(jnp.ones(t.data.shape, t.data.dtype))
             else:
                 cots.append(jnp.asarray(g))
-
-        def replay(*inner):
-            env = {}  # (id(node), slot) -> value
-            leaf_env = {id(t): a for t, a in zip(all_args, inner)}
-            for node in nodes:
-                vals = []
-                for t, recorded in zip(node.input_tensors,
-                                       node.input_vals):
-                    if id(t) in leaf_env:
-                        vals.append(leaf_env[id(t)])
-                    elif t._grad_node is not None and \
-                            (id(t._grad_node), t._out_idx) in env:
-                        vals.append(env[(id(t._grad_node), t._out_idx)])
-                    else:
-                        vals.append(recorded)
-                res = node.fwd(*vals)
-                res_list = list(res) if isinstance(res, (tuple, list)) \
-                    else [res]
-                for slot, v in enumerate(res_list):
-                    env[(id(node), slot)] = v
-            outs = []
-            for key, t in zip(out_keys, outputs):
-                if key[0] == "leaf":
-                    outs.append(leaf_env.get(id(t), t.data))
-                else:
-                    outs.append(env[key])
-            return tuple(outs)
-
         _, vjp = jax.vjp(replay, *leaf_arrs)
         return vjp(tuple(cots))[: len(inputs)]
 

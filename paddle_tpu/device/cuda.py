@@ -1,0 +1,92 @@
+"""paddle.device.cuda compatibility shims. This build targets TPU only
+(BASELINE.md hard constraint: no CUDA); queries report zero devices and
+stream/event primitives degrade to host synchronization, so portable
+scripts keep running."""
+from __future__ import annotations
+
+__all__ = ["device_count", "current_stream", "synchronize", "Stream",
+           "Event", "stream_guard", "get_device_properties",
+           "max_memory_allocated", "max_memory_reserved",
+           "memory_allocated", "memory_reserved", "empty_cache"]
+
+
+def device_count() -> int:
+    return 0
+
+
+def synchronize(device=None):
+    from . import synchronize as _sync
+    _sync(device)
+
+
+class Stream:
+    """No-op stream: XLA owns scheduling on TPU (reference streams map to
+    the compiler's async execution)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    yield stream
+
+
+def get_device_properties(device=None):
+    raise RuntimeError(
+        "paddle.device.cuda.get_device_properties: no CUDA device in this "
+        "build (TPU-only, BASELINE.md)")
+
+
+def memory_allocated(device=None) -> int:
+    return 0
+
+
+def memory_reserved(device=None) -> int:
+    return 0
+
+
+def max_memory_allocated(device=None) -> int:
+    return 0
+
+
+def max_memory_reserved(device=None) -> int:
+    return 0
+
+
+def empty_cache():
+    pass

@@ -43,6 +43,7 @@ class _State:
         self.infos: Dict[str, WorkerInfo] = {}
         self.self_name: Optional[str] = None
         self.running = False
+        self.token: bytes = b""
 
 
 _state = _State()
@@ -79,6 +80,10 @@ def _serve(srv):
 
 def _handle(conn):
     try:
+        tok = _recv_exact(conn, 16)
+        if tok != _state.token:  # reject before any pickle.loads
+            conn.close()
+            return
         fn, args, kwargs = _recv_msg(conn)
         try:
             result = ("ok", fn(*args, **kwargs))
@@ -126,15 +131,25 @@ def init_rpc(name: str, rank: Optional[int] = None,
                                          "127.0.0.1:29531")
     host, port = master_endpoint.rsplit(":", 1)
 
+    my_ip = _advertised_ip(host)
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("0.0.0.0", 0))  # reachable cross-host, not just loopback
+    # bind the advertised interface only (not 0.0.0.0): the wire protocol
+    # is pickle, so exposure is limited to the training network, and every
+    # request must present the job token (below) before deserialization
+    srv.bind((my_ip, 0))
     srv.listen(128)
     my_port = srv.getsockname()[1]
-    my_ip = _advertised_ip(host)
 
     _state.store = TCPStore(host=host, port=int(port), is_master=(rank == 0),
                             world_size=world_size)
+    # per-job shared secret: rank 0 mints it, everyone reads it from the
+    # store; requests without it are dropped before unpickling
+    if rank == 0:
+        import os as _os
+        _state.store.set("rpc/token", _os.urandom(16))
+    _state.token = _state.store.wait("rpc/token",
+                                     timeout=_DEFAULT_RPC_TIMEOUT * 10)
     _state.server = srv
     _state.running = True
     _state.pool = ThreadPoolExecutor(max_workers=8)
@@ -165,6 +180,7 @@ def _invoke(to: str, fn, args, kwargs, timeout):
     info = _state.infos[to]
     with socket.create_connection((info.ip, info.port),
                                   timeout=timeout) as conn:
+        conn.sendall(_state.token)
         _send_msg(conn, (fn, args or (), kwargs or {}))
         conn.settimeout(timeout)
         status, value = _recv_msg(conn)

@@ -1,0 +1,736 @@
+"""paddle.distribution parity (reference: ``python/paddle/distribution/``
+— Distribution base, the v2.4 family set, transforms, and the
+``register_kl`` multiple-dispatch divergence registry).
+
+TPU-native: every density/entropy is a differentiable tape node (one jnp
+body per method), sampling draws keys from the framework RNG
+(:mod:`paddle_tpu.core.generator`) so ``paddle.seed`` reproduces draws,
+and reparameterized families implement ``rsample`` so pathwise gradients
+flow (the reference only exposes rsample on a few; here every location-
+scale family has it).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import generator as G
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Laplace", "Gumbel", "LogNormal",
+    "Beta", "Dirichlet", "Categorical", "Multinomial", "Bernoulli",
+    "Independent", "TransformedDistribution", "Transform",
+    "AffineTransform", "ExpTransform", "SigmoidTransform", "ChainTransform",
+    "kl_divergence", "register_kl",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x.data
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jnp.ndarray) \
+        else x
+
+
+def _op(name, fn, *tensors):
+    return apply_op(fn, *tensors, op_name=name)
+
+
+def _shape(sample_shape, base_shape) -> Tuple[int, ...]:
+    return tuple(sample_shape) + tuple(base_shape)
+
+
+class Distribution:
+    """Reference: distribution.py:33."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from paddle_tpu import ops
+        return ops.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Reference: normal.py Normal(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(_arr(loc))
+        self.scale = scale if isinstance(scale, Tensor) \
+            else Tensor(_arr(scale))
+        super().__init__(jnp.broadcast_shapes(self.loc.data.shape,
+                                              self.scale.data.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _op("normal_var", lambda s: s * s, self.scale)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(G.next_key(),
+                                _shape(shape, self.batch_shape))
+        return _op("normal_rsample",
+                   lambda l, s: l + s * eps, self.loc, self.scale)
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            var = s * s
+            return -((v - l) ** 2) / (2 * var) - jnp.log(s) \
+                - 0.5 * math.log(2 * math.pi)
+        return _op("normal_log_prob", f, self.loc, self.scale, value)
+
+    def entropy(self):
+        return _op("normal_entropy",
+                   lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+                   + jnp.zeros(self.batch_shape),
+                   self.scale)
+
+
+class LogNormal(Normal):
+    """Reference: lognormal.py — exp of a Normal."""
+
+    @property
+    def mean(self):
+        return _op("lognormal_mean",
+                   lambda l, s: jnp.exp(l + s * s / 2), self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _op("lognormal_var",
+                   lambda l, s: (jnp.exp(s * s) - 1)
+                   * jnp.exp(2 * l + s * s), self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        base = super().rsample(shape)
+        return _op("lognormal_rsample", jnp.exp, base)
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            logv = jnp.log(v)
+            var = s * s
+            return -((logv - l) ** 2) / (2 * var) - jnp.log(s) - logv \
+                - 0.5 * math.log(2 * math.pi)
+        return _op("lognormal_log_prob", f, self.loc, self.scale, value)
+
+    def entropy(self):
+        return _op("lognormal_entropy",
+                   lambda l, s: 0.5 + 0.5 * math.log(2 * math.pi)
+                   + jnp.log(s) + l + jnp.zeros(self.batch_shape),
+                   self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    """Reference: uniform.py Uniform(low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = low if isinstance(low, Tensor) else Tensor(_arr(low))
+        self.high = high if isinstance(high, Tensor) else Tensor(_arr(high))
+        super().__init__(jnp.broadcast_shapes(self.low.data.shape,
+                                              self.high.data.shape))
+
+    @property
+    def mean(self):
+        return _op("uniform_mean", lambda l, h: (l + h) / 2,
+                   self.low, self.high)
+
+    @property
+    def variance(self):
+        return _op("uniform_var", lambda l, h: (h - l) ** 2 / 12,
+                   self.low, self.high)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(G.next_key(),
+                               _shape(shape, self.batch_shape))
+        return _op("uniform_rsample", lambda l, h: l + (h - l) * u,
+                   self.low, self.high)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(l, h, v):
+            inside = (v >= l) & (v < h)
+            return jnp.where(inside, -jnp.log(h - l), -jnp.inf)
+        return _op("uniform_log_prob", f, self.low, self.high, value)
+
+    def entropy(self):
+        return _op("uniform_entropy", lambda l, h: jnp.log(h - l),
+                   self.low, self.high)
+
+
+class Laplace(Distribution):
+    """Reference: laplace.py Laplace(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(_arr(loc))
+        self.scale = scale if isinstance(scale, Tensor) \
+            else Tensor(_arr(scale))
+        super().__init__(jnp.broadcast_shapes(self.loc.data.shape,
+                                              self.scale.data.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _op("laplace_var", lambda s: 2 * s * s, self.scale)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(G.next_key(),
+                               _shape(shape, self.batch_shape),
+                               minval=-0.5, maxval=0.5)
+        return _op("laplace_rsample",
+                   lambda l, s: l - s * jnp.sign(u)
+                   * jnp.log1p(-2 * jnp.abs(u)), self.loc, self.scale)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return _op("laplace_log_prob",
+                   lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                   self.loc, self.scale, value)
+
+    def entropy(self):
+        return _op("laplace_entropy",
+                   lambda s: 1 + jnp.log(2 * s), self.scale)
+
+
+class Gumbel(Distribution):
+    """Reference: gumbel.py Gumbel(loc, scale)."""
+
+    EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(_arr(loc))
+        self.scale = scale if isinstance(scale, Tensor) \
+            else Tensor(_arr(scale))
+        super().__init__(jnp.broadcast_shapes(self.loc.data.shape,
+                                              self.scale.data.shape))
+
+    @property
+    def mean(self):
+        return _op("gumbel_mean", lambda l, s: l + self.EULER * s,
+                   self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return _op("gumbel_var",
+                   lambda s: (math.pi ** 2 / 6) * s * s, self.scale)
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(G.next_key(),
+                              _shape(shape, self.batch_shape))
+        return _op("gumbel_rsample", lambda l, s: l + s * g,
+                   self.loc, self.scale)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _op("gumbel_log_prob", f, self.loc, self.scale, value)
+
+    def entropy(self):
+        return _op("gumbel_entropy",
+                   lambda s: jnp.log(s) + 1 + self.EULER, self.scale)
+
+
+class Beta(Distribution):
+    """Reference: beta.py Beta(alpha, beta)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = alpha if isinstance(alpha, Tensor) \
+            else Tensor(_arr(alpha))
+        self.beta = beta if isinstance(beta, Tensor) else Tensor(_arr(beta))
+        super().__init__(jnp.broadcast_shapes(self.alpha.data.shape,
+                                              self.beta.data.shape))
+
+    @property
+    def mean(self):
+        return _op("beta_mean", lambda a, b: a / (a + b),
+                   self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return _op("beta_var",
+                   lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                   self.alpha, self.beta)
+
+    def sample(self, shape=()):
+        a = np.broadcast_to(np.asarray(self.alpha.data),
+                            _shape(shape, self.batch_shape))
+        b = np.broadcast_to(np.asarray(self.beta.data),
+                            _shape(shape, self.batch_shape))
+        out = jax.random.beta(G.next_key(), a, b)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) \
+                - (jax.scipy.special.gammaln(a)
+                   + jax.scipy.special.gammaln(b)
+                   - jax.scipy.special.gammaln(a + b))
+        return _op("beta_log_prob", f, self.alpha, self.beta, value)
+
+    def entropy(self):
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return lbeta - (a - 1) * dg(a) - (b - 1) * dg(b) \
+                + (a + b - 2) * dg(a + b)
+        return _op("beta_entropy", f, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    """Reference: dirichlet.py Dirichlet(concentration)."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = concentration \
+            if isinstance(concentration, Tensor) \
+            else Tensor(_arr(concentration))
+        shape = self.concentration.data.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return _op("dirichlet_mean",
+                   lambda c: c / jnp.sum(c, -1, keepdims=True),
+                   self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+        return _op("dirichlet_var", f, self.concentration)
+
+    def sample(self, shape=()):
+        out = jax.random.dirichlet(
+            G.next_key(), np.asarray(self.concentration.data), shape=shape
+            if shape else None)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def f(c, v):
+            return jnp.sum((c - 1) * jnp.log(v), -1) \
+                + jax.scipy.special.gammaln(jnp.sum(c, -1)) \
+                - jnp.sum(jax.scipy.special.gammaln(c), -1)
+        return _op("dirichlet_log_prob", f, self.concentration, value)
+
+    def entropy(self):
+        def f(c):
+            dg = jax.scipy.special.digamma
+            k = c.shape[-1]
+            c0 = jnp.sum(c, -1)
+            lB = jnp.sum(jax.scipy.special.gammaln(c), -1) \
+                - jax.scipy.special.gammaln(c0)
+            return lB + (c0 - k) * dg(c0) - jnp.sum((c - 1) * dg(c), -1)
+        return _op("dirichlet_entropy", f, self.concentration)
+
+
+class Categorical(Distribution):
+    """Reference: categorical.py Categorical(logits) — note paddle's
+    ``logits`` are unnormalized probabilities (not log-space) when
+    positive; we follow the torch/log-space convention of the reference's
+    ``probs_to_logits`` path: pass log-probabilities or unnormalized
+    logits."""
+
+    def __init__(self, logits, name=None):
+        self.logits = logits if isinstance(logits, Tensor) \
+            else Tensor(_arr(logits))
+        shape = self.logits.data.shape
+        super().__init__(shape[:-1])
+
+    @property
+    def _log_probs(self):
+        return _op("categorical_log_probs",
+                   lambda lg: jax.nn.log_softmax(lg, -1), self.logits)
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            G.next_key(), self.logits.data,
+            shape=_shape(shape, self.batch_shape))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def f(lg, v):
+            lp = jax.nn.log_softmax(lg, -1)
+            # value may carry extra sample dims ahead of the batch dims
+            lp = jnp.broadcast_to(lp, v.shape + lp.shape[-1:])
+            return jnp.take_along_axis(
+                lp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+        return _op("categorical_log_prob", f, self.logits, value)
+
+    def probs(self, value=None):
+        p = _op("categorical_probs",
+                lambda lg: jax.nn.softmax(lg, -1), self.logits)
+        if value is None:
+            return p
+        def g(pp, v):
+            pp = jnp.broadcast_to(pp, v.shape + pp.shape[-1:])
+            return jnp.take_along_axis(
+                pp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+        return _op("categorical_probs_at", g, p, value)
+
+    def entropy(self):
+        def f(lg):
+            lp = jax.nn.log_softmax(lg, -1)
+            return -jnp.sum(jnp.exp(lp) * lp, -1)
+        return _op("categorical_entropy", f, self.logits)
+
+
+class Bernoulli(Distribution):
+    """Reference: the exponential-family Bernoulli (probs parameter)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_param = probs if isinstance(probs, Tensor) \
+            else Tensor(_arr(probs))
+        super().__init__(self.probs_param.data.shape)
+
+    @property
+    def mean(self):
+        return self.probs_param
+
+    @property
+    def variance(self):
+        return _op("bernoulli_var", lambda p: p * (1 - p), self.probs_param)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(G.next_key(),
+                               _shape(shape, self.batch_shape))
+        return Tensor((u < self.probs_param.data).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(p, v):
+            eps = 1e-7
+            p_ = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p_) + (1 - v) * jnp.log1p(-p_)
+        return _op("bernoulli_log_prob", f, self.probs_param, value)
+
+    def entropy(self):
+        def f(p):
+            eps = 1e-7
+            p_ = jnp.clip(p, eps, 1 - eps)
+            return -(p_ * jnp.log(p_) + (1 - p_) * jnp.log1p(-p_))
+        return _op("bernoulli_entropy", f, self.probs_param)
+
+
+class Multinomial(Distribution):
+    """Reference: multinomial.py Multinomial(total_count, probs)."""
+
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_param = probs if isinstance(probs, Tensor) \
+            else Tensor(_arr(probs))
+        shape = self.probs_param.data.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return _op("multinomial_mean",
+                   lambda p: self.total_count * p, self.probs_param)
+
+    @property
+    def variance(self):
+        return _op("multinomial_var",
+                   lambda p: self.total_count * p * (1 - p),
+                   self.probs_param)
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs_param.data, 1e-30))
+        draws = jax.random.categorical(
+            G.next_key(), logits,
+            shape=(self.total_count,) + _shape(shape, self.batch_shape))
+        k = self.probs_param.data.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def f(p, v):
+            return (jax.scipy.special.gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(jnp.maximum(p, 1e-30)), -1))
+        return _op("multinomial_log_prob", f, self.probs_param, value)
+
+
+class Independent(Distribution):
+    """Reference: independent.py — reinterprets batch dims as event
+    dims (log_prob sums over them)."""
+
+    def __init__(self, base: Distribution,
+                 reinterpreted_batch_rank: int = 1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        from paddle_tpu import ops
+        return ops.sum(lp, axis=list(range(lp.ndim - self.rank, lp.ndim)))
+
+    def entropy(self):
+        e = self.base.entropy()
+        from paddle_tpu import ops
+        return ops.sum(e, axis=list(range(e.ndim - self.rank, e.ndim)))
+
+
+# --------------------------------------------------------------- transforms
+class Transform:
+    """Reference: transform.py Transform base."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(_arr(loc))
+        self.scale = scale if isinstance(scale, Tensor) \
+            else Tensor(_arr(scale))
+
+    def forward(self, x):
+        return _op("affine_fwd", lambda l, s, v: l + s * v,
+                   self.loc, self.scale, x)
+
+    def inverse(self, y):
+        return _op("affine_inv", lambda l, s, v: (v - l) / s,
+                   self.loc, self.scale, y)
+
+    def forward_log_det_jacobian(self, x):
+        return _op("affine_ldj",
+                   lambda s, v: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                 v.shape),
+                   self.scale, x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _op("exp_fwd", jnp.exp, x)
+
+    def inverse(self, y):
+        return _op("exp_inv", jnp.log, y)
+
+    def forward_log_det_jacobian(self, x):
+        return _op("exp_ldj", lambda v: v, x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _op("sigmoid_fwd", jax.nn.sigmoid, x)
+
+    def inverse(self, y):
+        return _op("sigmoid_inv", lambda v: jnp.log(v) - jnp.log1p(-v), y)
+
+    def forward_log_det_jacobian(self, x):
+        return _op("sigmoid_ldj",
+                   lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v), x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        from paddle_tpu import ops
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else ops.add(total, ldj)
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Reference: transformed_distribution.py — pushforward of ``base``
+    through ``transforms`` (change of variables)."""
+
+    def __init__(self, base: Distribution, transforms):
+        self.base = base
+        self.transform = transforms if isinstance(transforms, Transform) \
+            else ChainTransform(list(transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        from paddle_tpu import ops
+        x = self.transform.inverse(value)
+        ldj = self.transform.forward_log_det_jacobian(x)
+        return ops.subtract(self.base.log_prob(x), ldj)
+
+
+# ---------------------------------------------------------------- kl registry
+_KL_REGISTRY: Dict[tuple, callable] = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Reference: kl.py:66 — decorator registering a pairwise KL rule."""
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """Reference: kl.py:34 — most-derived-match dispatch."""
+    best, best_score = None, None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            score = (len(type(p).__mro__) - len(cp.__mro__),
+                     len(type(q).__mro__) - len(cq.__mro__))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    if best is None:
+        raise NotImplementedError(
+            f"no KL rule registered for ({type(p).__name__}, "
+            f"{type(q).__name__}); use register_kl")
+    return best(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def f(lp, sp, lq, sq):
+        var_ratio = (sp / sq) ** 2
+        t1 = ((lp - lq) / sq) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return _op("kl_normal", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def f(pl, ph, ql, qh):
+        kl = jnp.log((qh - ql) / (ph - pl))
+        return jnp.where((ql <= pl) & (ph <= qh), kl, jnp.inf)
+    return _op("kl_uniform", f, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    def f(lp, lq):
+        a = jax.nn.log_softmax(lp, -1)
+        b = jax.nn.log_softmax(lq, -1)
+        return jnp.sum(jnp.exp(a) * (a - b), -1)
+    return _op("kl_categorical", f, p.logits, q.logits)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def f(pa, pb, qa, qb):
+        dg = jax.scipy.special.digamma
+        lbeta = lambda a, b: (jax.scipy.special.gammaln(a)
+                              + jax.scipy.special.gammaln(b)
+                              - jax.scipy.special.gammaln(a + b))
+        return (lbeta(qa, qb) - lbeta(pa, pb)
+                + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+    return _op("kl_beta", f, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def f(pc, qc):
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        p0 = jnp.sum(pc, -1)
+        return (gl(p0) - jnp.sum(gl(pc), -1)
+                - gl(jnp.sum(qc, -1)) + jnp.sum(gl(qc), -1)
+                + jnp.sum((pc - qc) * (dg(pc) - dg(p0)[..., None]), -1))
+    return _op("kl_dirichlet", f, p.concentration, q.concentration)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def f(lp, sp, lq, sq):
+        d = jnp.abs(lp - lq)
+        return (jnp.log(sq / sp) + sp / sq * jnp.exp(-d / sp)
+                + d / sq - 1)
+    return _op("kl_laplace", f, p.loc, p.scale, q.loc, q.scale)

@@ -1,0 +1,124 @@
+"""paddle.geometric parity (reference: ``python/paddle/geometric/`` —
+segment reductions in ``math.py`` and graph message passing in
+``message_passing/send_recv.py``).
+
+TPU-native: all reductions lower to ``jax.ops.segment_*`` (one sorted
+scatter per call — XLA's segment reduce), differentiable on the tape.
+``out_size`` must be static under jit; eagerly it defaults to
+``max(ids)+1`` like the reference.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _n_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = segment_ids.data if isinstance(segment_ids, Tensor) \
+        else jnp.asarray(segment_ids)
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def _segment(reduce: str, name: str):
+    jfn = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+           "max": jax.ops.segment_max}.get(reduce)
+
+    def f(data, segment_ids, name_arg=None):
+        n = _n_segments(segment_ids, None)
+
+        def body(d, ids):
+            ids_ = ids.astype(jnp.int32)
+            if reduce == "mean":
+                s = jax.ops.segment_sum(d, ids_, num_segments=n)
+                cnt = jax.ops.segment_sum(jnp.ones_like(ids_, d.dtype),
+                                          ids_, num_segments=n)
+                shape = (n,) + (1,) * (d.ndim - 1)
+                return s / jnp.maximum(cnt.reshape(shape), 1)
+            out = jfn(d, ids_, num_segments=n)
+            if reduce in ("min", "max"):
+                # empty segments: paddle fills 0, jax fills +-inf
+                touched = jax.ops.segment_sum(
+                    jnp.ones_like(ids_, jnp.float32), ids_, num_segments=n)
+                shape = (n,) + (1,) * (d.ndim - 1)
+                return jnp.where(touched.reshape(shape) > 0, out, 0)
+            return out
+        return apply_op(body, data, segment_ids, op_name=name)
+    f.__name__ = name
+    f.__doc__ = (f"paddle.geometric.{name} (reference: geometric/math.py; "
+                 "empty segments produce 0).")
+    return f
+
+
+segment_sum = _segment("sum", "segment_sum")
+segment_mean = _segment("mean", "segment_mean")
+segment_min = _segment("min", "segment_min")
+segment_max = _segment("max", "segment_max")
+
+
+def _reduce_to_dst(msg, dst, pool_type, out_size):
+    n = out_size
+    dst_ = dst.astype(jnp.int32)
+    if pool_type == "sum":
+        return jax.ops.segment_sum(msg, dst_, num_segments=n)
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(msg, dst_, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(dst_, msg.dtype), dst_,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (msg.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    jfn = jax.ops.segment_min if pool_type == "min" else jax.ops.segment_max
+    out = jfn(msg, dst_, num_segments=n)
+    touched = jax.ops.segment_sum(jnp.ones_like(dst_, jnp.float32), dst_,
+                                  num_segments=n)
+    shape = (n,) + (1,) * (msg.ndim - 1)
+    return jnp.where(touched.reshape(shape) > 0, out, 0)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None):
+    """Gather source rows, scatter-reduce to destinations (reference:
+    send_recv.py:35). out = reduce_{e: dst[e]=i} x[src[e]]."""
+    n = out_size if out_size is not None else x.shape[0]
+
+    def f(xa, src, dst):
+        msg = xa[src.astype(jnp.int32)]
+        return _reduce_to_dst(msg, dst, reduce_op, int(n))
+    return apply_op(f, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None):
+    """Combine source features with edge features, then scatter-reduce
+    (reference: send_recv.py:178)."""
+    n = out_size if out_size is not None else x.shape[0]
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def f(xa, ya, src, dst):
+        msg = combine(xa[src.astype(jnp.int32)], ya)
+        return _reduce_to_dst(msg, dst, reduce_op, int(n))
+    return apply_op(f, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message from both endpoints (reference:
+    send_recv.py:375): out[e] = op(x[src[e]], y[dst[e]])."""
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def f(xa, ya, src, dst):
+        return combine(xa[src.astype(jnp.int32)],
+                       ya[dst.astype(jnp.int32)])
+    return apply_op(f, x, y, src_index, dst_index, op_name="send_uv")

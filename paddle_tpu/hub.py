@@ -1,0 +1,64 @@
+"""paddle.hub parity (reference: ``python/paddle/hapi/hub.py`` — load
+models from a github/gitee repo's hubconf.py).
+
+Zero-egress build: only ``source='local'`` works (a directory containing
+``hubconf.py``); remote sources raise with a clear message.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_HUB_CONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUB_CONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUB_CONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"paddle.hub source='{source}' needs network access, which "
+            "this build does not have; clone the repo and use "
+            "source='local'")
+
+
+def list(repo_dir: str, source: str = "local",
+         force_reload: bool = False) -> List[str]:
+    """Entry points exported by the repo's hubconf
+    (reference: hub.py list)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate ``model`` from the repo's hubconf
+    (reference: hub.py load)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"hubconf has no entry point '{model}'; "
+                         f"available: {list(repo_dir)}")
+    return getattr(mod, model)(**kwargs)

@@ -1,0 +1,30 @@
+// paddle_tpu custom-op extension header — the analog of
+// paddle/extension.h for this framework's host-callback custom-op seam
+// (see python/paddle/utils/cpp_extension in the reference, and
+// paddle_tpu/utils/cpp_extension.py here for the loading side).
+//
+// A custom op exports one C function with this signature; the optional
+// gradient exports `<name>_grad` with the same signature, receiving
+// inputs + output cotangents and writing one gradient per forward input.
+#ifndef PADDLE_TPU_EXT_H_
+#define PADDLE_TPU_EXT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ins/outs: flat float32 buffers; *_shapes[i] points at in/out i's dims;
+// *_ndims[i] gives its rank. Output buffers are pre-allocated by the
+// framework from the shapes the Python registration declared.
+typedef void (*paddle_tpu_op_fn)(
+    const float** ins, const int64_t** in_shapes, const int32_t* in_ndims,
+    int32_t n_in, float** outs, const int64_t** out_shapes,
+    const int32_t* out_ndims, int32_t n_out);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // PADDLE_TPU_EXT_H_

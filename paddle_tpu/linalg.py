@@ -1,0 +1,17 @@
+"""paddle.linalg namespace (reference: ``python/paddle/linalg.py`` — a
+re-export surface over tensor/linalg ops). The implementations live in
+:mod:`paddle_tpu.ops.linalg` (jnp.linalg delegates on the tape)."""
+from paddle_tpu.ops.linalg import (  # noqa: F401
+    bincount, bmm, cdist, cholesky, cholesky_solve, corrcoef, cov, cross,
+    det, dist, dot, eig, eigh, eigvals, eigvalsh, histogram, inner, inverse,
+    lstsq, lu, matmul, matrix_power, matrix_rank, multi_dot, mv, norm,
+    outer, pinv, qr, slogdet, solve, svd, triangular_solve,
+)
+
+__all__ = [
+    "bincount", "bmm", "cdist", "cholesky", "cholesky_solve", "corrcoef",
+    "cov", "cross", "det", "dist", "dot", "eig", "eigh", "eigvals",
+    "eigvalsh", "histogram", "inner", "inverse", "lstsq", "lu", "matmul",
+    "matrix_power", "matrix_rank", "multi_dot", "mv", "norm", "outer",
+    "pinv", "qr", "slogdet", "solve", "svd", "triangular_solve",
+]

@@ -1,0 +1,27 @@
+"""paddle.onnx parity surface (reference: ``python/paddle/onnx/export.py``
+— delegates to the external paddle2onnx package).
+
+TPU build: the deployment path is XLA AOT via ``paddle_tpu.jit.save`` /
+``paddle_tpu.inference`` (SURVEY.md §2.7 maps TensorRT/ONNX engines to
+TPU export). ONNX emission would require the onnx package and an
+exporter; absent here, ``export`` raises with the supported alternative
+spelled out rather than failing deep inside.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "paddle.onnx.export: the onnx package is not available in this "
+            "build. For TPU deployment use paddle_tpu.jit.save(layer, path) "
+            "and paddle_tpu.inference.Predictor (XLA AOT export, the "
+            "TensorRT/ONNX-engine analog).") from None
+    raise NotImplementedError(
+        "ONNX emission from XLA programs is not implemented; use "
+        "paddle_tpu.jit.save + paddle_tpu.inference for deployment")

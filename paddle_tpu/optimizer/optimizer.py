@@ -32,10 +32,13 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=False):
         if parameters is None:
-            raise ValueError(
-                "parameters is required in dygraph mode: pass "
-                "model.parameters() (the reference's global-parameter static "
-                "mode has no analog here)")
+            import paddle_tpu
+            if paddle_tpu.in_dynamic_mode():
+                raise ValueError(
+                    "parameters is required in dygraph mode: pass "
+                    "model.parameters() (static mode collects them from "
+                    "the loss graph at minimize())")
+            parameters = []  # static mode: filled by minimize()
         self._lr = learning_rate
         self._grad_clip = grad_clip
         self._name = name
@@ -192,7 +195,36 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        """Dygraph parity: backward + step (reference Optimizer.minimize)."""
+        """Dygraph: backward + step. Static mode: attach this optimizer +
+        loss to the default Program — Executor.run performs the backward
+        inside the compiled replay (reference Optimizer.minimize appends
+        backward ops to the Program the same way)."""
+        import paddle_tpu
+        if not paddle_tpu.in_dynamic_mode():
+            if not self._parameter_list:
+                # reference static mode optimizes every trainable var in
+                # the program; collect the trainable leaves of the loss
+                from paddle_tpu.core.autograd import _topo_nodes
+                from paddle_tpu.core.tensor import Parameter
+                params, seen = [], set()
+                for n in _topo_nodes([loss]):
+                    for t in n.input_tensors or ():
+                        # only true Parameters, never feeds or user
+                        # tensors that merely have stop_gradient=False
+                        # (reference collects the Program's trainable
+                        # Parameters, not arbitrary leaves)
+                        if isinstance(t, Parameter) \
+                                and t._grad_node is None \
+                                and not t.stop_gradient \
+                                and id(t) not in seen:
+                            seen.add(id(t))
+                            params.append(t)
+                self._param_groups = [{"params": params}]
+            from paddle_tpu.static.graph import default_main_program
+            prog = default_main_program()
+            prog.optimizer = self
+            prog.loss = loss
+            return None, [(p, None) for p in self._parameter_list]
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in self._parameter_list]

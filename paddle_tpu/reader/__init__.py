@@ -1,0 +1,90 @@
+"""paddle.reader parity (reference: ``python/paddle/reader/decorator.py``
+— composable reader decorators from the pre-DataLoader era; kept because
+recipe code still imports them)."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn"]
+
+
+def cache(reader):
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        return iter(all_data)
+    return cached
+
+
+def map_readers(func, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    def shuffled():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+    return chained
+
+
+def compose(*readers, check_alignment: bool = True):
+    def composed():
+        its = [r() for r in readers]
+        for items in (zip(*its) if check_alignment
+                      else itertools.zip_longest(*its)):
+            out = []
+            for it in items:
+                out.extend(it if isinstance(it, tuple) else (it,))
+            yield tuple(out)
+    return composed
+
+
+def buffered(reader, size: int):
+    """Prefetch ``size`` samples on a background thread."""
+    import queue
+    import threading
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+        end = object()
+
+        def fill():
+            for s in reader():
+                q.put(s)
+            q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                return
+            yield s
+    return buffered_reader
+
+
+def firstn(reader, n: int):
+    def limited():
+        return itertools.islice(reader(), n)
+    return limited

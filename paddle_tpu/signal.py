@@ -1,0 +1,141 @@
+"""paddle.signal parity (reference: ``python/paddle/signal.py`` —
+frame / overlap_add / stft / istft over the phi frame+fft kernels).
+
+TPU-native: framing is a gather with a static index matrix, overlap-add a
+segment-sum — both single fused tape nodes; stft/istft compose them with
+:mod:`paddle_tpu.fft`. Output layout matches paddle:
+stft -> [..., n_fft//2+1 (or n_fft), n_frames].
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames (reference: signal.py:31). With the default
+    ``axis=-1``: [..., T] -> [..., frame_length, n_frames]."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+
+    def f(a):
+        arr = jnp.moveaxis(a, axis, -1) if axis not in (-1, a.ndim - 1) \
+            else a
+        T = arr.shape[-1]
+        if frame_length > T:
+            raise ValueError(
+                f"frame_length ({frame_length}) > signal length ({T})")
+        n = 1 + (T - frame_length) // hop_length
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])  # [n, frame_length]
+        out = arr[..., idx]                          # [..., n, frame_length]
+        out = jnp.swapaxes(out, -1, -2)              # [..., frame_length, n]
+        return out
+    return apply_op(f, x, op_name="frame")
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame (reference: signal.py:151). With ``axis=-1``:
+    [..., frame_length, n_frames] -> [..., T]."""
+    def f(a):
+        fl, n = a.shape[-2], a.shape[-1]
+        T = (n - 1) * hop_length + fl
+        frames = jnp.swapaxes(a, -1, -2)  # [..., n, fl]
+        pos = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(fl)[None, :]).reshape(-1)  # [n*fl]
+        flat = frames.reshape(a.shape[:-2] + (n * fl,))
+        out = jnp.zeros(a.shape[:-2] + (T,), a.dtype)
+        return out.at[..., pos].add(flat)
+    return apply_op(f, x, op_name="overlap_add")
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Reference: signal.py:236. Returns a complex Tensor
+    [..., freq, n_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window.data if isinstance(window, Tensor) else jnp.asarray(window)
+        if w.shape[0] < n_fft:  # center-pad to n_fft like paddle
+            lpad = (n_fft - w.shape[0]) // 2
+            w = jnp.pad(w, (lpad, n_fft - w.shape[0] - lpad))
+    else:
+        w = jnp.ones(n_fft, jnp.float32)
+
+    def f(a, win):
+        arr = a
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (arr.ndim - 1) + [(pad, pad)]
+            arr = jnp.pad(arr, cfg, mode=pad_mode)
+        T = arr.shape[-1]
+        n = 1 + (T - n_fft) // hop_length
+        idx = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        seg = arr[..., idx] * win  # [..., n, n_fft]
+        if onesided and not jnp.iscomplexobj(seg):
+            spec = jnp.fft.rfft(seg, axis=-1, norm="ortho" if normalized
+                                else "backward")
+        else:
+            spec = jnp.fft.fft(seg, axis=-1, norm="ortho" if normalized
+                               else "backward")
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, n]
+    return apply_op(f, x, w, op_name="stft")
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Reference: signal.py:403 — window-weighted overlap-add inverse with
+    NOLA normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window.data if isinstance(window, Tensor) else jnp.asarray(window)
+        if w.shape[0] < n_fft:
+            lpad = (n_fft - w.shape[0]) // 2
+            w = jnp.pad(w, (lpad, n_fft - w.shape[0] - lpad))
+    else:
+        w = jnp.ones(n_fft, jnp.float32)
+
+    def f(a, win):
+        spec = jnp.swapaxes(a, -1, -2)  # [..., n, freq]
+        if onesided:
+            seg = jnp.fft.irfft(spec, n=n_fft, axis=-1,
+                                norm="ortho" if normalized else "backward")
+        else:
+            seg = jnp.fft.ifft(spec, axis=-1,
+                               norm="ortho" if normalized else "backward")
+            if not return_complex:
+                seg = seg.real
+        seg = seg * win
+        n = seg.shape[-2]
+        T = (n - 1) * hop_length + n_fft
+        pos = (jnp.arange(n)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :]).reshape(-1)
+        flat = seg.reshape(seg.shape[:-2] + (n * n_fft,))
+        out = jnp.zeros(seg.shape[:-2] + (T,), seg.dtype)
+        out = out.at[..., pos].add(flat)
+        # NOLA normalization: divide by the summed squared window
+        wsq = (win * win)[None, :] * jnp.ones((n, 1), win.dtype)
+        wsum = jnp.zeros(T, win.dtype).at[pos].add(wsq.reshape(-1))
+        out = out / jnp.maximum(wsum, 1e-11)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:T - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply_op(f, x, w, op_name="istft")

@@ -11,8 +11,16 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from paddle_tpu.core.dtype import convert_dtype
+from .graph import (  # noqa: F401
+    Executor, Program, data, default_main_program,
+    default_startup_program, global_scope, program_guard,
+)
+from . import nn  # noqa: F401
 
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "Executor", "Program", "data", "default_main_program",
+           "default_startup_program", "global_scope", "program_guard",
+           "nn"]
 
 
 class InputSpec:

@@ -1,0 +1,181 @@
+"""Static-graph facade: Program / Executor / program_guard / data.
+
+Reference: ``python/paddle/fluid/framework.py`` (Program:5384,
+Variable:1447) + ``executor.py:1394`` Executor.run — the protobuf Program
+IR interpreted by InterpreterCore.
+
+TPU-native redesign (SURVEY.md §7 step 4): there is no separate op-desc
+IR. Building the "Program" RUNS the ops once on placeholder values, which
+records the framework's tape; ``Executor.run`` replays that tape as one
+pure jax function of (feeds, parameters) — jit-compiled and cached per
+feed signature, so steady-state ``run`` is a single XLA executable, which
+is InterpreterCore's whole job done by the compiler. ``minimize`` hangs
+the optimizer on the program; ``run`` then also computes grads (jax.grad
+of the replay) and applies the update rule.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import autograd as _ag
+from paddle_tpu.core.dtype import convert_dtype
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["Program", "Executor", "program_guard", "data",
+           "default_main_program", "default_startup_program",
+           "global_scope"]
+
+
+class Program:
+    """Holds the placeholders, fetch targets, and optimizer attached
+    while this program was the default (reference Program surface)."""
+
+    def __init__(self):
+        self.feeds: Dict[str, Tensor] = {}
+        self.optimizer = None
+        self.loss: Optional[Tensor] = None
+        self._replay_cache = {}
+
+    def clone(self, for_test: bool = False):
+        return self
+
+    def global_block(self):
+        return self
+
+
+_default_main = Program()
+_default_startup = Program()
+_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _stack[-1] if _stack else _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    """Reference: static.program_guard context manager."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+
+    def __enter__(self):
+        _stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _stack.pop()
+        return False
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
+         lod_level: int = 0) -> Tensor:
+    """Declare a feed placeholder (reference: static/input.py data).
+
+    The placeholder carries a concrete dummy array (None/-1 dims become
+    1) so graph construction can execute eagerly and record the tape; the
+    executor substitutes the fed value at replay time.
+    """
+    dt = convert_dtype(dtype)
+    concrete = tuple(1 if (s is None or int(s) < 0) else int(s)
+                     for s in shape)
+    # stop_gradient=False so every op consuming the placeholder records a
+    # tape node even in parameter-free graphs (the replay IS the Program);
+    # _is_static_feed excludes it from minimize()'s trainable collection
+    t = Tensor(jnp.zeros(concrete, dt.np_dtype), stop_gradient=False,
+               name=name)
+    t._is_static_feed = True
+    default_main_program().feeds[name] = t
+    return t
+
+
+class _Scope:
+    def find_var(self, name):
+        return None
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+class Executor:
+    """Reference: executor.py Executor — here a tape-replay jit runner."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, np.ndarray]] = None,
+            fetch_list: Optional[Sequence[Tensor]] = None,
+            return_numpy: bool = True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        if program is _default_startup or (not fetch_list
+                                           and program.loss is None):
+            return []  # startup program: params are already initialized
+
+        placeholders = [program.feeds[n] for n in sorted(program.feeds)]
+        feed_vals = []
+        for n in sorted(program.feeds):
+            if n not in feed:
+                raise ValueError(f"missing feed '{n}'")
+            feed_vals.append(jnp.asarray(feed[n]))
+
+        opt = program.optimizer
+        params = list(opt._parameter_list) if opt is not None else []
+        # identity comparison on purpose: Tensor.__eq__ is elementwise
+        loss_in_fetch = any(t is program.loss for t in fetch_list)
+        targets = fetch_list + ([program.loss]
+                                if opt is not None and not loss_in_fetch
+                                else [])
+
+        key = (id(program), tuple(t.name or id(t) for t in fetch_list),
+               tuple(v.shape + (str(v.dtype),) for v in feed_vals))
+        cached = program._replay_cache.get(key)
+        if cached is None:
+            replay = _ag.make_replay_fn(targets, placeholders + params)
+            n_feed = len(placeholders)
+
+            if opt is not None:
+                loss_pos = next(i for i, t in enumerate(targets)
+                                if t is program.loss)
+
+                def step(feed_arrs, param_arrs):
+                    def loss_of(ps):
+                        outs = replay(*feed_arrs, *ps)
+                        return outs[loss_pos], outs
+                    grads, outs = jax.grad(loss_of, has_aux=True)(
+                        param_arrs)
+                    return outs, grads
+                cached = jax.jit(step)
+            else:
+                cached = jax.jit(lambda feed_arrs, param_arrs:
+                                 (replay(*feed_arrs, *param_arrs), None))
+            program._replay_cache[key] = cached
+
+        outs, grads = cached(feed_vals,
+                             [p.data for p in params])
+        if opt is not None and grads is not None:
+            for p, g in zip(params, grads):
+                p.grad = Tensor(g, stop_gradient=True)
+            opt.step()
+            opt.clear_grad()
+        results = outs[: len(fetch_list)]
+        if return_numpy:
+            return [np.asarray(r) for r in results]
+        return [Tensor(r) for r in results]
+
+    def close(self):
+        pass

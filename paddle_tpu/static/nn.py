@@ -1,0 +1,57 @@
+"""paddle.static.nn parity — the static-graph layer helpers recipe code
+uses (reference: ``python/paddle/static/nn/common.py`` fc/embedding/
+batch_norm). Each helper instantiates the dygraph layer once (creating
+the parameters) and applies it to the placeholder, so the op lands on the
+tape that Executor.run replays."""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["fc", "embedding", "batch_norm"]
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation: Optional[str] = None, name=None):
+    """Reference: static/nn/common.py fc."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu import ops
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s)
+    if tuple(x.shape[num_flatten_dims:]) != (in_features,):
+        x = ops.reshape(x, list(x.shape[:num_flatten_dims])
+                        + [in_features])
+    layer = nn.Linear(in_features, size, weight_attr=weight_attr,
+                      bias_attr=bias_attr)
+    out = layer(x)
+    if activation is not None:
+        import paddle_tpu.nn.functional as F
+        out = getattr(F, activation)(out)
+    out._static_layer = layer  # keep the params alive with the graph
+    return out
+
+
+def embedding(input, size, weight_attr=None, is_sparse: bool = False,
+              padding_idx=None, name=None):
+    """Reference: static/nn/common.py embedding."""
+    import paddle_tpu.nn as nn
+    layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                         weight_attr=weight_attr, sparse=is_sparse)
+    out = layer(input)
+    out._static_layer = layer
+    return out
+
+
+def batch_norm(input, momentum: float = 0.9, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test: bool = False, name=None):
+    """Reference: static/nn/common.py batch_norm."""
+    import paddle_tpu.nn as nn
+    ch = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    layer = nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    out._static_layer = layer
+    return out

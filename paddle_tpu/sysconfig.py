@@ -1,0 +1,20 @@
+"""paddle.sysconfig parity (reference: ``python/paddle/sysconfig.py``)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory with the C extension headers (the custom-op seam,
+    reference sysconfig.get_include)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "include")
+
+
+def get_lib() -> str:
+    """Directory with the framework's native libraries (the compiled
+    runtime pieces under native/build)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "native", "build")

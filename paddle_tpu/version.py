@@ -1,0 +1,29 @@
+"""paddle.version parity (reference: generated ``python/paddle/version``
+— full_version/major/minor/patch/rc + build metadata queries)."""
+full_version = "2.5.0+tpu"
+major = "2"
+minor = "5"
+patch = "0"
+rc = "0"
+cuda_version = "False"  # no CUDA in this build (BASELINE.md constraint)
+cudnn_version = "False"
+istaged = False
+commit = "unknown"
+with_mkl = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "cuda",
+           "cudnn", "show"]
+
+
+def cuda() -> str:
+    return cuda_version
+
+
+def cudnn() -> str:
+    return cudnn_version
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}\nminor: {minor}\npatch: {patch}\nrc: {rc}")
+    print(f"cuda: {cuda_version}\ncudnn: {cudnn_version}")

@@ -1,0 +1,184 @@
+"""paddle.distribution — scipy.stats oracles for densities/entropies,
+sample-moment checks, KL registry dispatch."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as pt
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def test_normal_log_prob_entropy_vs_scipy():
+    d = D.Normal(1.5, 2.0)
+    v = np.array([-1.0, 0.0, 3.7], np.float32)
+    np.testing.assert_allclose(_np(d.log_prob(pt.to_tensor(v))),
+                               st.norm(1.5, 2.0).logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.norm(1.5, 2.0).entropy(), rtol=1e-5)
+    np.testing.assert_allclose(float(_np(d.variance)), 4.0, rtol=1e-6)
+
+
+def test_normal_rsample_pathwise_grad():
+    pt.seed(0)
+    loc = pt.to_tensor(np.float32(0.0))
+    loc.stop_gradient = False
+    d = D.Normal(loc, 1.0)
+    s = d.rsample((256,))
+    pt.ops.mean(s).backward()
+    # d mean(loc + eps)/d loc = 1
+    np.testing.assert_allclose(float(_np(loc.grad)), 1.0, rtol=1e-5)
+
+
+def test_normal_sample_moments():
+    pt.seed(1)
+    d = D.Normal(2.0, 0.5)
+    s = _np(d.sample((20000,)))
+    assert abs(s.mean() - 2.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+
+
+def test_uniform_laplace_gumbel_vs_scipy():
+    v = np.array([0.3, 0.6], np.float32)
+    u = D.Uniform(0.0, 2.0)
+    np.testing.assert_allclose(_np(u.log_prob(pt.to_tensor(v))),
+                               st.uniform(0, 2).logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(_np(u.entropy())),
+                               st.uniform(0, 2).entropy(), rtol=1e-5)
+
+    l = D.Laplace(0.5, 1.5)
+    np.testing.assert_allclose(_np(l.log_prob(pt.to_tensor(v))),
+                               st.laplace(0.5, 1.5).logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(_np(l.entropy())),
+                               st.laplace(0.5, 1.5).entropy(), rtol=1e-5)
+
+    g = D.Gumbel(0.0, 2.0)
+    np.testing.assert_allclose(_np(g.log_prob(pt.to_tensor(v))),
+                               st.gumbel_r(0, 2).logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(_np(g.entropy())),
+                               st.gumbel_r(0, 2).entropy(), rtol=1e-5)
+
+
+def test_lognormal_vs_scipy():
+    d = D.LogNormal(0.2, 0.7)
+    v = np.array([0.5, 1.0, 2.5], np.float32)
+    np.testing.assert_allclose(
+        _np(d.log_prob(pt.to_tensor(v))),
+        st.lognorm(s=0.7, scale=np.exp(0.2)).logpdf(v), rtol=1e-4)
+    np.testing.assert_allclose(
+        float(_np(d.mean)), st.lognorm(s=0.7, scale=np.exp(0.2)).mean(),
+        rtol=1e-5)
+
+
+def test_beta_dirichlet_vs_scipy():
+    b = D.Beta(2.0, 3.0)
+    v = np.array([0.2, 0.7], np.float32)
+    np.testing.assert_allclose(_np(b.log_prob(pt.to_tensor(v))),
+                               st.beta(2, 3).logpdf(v), rtol=1e-4)
+    np.testing.assert_allclose(float(_np(b.entropy())),
+                               st.beta(2, 3).entropy(), rtol=1e-4)
+
+    c = np.array([1.5, 2.0, 3.0], np.float32)
+    d = D.Dirichlet(pt.to_tensor(c))
+    x = np.array([0.2, 0.3, 0.5], np.float32)
+    np.testing.assert_allclose(float(_np(d.log_prob(pt.to_tensor(x)))),
+                               st.dirichlet(c).logpdf(x), rtol=1e-4)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.dirichlet(c).entropy(), rtol=1e-4)
+
+
+def test_categorical_and_multinomial():
+    logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+    c = D.Categorical(pt.to_tensor(logits))
+    np.testing.assert_allclose(_np(c.probs()), [0.2, 0.3, 0.5], rtol=1e-5)
+    lp = _np(c.log_prob(pt.to_tensor(np.array([2], np.int64))))
+    np.testing.assert_allclose(lp, [np.log(0.5)], rtol=1e-5)
+    np.testing.assert_allclose(
+        float(_np(c.entropy())),
+        -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5)),
+        rtol=1e-5)
+    pt.seed(3)
+    s = _np(c.sample((5000,)))
+    freq = np.bincount(s, minlength=3) / 5000
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+    m = D.Multinomial(10, pt.to_tensor(np.array([0.3, 0.7], np.float32)))
+    v = np.array([3.0, 7.0], np.float32)
+    np.testing.assert_allclose(
+        float(_np(m.log_prob(pt.to_tensor(v)))),
+        st.multinomial(10, [0.3, 0.7]).logpmf([3, 7]), rtol=1e-4)
+    s = _np(m.sample())
+    assert s.sum() == 10
+
+
+def test_bernoulli():
+    d = D.Bernoulli(pt.to_tensor(np.float32(0.3)))
+    np.testing.assert_allclose(
+        float(_np(d.log_prob(pt.to_tensor(np.float32(1.0))))),
+        np.log(0.3), rtol=1e-4)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.bernoulli(0.3).entropy(), rtol=1e-4)
+
+
+def test_independent_sums_event_dims():
+    locs = np.zeros(4, np.float32)
+    base = D.Normal(pt.to_tensor(locs), 1.0)
+    ind = D.Independent(base, 1)
+    v = pt.to_tensor(np.ones(4, np.float32))
+    np.testing.assert_allclose(float(_np(ind.log_prob(v))),
+                               st.norm(0, 1).logpdf(1.0) * 4, rtol=1e-5)
+    assert ind.event_shape == (4,)
+
+
+def test_transformed_distribution_lognormal_equivalence():
+    base = D.Normal(0.2, 0.7)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(0.2, 0.7)
+    v = pt.to_tensor(np.array([0.5, 1.5], np.float32))
+    np.testing.assert_allclose(_np(td.log_prob(v)), _np(ln.log_prob(v)),
+                               rtol=1e-4)
+
+
+def test_affine_sigmoid_transforms():
+    t = D.AffineTransform(1.0, 2.0)
+    x = pt.to_tensor(np.array([0.5], np.float32))
+    y = t.forward(x)
+    np.testing.assert_allclose(_np(y), [2.0], rtol=1e-6)
+    np.testing.assert_allclose(_np(t.inverse(y)), [0.5], rtol=1e-6)
+    np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)),
+                               [np.log(2.0)], rtol=1e-6)
+    s = D.SigmoidTransform()
+    np.testing.assert_allclose(_np(s.inverse(s.forward(x))), [0.5],
+                               rtol=1e-5)
+
+
+def test_kl_registry_vs_scipy_numeric():
+    p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+    got = float(_np(D.kl_divergence(p, q)))
+    # analytic: log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 1/2
+    want = np.log(2.0) + (1 + 1) / 8 - 0.5
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    c1 = D.Categorical(pt.to_tensor(np.log(
+        np.array([0.5, 0.5], np.float32))))
+    c2 = D.Categorical(pt.to_tensor(np.log(
+        np.array([0.1, 0.9], np.float32))))
+    got = float(_np(D.kl_divergence(c1, c2)))
+    want = 0.5 * np.log(0.5 / 0.1) + 0.5 * np.log(0.5 / 0.9)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0, 1), D.Gumbel(0, 1))
+
+
+def test_kl_lognormal_uses_most_derived_rule():
+    """LogNormal subclasses Normal; KL(LogNormal, LogNormal) must pick the
+    Normal/Normal rule (KL is invariant under the shared bijector) rather
+    than fail."""
+    p, q = D.LogNormal(0.0, 1.0), D.LogNormal(1.0, 2.0)
+    got = float(_np(D.kl_divergence(p, q)))
+    want = np.log(2.0) + (1 + 1) / 8 - 0.5
+    np.testing.assert_allclose(got, want, rtol=1e-5)

@@ -1,0 +1,128 @@
+"""paddle.fft / paddle.signal / paddle.linalg / paddle.device — numpy
+oracles and gradient checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import fft, linalg, signal
+
+
+def _t(x):
+    return pt.to_tensor(np.asarray(x))
+
+
+# -------------------------------------------------------------------- fft
+def test_fft_roundtrip_and_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(16).astype(np.float32)
+    got = np.asarray(fft.fft(_t(x)).data)
+    np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4, atol=1e-5)
+    back = np.asarray(fft.ifft(fft.fft(_t(x))).data)
+    np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-5)
+
+
+def test_rfft_irfft_norms():
+    rng = np.random.RandomState(1)
+    x = rng.randn(32).astype(np.float32)
+    for norm in ("backward", "ortho", "forward"):
+        got = np.asarray(fft.rfft(_t(x), norm=norm).data)
+        np.testing.assert_allclose(got, np.fft.rfft(x, norm=norm),
+                                   rtol=1e-4, atol=1e-5, err_msg=norm)
+    y = np.asarray(fft.irfft(fft.rfft(_t(x)), n=32).data)
+    np.testing.assert_allclose(y, x, rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError):
+        fft.fft(_t(x), norm="bogus")
+
+
+def test_fft2_fftn_shift_freq():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(fft.fft2(_t(x)).data),
+                               np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(fft.fftn(_t(x)).data),
+                               np.fft.fftn(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(fft.fftshift(_t(x)).data),
+                               np.fft.fftshift(x), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(fft.fftfreq(8, 0.5).data),
+                               np.fft.fftfreq(8, 0.5), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(fft.rfftfreq(8).data),
+                               np.fft.rfftfreq(8), rtol=1e-6)
+
+
+def test_hfft_ihfft():
+    rng = np.random.RandomState(3)
+    x = (rng.randn(9) + 1j * rng.randn(9)).astype(np.complex64)
+    np.testing.assert_allclose(np.asarray(fft.hfft(_t(x)).data),
+                               np.fft.hfft(x), rtol=1e-3, atol=1e-4)
+    r = rng.randn(16).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(fft.ihfft(_t(r)).data),
+                               np.fft.ihfft(r), rtol=1e-3, atol=1e-5)
+
+
+def test_fft_is_differentiable():
+    x = _t(np.random.RandomState(4).randn(8).astype(np.float32))
+    x.stop_gradient = False
+    y = pt.ops.sum(pt.ops.abs(fft.rfft(x)))
+    y.backward()
+    assert x.grad is not None
+    assert np.all(np.isfinite(np.asarray(x.grad.data)))
+
+
+# ----------------------------------------------------------------- signal
+def test_frame_overlap_add_roundtrip():
+    x = np.arange(16, dtype=np.float32)
+    framed = signal.frame(_t(x), frame_length=4, hop_length=4)
+    assert list(framed.shape) == [4, 4]
+    # non-overlapping: overlap_add inverts exactly
+    back = signal.overlap_add(framed, hop_length=4)
+    np.testing.assert_allclose(np.asarray(back.data), x, rtol=1e-6)
+
+
+def test_frame_overlapping_matches_manual():
+    x = np.arange(10, dtype=np.float32)
+    framed = np.asarray(signal.frame(_t(x), 4, 2).data)  # [4, n]
+    want = np.stack([x[i:i + 4] for i in range(0, 7, 2)], axis=1)
+    np.testing.assert_allclose(framed, want, rtol=1e-6)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 512).astype(np.float32)
+    from paddle_tpu.audio.functional import get_window
+    win = get_window("hann", 64)
+    spec = signal.stft(_t(x), n_fft=64, hop_length=16, window=win)
+    assert list(spec.shape)[:2] == [2, 33]  # onesided freq bins
+    back = signal.istft(spec, n_fft=64, hop_length=16, window=win,
+                        length=512)
+    np.testing.assert_allclose(np.asarray(back.data), x, rtol=1e-3,
+                               atol=1e-4)
+
+
+# ----------------------------------------------------------------- linalg
+def test_linalg_namespace():
+    a = np.random.RandomState(6).randn(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(linalg.det(_t(spd)).data),
+                               np.linalg.det(spd), rtol=1e-3)
+    sol = np.asarray(linalg.solve(_t(spd), _t(np.ones(4, np.float32))).data)
+    np.testing.assert_allclose(spd @ sol, np.ones(4), rtol=1e-3, atol=1e-4)
+    c = np.asarray(linalg.cholesky(_t(spd)).data)
+    np.testing.assert_allclose(c @ c.T, spd, rtol=1e-3, atol=1e-4)
+
+
+# ----------------------------------------------------------------- device
+def test_device_queries():
+    assert pt.device_count() >= 1
+    assert isinstance(pt.get_device(), str)
+    assert pt.set_device("cpu") == "cpu"
+    assert pt.get_device() == "cpu"
+    assert not pt.is_compiled_with_cuda()
+    assert pt.device.cuda.device_count() == 0
+    avail = pt.device.get_available_device()
+    assert len(avail) == pt.device_count()
+    pt.device.synchronize()
+    # cuda shims degrade gracefully
+    s = pt.device.cuda.current_stream()
+    s.synchronize()
+    ev = s.record_event()
+    assert ev.query()

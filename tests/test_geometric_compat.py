@@ -1,0 +1,127 @@
+"""paddle.geometric + compat shims (batch/reader/callbacks/hub/
+sysconfig/onnx/version)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import geometric as G
+
+
+def _t(x):
+    return pt.to_tensor(np.asarray(x))
+
+
+# -------------------------------------------------------------- geometric
+def test_segment_reductions_vs_numpy():
+    data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+    ids = np.array([0, 0, 1, 1], np.int64)
+    np.testing.assert_allclose(
+        np.asarray(G.segment_sum(_t(data), _t(ids)).data),
+        [[4., 6.], [12., 14.]], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(G.segment_mean(_t(data), _t(ids)).data),
+        [[2., 3.], [6., 7.]], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(G.segment_max(_t(data), _t(ids)).data),
+        [[3., 4.], [7., 8.]], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(G.segment_min(_t(data), _t(ids)).data),
+        [[1., 2.], [5., 6.]], rtol=1e-6)
+
+
+def test_segment_empty_segment_fills_zero():
+    data = np.array([[1.0], [2.0]], np.float32)
+    ids = np.array([0, 2], np.int64)  # segment 1 untouched
+    out = np.asarray(G.segment_max(_t(data), _t(ids)).data)
+    np.testing.assert_allclose(out, [[1.0], [0.0], [2.0]], rtol=1e-6)
+
+
+def test_send_u_recv_matches_manual():
+    x = np.array([[1., 1.], [2., 2.], [3., 3.]], np.float32)
+    src = np.array([0, 1, 2, 0], np.int64)
+    dst = np.array([1, 2, 1, 0], np.int64)
+    out = np.asarray(G.send_u_recv(_t(x), _t(src), _t(dst),
+                                   reduce_op="sum").data)
+    # dst 0 <- x[0]; dst 1 <- x[0]+x[2]; dst 2 <- x[1]
+    np.testing.assert_allclose(out, [[1., 1.], [4., 4.], [2., 2.]],
+                               rtol=1e-6)
+
+
+def test_send_ue_recv_and_send_uv():
+    x = np.array([[1.], [2.]], np.float32)
+    e = np.array([[10.], [20.]], np.float32)
+    src = np.array([0, 1], np.int64)
+    dst = np.array([1, 0], np.int64)
+    out = np.asarray(G.send_ue_recv(_t(x), _t(e), _t(src), _t(dst),
+                                    message_op="add").data)
+    np.testing.assert_allclose(out, [[22.], [11.]], rtol=1e-6)
+    uv = np.asarray(G.send_uv(_t(x), _t(x), _t(src), _t(dst),
+                              message_op="mul").data)
+    np.testing.assert_allclose(uv, [[2.], [2.]], rtol=1e-6)
+
+
+def test_segment_sum_gradient():
+    data = _t(np.ones((4, 2), np.float32))
+    data.stop_gradient = False
+    ids = _t(np.array([0, 0, 1, 1], np.int64))
+    out = G.segment_sum(data, ids)
+    pt.ops.sum(pt.ops.multiply(out, out)).backward()
+    # d/dx sum((sum_seg x)^2) = 2 * seg_total broadcast back
+    np.testing.assert_allclose(np.asarray(data.grad.data),
+                               4 * np.ones((4, 2)), rtol=1e-5)
+
+
+# ------------------------------------------------------------------ compat
+def test_batch_and_reader_decorators():
+    def samples():
+        yield from range(10)
+
+    batches = list(pt.batch(samples, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    assert list(pt.batch(samples, 3, drop_last=True)()) == [
+        [0, 1, 2], [3, 4, 5], [6, 7, 8]]
+
+    from paddle_tpu import reader
+    doubled = reader.map_readers(lambda a: a * 2, samples)
+    assert list(doubled())[:3] == [0, 2, 4]
+    assert sorted(reader.shuffle(samples, 4)()) == list(range(10))
+    assert list(reader.firstn(samples, 3)()) == [0, 1, 2]
+    assert list(reader.buffered(samples, 2)()) == list(range(10))
+    assert list(reader.chain(samples, samples)()) == \
+        list(range(10)) * 2
+
+
+def test_callbacks_namespace():
+    assert pt.callbacks.EarlyStopping is not None
+    assert pt.callbacks.ModelCheckpoint is not None
+
+
+def test_hub_local(tmp_path):
+    conf = os.path.join(tmp_path, "hubconf.py")
+    with open(conf, "w") as f:
+        f.write("def tiny_model(scale=1):\n"
+                "    'a tiny model'\n"
+                "    return {'scale': scale}\n")
+    assert pt.hub.list(str(tmp_path)) == ["tiny_model"]
+    assert "tiny" in pt.hub.help(str(tmp_path), "tiny_model")
+    assert pt.hub.load(str(tmp_path), "tiny_model", scale=3) == {"scale": 3}
+    with pytest.raises(RuntimeError):
+        pt.hub.load("owner/repo", "m", source="github")
+
+
+def test_sysconfig_paths_exist():
+    inc = pt.sysconfig.get_include()
+    assert os.path.exists(os.path.join(inc, "paddle_tpu_ext.h"))
+    assert os.path.basename(pt.sysconfig.get_lib()) == "build"
+
+
+def test_onnx_export_raises_with_guidance():
+    with pytest.raises(NotImplementedError, match="jit.save"):
+        pt.onnx.export(None, "/tmp/x")
+
+
+def test_version():
+    assert pt.version.full_version.startswith("2.5")
+    assert pt.version.cuda() == "False"

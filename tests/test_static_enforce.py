@@ -1,0 +1,100 @@
+"""paddle.static Program/Executor (tape-replay) + enforce machinery."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import static
+from paddle_tpu.core import enforce as E
+
+
+@pytest.fixture
+def static_mode():
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def test_static_linear_regression_trains(static_mode):
+    """The classic static workflow: data -> fc -> loss -> minimize ->
+    Executor.run loop. Teacher data: y = x @ w_true."""
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype(np.float32)
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data(name="x", shape=[None, 13], dtype="float32")
+        y = static.data(name="y", shape=[None, 1], dtype="float32")
+        pred = static.nn.fc(x, size=1)
+        loss = pt.ops.mean(pt.ops.square(pt.ops.subtract(pred, y)))
+        opt = pt.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)  # params auto-collected from the loss graph
+
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    first = last = None
+    for step in range(60):
+        xb = rng.randn(32, 13).astype(np.float32)
+        yb = xb @ w_true
+        (lv,) = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert last < first * 0.05, (first, last)
+
+
+def test_static_fetch_without_optimizer(static_mode):
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data(name="x", shape=[None, 4], dtype="float32")
+        out = pt.ops.sum(pt.ops.multiply(x, x))
+    exe = static.Executor()
+    xv = np.arange(8, dtype=np.float32).reshape(2, 4)
+    (got,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, (xv * xv).sum(), rtol=1e-6)
+
+
+def test_static_missing_feed_raises(static_mode):
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data(name="x", shape=[2, 2], dtype="float32")
+        out = pt.ops.sum(x)
+    with pytest.raises(ValueError, match="missing feed"):
+        static.Executor().run(prog, feed={}, fetch_list=[out])
+
+
+def test_mode_toggles():
+    assert pt.in_dynamic_mode()
+    pt.enable_static()
+    assert not pt.in_dynamic_mode()
+    pt.disable_static()
+    assert pt.in_dynamic_mode()
+
+
+# ------------------------------------------------------------------ enforce
+def test_enforce_helpers():
+    E.enforce(True)
+    E.enforce_eq(3, 3)
+    E.enforce_ge(3, 3)
+    E.enforce_not_none(0) == 0  # 0 is not None
+    with pytest.raises(E.EnforceNotMet, match="Expected 3 == 4"):
+        E.enforce_eq(3, 4, "ranks must match")
+    with pytest.raises(E.EnforceNotMet, match="ranks must match"):
+        E.enforce_eq(3, 4, "ranks must match")
+    with pytest.raises(E.EnforceNotMet):
+        E.enforce_not_none(None)
+
+
+def test_enforce_shape_match_wildcards():
+    E.enforce_shape_match([-1, 4], [8, 4])
+    E.enforce_shape_match([None, 4], [8, 4])
+    with pytest.raises(E.EnforceNotMet):
+        E.enforce_shape_match([3, 4], [8, 4])
+    with pytest.raises(E.EnforceNotMet):
+        E.enforce_shape_match([3, 4], [3, 4, 5])
+
+
+def test_enforce_error_carries_stack():
+    try:
+        E.enforce(False, "boom")
+    except E.EnforceNotMet as e:
+        assert "Error Message Summary" in str(e)
+        assert "test_static_enforce" in e.stack
