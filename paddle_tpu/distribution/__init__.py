@@ -190,7 +190,10 @@ class Uniform(Distribution):
         return _op("uniform_rsample", lambda l, h: l + (h - l) * u,
                    self.low, self.high)
 
-    sample = rsample
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True  # sample() is detached; rsample is pathwise
+        return out
 
     def log_prob(self, value):
         def f(l, h, v):
@@ -229,7 +232,10 @@ class Laplace(Distribution):
                    lambda l, s: l - s * jnp.sign(u)
                    * jnp.log1p(-2 * jnp.abs(u)), self.loc, self.scale)
 
-    sample = rsample
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
 
     def log_prob(self, value):
         return _op("laplace_log_prob",
@@ -269,7 +275,10 @@ class Gumbel(Distribution):
         return _op("gumbel_rsample", lambda l, s: l + s * g,
                    self.loc, self.scale)
 
-    sample = rsample
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
 
     def log_prob(self, value):
         def f(l, s, v):
@@ -356,8 +365,8 @@ class Dirichlet(Distribution):
 
     def sample(self, shape=()):
         out = jax.random.dirichlet(
-            G.next_key(), np.asarray(self.concentration.data), shape=shape
-            if shape else None)
+            G.next_key(), np.asarray(self.concentration.data),
+            shape=_shape(shape, self.batch_shape) if shape else None)
         return Tensor(out)
 
     def log_prob(self, value):

@@ -3,5 +3,6 @@
 sparsity) and functional/forward-mode autodiff (``incubate.autograd``)."""
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
 
-__all__ = ["asp", "autograd"]
+__all__ = ["asp", "autograd", "nn"]

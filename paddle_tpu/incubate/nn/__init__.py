@@ -1,0 +1,234 @@
+"""paddle.incubate.nn parity — the fused transformer layer set
+(reference: ``python/paddle/incubate/nn/layer/fused_transformer.py``
+FusedMultiHeadAttention / FusedFeedForward / FusedTransformerEncoderLayer
+/ FusedMultiTransformer, ``fused_linear.py``, ``fused_ec_moe.py``; CUDA
+kernels under ``paddle/fluid/operators/fused/``).
+
+TPU-native: "fused" is the compiler's default on XLA — these layers exist
+for API parity and route attention through the Pallas flash kernel (the
+hand-fusion that actually matters on TPU, SURVEY.md §2.10 item 6). Each
+matches the reference's parameter naming so state_dicts port.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import paddle_tpu.nn as nn
+from paddle_tpu import ops
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.layer_base import Layer
+
+__all__ = ["FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedEcMoe"]
+
+
+class FusedLinear(Layer):
+    """Reference: fused_linear.py FusedLinear (matmul+bias in one op —
+    XLA fuses this unconditionally)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight \
+            else [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        w = ops.transpose(self.weight, [1, 0]) if self.transpose_weight \
+            else self.weight
+        return F.linear(x, w, self.bias)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference: fused_transformer.py FusedMultiHeadAttention —
+    pre/post-LN + QKV proj + attention + out proj in one fused op; here
+    attention runs the flash path."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        # reference stores one packed QKV weight [3, H, D/H, E]
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim], attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, self.head_dim], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True)
+        self._epsilon = epsilon
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        residual = query
+        x = query
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], self.pre_ln_scale,
+                             self.pre_ln_bias, self._epsilon)
+        B, S = x.shape[0], x.shape[1]
+        # packed qkv: x [B,S,E] @ W[3,H,hd,E] -> [B,S,3,H,hd]
+        w = ops.reshape(ops.transpose(
+            ops.reshape(self.qkv_weight,
+                        [3 * self.num_heads * self.head_dim,
+                         self.embed_dim]), [1, 0]),
+            [self.embed_dim, 3 * self.num_heads * self.head_dim])
+        qkv = ops.add(ops.matmul(x, w),
+                      ops.reshape(self.qkv_bias, [-1]))
+        qkv = ops.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        out = ops.reshape(out, [B, S, self.embed_dim])
+        out = ops.add(ops.matmul(out, self.linear_weight), self.linear_bias)
+        out = self.dropout(out)
+        out = ops.add(residual, out)
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.embed_dim], self.ln_scale,
+                               self.ln_bias, self._epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Reference: fused_transformer.py FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.normalize_before = normalize_before
+        self._epsilon = epsilon
+        self._act = activation
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.ln1_bias = self.create_parameter([d_model], attr=ln1_bias_attr,
+                                              is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.ln2_bias = self.create_parameter([d_model], attr=ln2_bias_attr,
+                                              is_bias=True)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = src
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], self.ln1_scale,
+                             self.ln1_bias, self._epsilon)
+        h = ops.add(ops.matmul(x, self.linear1_weight), self.linear1_bias)
+        h = getattr(F, self._act)(h)
+        h = self.act_dropout(h)
+        h = ops.add(ops.matmul(h, self.linear2_weight), self.linear2_bias)
+        h = self.dropout(h)
+        out = ops.add(residual, h)
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.d_model], self.ln2_scale,
+                               self.ln2_bias, self._epsilon)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Reference: fused_transformer.py FusedTransformerEncoderLayer —
+    FusedMultiHeadAttention + FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate if attn_dropout_rate is None
+            else attn_dropout_rate, normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """Reference: fused_transformer.py FusedMultiTransformer — the
+    inference-oriented N-layer stack with shared config."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, nranks=1,
+                 ring_id=-1, name=None):
+        super().__init__()
+        self.layers = nn.LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, src, attn_mask=None, caches=None):
+        x = src
+        for layer in self.layers:
+            x = layer(x, src_mask=attn_mask)
+        return x
+
+
+class FusedEcMoe(Layer):
+    """Reference: fused_ec_moe.py FusedEcMoe (expert-choice MoE over the
+    cutlass grouped GEMM) — here it reuses the expert-parallel MoELayer
+    (Pallas/einsum dispatch)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from paddle_tpu.distributed.fleet import MoELayer
+        self.moe = MoELayer(hidden_size, inter_size, num_experts,
+                            gate="gshard", top_k=2, activation=act_type)
+
+    def forward(self, x, gate=None):
+        return self.moe(x)

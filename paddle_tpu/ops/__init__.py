@@ -15,6 +15,9 @@ from .creation import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .array import (  # noqa: F401
+    TensorArray, array_length, array_read, array_write, create_array,
+)
 
 from . import math as _math
 from . import creation as _creation

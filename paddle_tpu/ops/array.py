@@ -1,0 +1,61 @@
+"""TensorArray + array ops (reference: ``paddle/phi/core/tensor_array.h``
+TensorArray; Python surface ``python/paddle/tensor/array.py``
+create_array / array_write / array_read / array_length).
+
+Eager-mode design: a Python list of Tensors (the reference dygraph path
+does exactly this — ``array.py`` appends to a list when in dygraph mode).
+Inside jit-captured code, prefer ``lax.scan`` via the nn RNN layers; the
+list form is the dygraph UX."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length"]
+
+
+class TensorArray(list):
+    """A list of Tensors with the reference's dtype tag."""
+
+    def __init__(self, dtype: str = "float32"):
+        super().__init__()
+        self.dtype = dtype
+
+
+def create_array(dtype: str = "float32", initialized_list=None):
+    arr = TensorArray(dtype)
+    for t in initialized_list or ():
+        arr.append(t if isinstance(t, Tensor) else Tensor(t))
+    return arr
+
+
+def _index(i) -> int:
+    if isinstance(i, Tensor):
+        return int(i.numpy())
+    return int(i)
+
+
+def array_write(x: Tensor, i, array: Optional[TensorArray] = None):
+    """Write ``x`` at position ``i`` (extends the array if i == len)."""
+    if array is None:
+        array = create_array()
+    idx = _index(i)
+    if idx < len(array):
+        array[idx] = x
+    elif idx == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write index {idx} beyond array length {len(array)}")
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    return array[_index(i)]
+
+
+def array_length(array: TensorArray) -> Tensor:
+    from paddle_tpu.core.tensor import to_tensor
+    return to_tensor(len(array), dtype="int64")

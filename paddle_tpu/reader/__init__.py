@@ -7,7 +7,7 @@ import itertools
 import random as _random
 
 __all__ = ["cache", "map_readers", "buffered", "compose", "chain",
-           "shuffle", "firstn"]
+           "shuffle", "firstn", "ComposeNotAligned"]
 
 
 def cache(reader):
@@ -48,11 +48,27 @@ def chain(*readers):
     return chained
 
 
+class ComposeNotAligned(ValueError):
+    """Reference: reader/decorator.py ComposeNotAligned."""
+
+
 def compose(*readers, check_alignment: bool = True):
+    """Reference semantics: check_alignment=True raises
+    :class:`ComposeNotAligned` when readers exhaust at different lengths;
+    False silently truncates to the shortest."""
+    _END = object()
+
     def composed():
         its = [r() for r in readers]
-        for items in (zip(*its) if check_alignment
-                      else itertools.zip_longest(*its)):
+        if not check_alignment:
+            source = zip(*its)
+        else:
+            source = itertools.zip_longest(*its, fillvalue=_END)
+        for items in source:
+            if check_alignment and any(i is _END for i in items):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned (different "
+                    "lengths); pass check_alignment=False to truncate")
             out = []
             for it in items:
                 out.extend(it if isinstance(it, tuple) else (it,))

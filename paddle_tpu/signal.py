@@ -20,31 +20,49 @@ __all__ = ["frame", "overlap_add", "stft", "istft"]
 
 
 def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
-    """Slice overlapping frames (reference: signal.py:31). With the default
-    ``axis=-1``: [..., T] -> [..., frame_length, n_frames]."""
+    """Slice overlapping frames (reference: signal.py:31).
+
+    ``axis=-1``: [..., T] -> [..., frame_length, n_frames];
+    ``axis=0``:  [T, ...] -> [n_frames, frame_length, ...].
+    """
     if frame_length <= 0 or hop_length <= 0:
         raise ValueError("frame_length and hop_length must be positive")
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1 (reference frame contract)")
 
     def f(a):
-        arr = jnp.moveaxis(a, axis, -1) if axis not in (-1, a.ndim - 1) \
-            else a
-        T = arr.shape[-1]
+        T = a.shape[0] if axis == 0 else a.shape[-1]
         if frame_length > T:
             raise ValueError(
                 f"frame_length ({frame_length}) > signal length ({T})")
         n = 1 + (T - frame_length) // hop_length
         idx = (jnp.arange(n)[:, None] * hop_length
                + jnp.arange(frame_length)[None, :])  # [n, frame_length]
-        out = arr[..., idx]                          # [..., n, frame_length]
-        out = jnp.swapaxes(out, -1, -2)              # [..., frame_length, n]
-        return out
+        if axis == 0:
+            return a[idx]                            # [n, frame_length, ...]
+        out = a[..., idx]                            # [..., n, frame_length]
+        return jnp.swapaxes(out, -1, -2)             # [..., frame_length, n]
     return apply_op(f, x, op_name="frame")
 
 
 def overlap_add(x, hop_length: int, axis: int = -1, name=None):
-    """Inverse of frame (reference: signal.py:151). With ``axis=-1``:
-    [..., frame_length, n_frames] -> [..., T]."""
+    """Inverse of frame (reference: signal.py:151).
+
+    ``axis=-1``: [..., frame_length, n_frames] -> [..., T];
+    ``axis=0``:  [n_frames, frame_length, ...] -> [T, ...].
+    """
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1 (reference contract)")
+
     def f(a):
+        if axis == 0:
+            n, fl = a.shape[0], a.shape[1]
+            T = (n - 1) * hop_length + fl
+            pos = (jnp.arange(n)[:, None] * hop_length
+                   + jnp.arange(fl)[None, :]).reshape(-1)
+            flat = a.reshape((n * fl,) + a.shape[2:])
+            out = jnp.zeros((T,) + a.shape[2:], a.dtype)
+            return out.at[pos].add(flat)
         fl, n = a.shape[-2], a.shape[-1]
         T = (n - 1) * hop_length + fl
         frames = jnp.swapaxes(a, -1, -2)  # [..., n, fl]
@@ -64,6 +82,11 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
     [..., freq, n_frames]."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    x_data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    if onesided and jnp.iscomplexobj(x_data):
+        raise ValueError(
+            "stft: onesided is not supported for complex input (reference "
+            "signal.py contract); pass onesided=False")
     if window is not None:
         w = window.data if isinstance(window, Tensor) else jnp.asarray(window)
         if w.shape[0] < n_fft:  # center-pad to n_fft like paddle

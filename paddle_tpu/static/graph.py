@@ -89,10 +89,10 @@ def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
                      for s in shape)
     # stop_gradient=False so every op consuming the placeholder records a
     # tape node even in parameter-free graphs (the replay IS the Program);
-    # _is_static_feed excludes it from minimize()'s trainable collection
+    # minimize() only collects Parameter instances, so feeds are never
+    # promoted to trainables
     t = Tensor(jnp.zeros(concrete, dt.np_dtype), stop_gradient=False,
                name=name)
-    t._is_static_feed = True
     default_main_program().feeds[name] = t
     return t
 
@@ -141,7 +141,10 @@ class Executor:
                                 if opt is not None and not loss_in_fetch
                                 else [])
 
-        key = (id(program), tuple(t.name or id(t) for t in fetch_list),
+        # id(opt) in the key: attaching an optimizer after an eval run
+        # must not reuse the eval closure (grads=None would skip training)
+        key = (id(program), id(opt),
+               tuple(t.name or id(t) for t in fetch_list),
                tuple(v.shape + (str(v.dtype),) for v in feed_vals))
         cached = program._replay_cache.get(key)
         if cached is None:

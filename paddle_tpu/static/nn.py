@@ -19,7 +19,9 @@ def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
     for s in x.shape[num_flatten_dims:]:
         in_features *= int(s)
     if tuple(x.shape[num_flatten_dims:]) != (in_features,):
-        x = ops.reshape(x, list(x.shape[:num_flatten_dims])
+        # -1 for the leading (batch) dim: the placeholder's dummy batch
+        # size must not be baked into the replayed reshape
+        x = ops.reshape(x, [-1] + list(x.shape[1:num_flatten_dims])
                         + [in_features])
     layer = nn.Linear(in_features, size, weight_attr=weight_attr,
                       bias_attr=bias_attr)

@@ -182,3 +182,20 @@ def test_kl_lognormal_uses_most_derived_rule():
     got = float(_np(D.kl_divergence(p, q)))
     want = np.log(2.0) + (1 + 1) / 8 - 0.5
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_dirichlet_batched_sample_shape():
+    d = D.Dirichlet(pt.to_tensor(np.ones((3, 4), np.float32)))
+    s = _np(d.sample((5,)))
+    assert s.shape == (5, 3, 4)
+    np.testing.assert_allclose(s.sum(-1), np.ones((5, 3)), rtol=1e-5)
+
+
+def test_sample_is_detached_rsample_is_not():
+    for cls, args in ((D.Uniform, (0.0,)), (D.Laplace, (1.0,)),
+                      (D.Gumbel, (1.0,))):
+        p = pt.to_tensor(np.float32(0.5))
+        p.stop_gradient = False
+        d = cls(p, *args) if cls is not D.Uniform else D.Uniform(p, 1.0)
+        assert d.sample((3,)).stop_gradient
+        assert not d.rsample((3,)).stop_gradient

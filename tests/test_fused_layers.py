@@ -1,0 +1,95 @@
+"""incubate.nn fused layers + TensorArray ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.nn import (
+    FusedEcMoe, FusedFeedForward, FusedLinear, FusedMultiHeadAttention,
+    FusedMultiTransformer, FusedTransformerEncoderLayer,
+)
+
+
+def test_fused_linear_matches_linear():
+    pt.seed(0)
+    fl = FusedLinear(8, 4)
+    x = pt.to_tensor(np.random.RandomState(0).randn(2, 8)
+                     .astype(np.float32))
+    out = fl(x)
+    want = np.asarray(x.data) @ np.asarray(fl.weight.data) \
+        + np.asarray(fl.bias.data)
+    np.testing.assert_allclose(np.asarray(out.data), want, rtol=1e-5)
+
+    flt = FusedLinear(8, 4, transpose_weight=True)
+    assert list(flt.weight.shape) == [4, 8]
+    out_t = flt(x)
+    assert list(out_t.shape) == [2, 4]
+
+
+def test_fused_mha_forward_backward():
+    pt.seed(1)
+    attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0)
+    x = pt.to_tensor(np.random.RandomState(1).randn(2, 6, 16)
+                     .astype(np.float32))
+    out = attn(x)
+    assert list(out.shape) == [2, 6, 16]
+    pt.ops.sum(out).backward()
+    assert attn.qkv_weight.grad is not None
+    assert attn.linear_weight.grad is not None
+
+
+def test_fused_ffn_pre_post_ln():
+    pt.seed(2)
+    x = pt.to_tensor(np.random.RandomState(2).randn(2, 4, 8)
+                     .astype(np.float32))
+    for pre in (True, False):
+        ffn = FusedFeedForward(8, 16, dropout_rate=0.0,
+                               normalize_before=pre)
+        out = ffn(x)
+        assert list(out.shape) == [2, 4, 8]
+        pt.ops.sum(out).backward()
+
+
+def test_fused_encoder_layer_and_stack_train():
+    pt.seed(3)
+    layer = FusedTransformerEncoderLayer(16, 2, 32, dropout_rate=0.0)
+    x = pt.to_tensor(np.random.RandomState(3).randn(2, 5, 16)
+                     .astype(np.float32))
+    out = layer(x)
+    assert list(out.shape) == [2, 5, 16]
+
+    stack = FusedMultiTransformer(16, 2, 32, num_layers=2)
+    out2 = stack(x)
+    assert list(out2.shape) == [2, 5, 16]
+    pt.ops.sum(out2).backward()
+    grads = [p.grad for _, p in stack.named_parameters()
+             if p.grad is not None]
+    assert len(grads) > 10
+
+
+def test_fused_ec_moe():
+    pt.seed(4)
+    moe = FusedEcMoe(8, 16, num_experts=4)
+    x = pt.to_tensor(np.random.RandomState(4).randn(2, 6, 8)
+                     .astype(np.float32))
+    out = moe(x)
+    assert list(out.shape) == [2, 6, 8]
+    pt.ops.sum(out).backward()
+
+
+def test_tensor_array_ops():
+    arr = pt.ops.create_array("float32")
+    a = pt.to_tensor(np.ones(3, np.float32))
+    b = pt.to_tensor(np.zeros(3, np.float32))
+    pt.ops.array_write(a, 0, arr)
+    pt.ops.array_write(b, 1, arr)
+    assert int(pt.ops.array_length(arr).numpy()) == 2
+    got = pt.ops.array_read(arr, pt.to_tensor(np.int64(0)))
+    np.testing.assert_array_equal(np.asarray(got.data), np.ones(3))
+    # overwrite in place
+    pt.ops.array_write(b, 0, arr)
+    np.testing.assert_array_equal(
+        np.asarray(pt.ops.array_read(arr, 0).data), np.zeros(3))
+    with pytest.raises(IndexError):
+        pt.ops.array_write(a, 5, arr)

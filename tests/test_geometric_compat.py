@@ -125,3 +125,19 @@ def test_onnx_export_raises_with_guidance():
 def test_version():
     assert pt.version.full_version.startswith("2.5")
     assert pt.version.cuda() == "False"
+
+
+def test_compose_alignment_semantics():
+    from paddle_tpu import reader
+
+    def r5():
+        yield from range(5)
+
+    def r3():
+        yield from range(3)
+
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(r5, r3)())
+    # check_alignment=False truncates to the shortest
+    assert list(reader.compose(r5, r3, check_alignment=False)()) == [
+        (0, 0), (1, 1), (2, 2)]

@@ -98,3 +98,38 @@ def test_enforce_error_carries_stack():
     except E.EnforceNotMet as e:
         assert "Error Message Summary" in str(e)
         assert "test_static_enforce" in e.stack
+
+
+def test_static_eval_then_minimize_trains(static_mode):
+    """Attaching an optimizer after an eval run must not reuse the eval
+    closure (regression: cache key now includes the optimizer)."""
+    rng = np.random.RandomState(1)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x, size=1)
+        loss = pt.ops.mean(pt.ops.square(pt.ops.subtract(pred, y)))
+    exe = static.Executor()
+    xb = rng.randn(16, 4).astype(np.float32)
+    yb = (xb.sum(1, keepdims=True)).astype(np.float32)
+    (l0,) = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+    with static.program_guard(prog):
+        pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    last = None
+    for _ in range(40):
+        (lv,) = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        last = float(lv)
+    assert last < float(l0) * 0.5, (float(l0), last)
+
+
+def test_static_fc_rank3_dynamic_batch(static_mode):
+    """fc must not bake the dummy batch size into its flatten reshape."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 3, 4], "float32")
+        out = static.nn.fc(x, size=2)
+    exe = static.Executor()
+    xv = np.random.RandomState(2).randn(8, 3, 4).astype(np.float32)
+    (got,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    assert got.shape == (8, 2)
