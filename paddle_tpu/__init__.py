@@ -67,6 +67,33 @@ from paddle_tpu import reader  # noqa: F401
 from paddle_tpu import sysconfig  # noqa: F401
 from paddle_tpu import version  # noqa: F401
 from paddle_tpu.batch import batch  # noqa: F401
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary parity (reference: hapi/model_summary.py) — layer
+    table + parameter counts."""
+    from paddle_tpu.hapi import Model
+    return Model(net).summary(input_size)
+
+
+def flops(net, input_size, custom_ops=None, print_detail: bool = False):
+    """paddle.flops parity (reference: hapi/dynamic_flops.py) — here the
+    count comes from XLA's own cost analysis of the compiled forward (the
+    TPU-native flops oracle) instead of per-layer hooks."""
+    import numpy as np
+    from paddle_tpu.distributed.auto_parallel import CostEstimator
+
+    x = np.zeros(input_size, np.float32)
+
+    def fwd(arr):
+        out = net(Tensor(arr))
+        return out.data if isinstance(out, Tensor) else out
+
+    with no_grad():
+        r = CostEstimator().analyze(fwd, x)
+    if print_detail:
+        print(f"FLOPs: {r['flops']:.3e}  bytes: {r['bytes_accessed']:.3e}")
+    return int(r["flops"])
 from paddle_tpu import linalg  # noqa: F401
 from paddle_tpu import signal  # noqa: F401
 

@@ -95,11 +95,10 @@ class FusedMultiHeadAttention(Layer):
                              self.pre_ln_bias, self._epsilon)
         B, S = x.shape[0], x.shape[1]
         # packed qkv: x [B,S,E] @ W[3,H,hd,E] -> [B,S,3,H,hd]
-        w = ops.reshape(ops.transpose(
+        w = ops.transpose(
             ops.reshape(self.qkv_weight,
                         [3 * self.num_heads * self.head_dim,
-                         self.embed_dim]), [1, 0]),
-            [self.embed_dim, 3 * self.num_heads * self.head_dim])
+                         self.embed_dim]), [1, 0])
         qkv = ops.add(ops.matmul(x, w),
                       ops.reshape(self.qkv_bias, [-1]))
         qkv = ops.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
@@ -227,8 +226,29 @@ class FusedEcMoe(Layer):
                  weight_attr=None, bias_attr=None):
         super().__init__()
         from paddle_tpu.distributed.fleet import MoELayer
+        self._act_type = act_type
         self.moe = MoELayer(hidden_size, inter_size, num_experts,
                             gate="gshard", top_k=2, activation=act_type)
 
     def forward(self, x, gate=None):
-        return self.moe(x)
+        """With ``gate`` (caller-supplied logits [..., E], the reference
+        contract), tokens are combined by softmax(gate) over a dense
+        evaluation of all experts — the capacity-unlimited limit of
+        expert-choice routing, which is the XLA-friendly form (every
+        expert runs as one batched einsum). Without ``gate``, the
+        internal top-k gate routes with capacity, like MoELayer."""
+        if gate is None:
+            return self.moe(x)
+        from paddle_tpu.core.autograd import apply_op
+        import jax
+        import jax.numpy as jnp
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+               "silu": jax.nn.silu}[self._act_type]
+
+        def f(xa, ga, w1, b1, w2, b2):
+            h = act(jnp.einsum("...d,edh->...eh", xa, w1) + b1)
+            y = jnp.einsum("...eh,ehd->...ed", h, w2) + b2
+            probs = jax.nn.softmax(ga, axis=-1)
+            return jnp.einsum("...e,...ed->...d", probs, y)
+        return apply_op(f, x, gate, self.moe.w1, self.moe.b1, self.moe.w2,
+                        self.moe.b2, op_name="fused_ec_moe")

@@ -1,0 +1,263 @@
+"""paddle.vision.ops parity — detection ops (reference:
+``python/paddle/vision/ops.py``: nms, roi_align, roi_pool, box_coder,
+deform_conv2d, yolo_box...; kernels under ``paddle/phi/kernels``).
+
+TPU-native notes: roi_align/roi_pool are gather+bilinear compositions (one
+fused tape node, differentiable w.r.t. the feature map); nms is the
+classic sequential-suppression algorithm expressed as a ``lax.scan`` over
+score-sorted boxes (static shapes, no host sync under jit).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["box_area", "box_iou", "nms", "roi_align", "roi_pool",
+           "box_coder"]
+
+
+def box_area(boxes):
+    """[N, 4] xyxy -> [N] areas (reference: vision/ops.py)."""
+    def f(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply_op(f, boxes, op_name="box_area")
+
+
+def _iou_matrix(b1, b2):
+    area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                               1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU, [N, M]."""
+    return apply_op(_iou_matrix, boxes1, boxes2, op_name="box_iou")
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None):
+    """Greedy non-maximum suppression (reference: vision/ops.py nms).
+
+    Returns kept indices sorted by descending score. With
+    ``category_idxs``/``categories``, suppression is per-category
+    (batched-NMS offset trick).
+    """
+    def f(b, s):
+        n = b.shape[0]
+        order = jnp.argsort(-s)
+        b_sorted = b[order]
+        iou = _iou_matrix(b_sorted, b_sorted)
+
+        def body(keep, i):
+            # suppressed if any higher-scored KEPT box overlaps > thresh
+            over = (iou[i] > iou_threshold) & keep & \
+                (jnp.arange(n) < i)
+            k = ~jnp.any(over)
+            return keep.at[i].set(k), None
+
+        keep0 = jnp.ones(n, bool)
+        keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+        return order, keep
+
+    bt = boxes if isinstance(boxes, Tensor) else Tensor(jnp.asarray(boxes))
+    if scores is None:
+        st = Tensor(jnp.arange(bt.data.shape[0], 0, -1,
+                               dtype=jnp.float32))
+    else:
+        st = scores if isinstance(scores, Tensor) \
+            else Tensor(jnp.asarray(scores))
+    if category_idxs is not None:
+        # batched NMS: offset boxes per category so cross-category boxes
+        # never overlap (the reference applies NMS per category)
+        cat = category_idxs.data if isinstance(category_idxs, Tensor) \
+            else jnp.asarray(category_idxs)
+        span = jnp.max(bt.data) - jnp.min(bt.data) + 1
+        offset = cat.astype(bt.data.dtype)[:, None] * span
+        bt = Tensor(bt.data + offset)
+
+    order, keep = apply_op(f, bt, st, op_name="nms")
+    order_np = np.asarray(order.data)
+    keep_np = np.asarray(keep.data)
+    kept = order_np[np.where(keep_np)[0]]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept.astype(np.int64)))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """RoIAlign (reference: vision/ops.py roi_align; kernel
+    ``phi/kernels/cpu/roi_align_kernel.cc``): bilinear sampling on a
+    regular grid inside each box, averaged per output cell.
+
+    x: [N, C, H, W]; boxes: [R, 4] xyxy in input coords; boxes_num: [N]
+    rois per image. Returns [R, C, out_h, out_w]; differentiable in x.
+    """
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    if sampling_ratio > 0:
+        ratio = sampling_ratio
+    else:
+        # reference adaptive rule (roi_align_kernel.cc:276): ceil of the
+        # largest roi-cell size; computed host-side from concrete boxes
+        # (capped at 8 samples/axis), falling back to 2 under tracing
+        try:
+            b = np.asarray(boxes.data if isinstance(boxes, Tensor)
+                           else boxes)
+            cell = max(float(np.max((b[:, 3] - b[:, 1]))) * spatial_scale
+                       / out_h,
+                       float(np.max((b[:, 2] - b[:, 0]))) * spatial_scale
+                       / out_w, 1.0)
+            ratio = int(min(np.ceil(cell), 8))
+        except Exception:  # traced boxes: no concrete values
+            ratio = 2
+
+    bn = boxes_num.data if isinstance(boxes_num, Tensor) \
+        else jnp.asarray(boxes_num)
+    batch_of_roi = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                              total_repeat_length=int(jnp.sum(bn)))
+
+    def f(feat, rois):
+        H, W = feat.shape[2], feat.shape[3]
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        # sample grid: out_h*ratio x out_w*ratio points per roi
+        gy = (jnp.arange(out_h * ratio) + 0.5) / ratio  # in output cells
+        gx = (jnp.arange(out_w * ratio) + 0.5) / ratio
+        ys = y1[:, None] + rh[:, None] * gy[None, :] / out_h  # [R, oh*r]
+        xs = x1[:, None] + rw[:, None] * gx[None, :] / out_w
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [oh*r], xx [ow*r] -> [C, oh*r, ow*r]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy, 0, H - 1) - y0
+            wx = jnp.clip(xx, 0, W - 1) - x0
+            y0i, y1i = y0.astype(jnp.int32), y1_.astype(jnp.int32)
+            x0i, x1i = x0.astype(jnp.int32), x1_.astype(jnp.int32)
+            v00 = img[:, y0i][:, :, x0i]
+            v01 = img[:, y0i][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0i]
+            v11 = img[:, y1i][:, :, x1i]
+            w00 = ((1 - wy)[:, None] * (1 - wx)[None, :])
+            w01 = ((1 - wy)[:, None] * wx[None, :])
+            w10 = (wy[:, None] * (1 - wx)[None, :])
+            w11 = (wy[:, None] * wx[None, :])
+            return v00 * w00 + v01 * w01 + v10 * w10 + v11 * w11
+
+        def per_roi(r):
+            img = feat[batch_of_roi[r]]
+            s = bilinear(img, ys[r], xs[r])  # [C, oh*ratio, ow*ratio]
+            C = s.shape[0]
+            s = s.reshape(C, out_h, ratio, out_w, ratio)
+            return s.mean(axis=(2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(rois.shape[0]))
+
+    return apply_op(f, x, boxes, op_name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None):
+    """RoIPool = max instead of average, no subsampling (reference:
+    vision/ops.py roi_pool). Implemented as roi_align with dense sampling
+    + max reduction over each cell's samples."""
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    ratio = 2
+    bn = boxes_num.data if isinstance(boxes_num, Tensor) \
+        else jnp.asarray(boxes_num)
+    batch_of_roi = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                              total_repeat_length=int(jnp.sum(bn)))
+
+    def f(feat, rois):
+        H, W = feat.shape[2], feat.shape[3]
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        gy = (jnp.arange(out_h * ratio) + 0.5) / ratio
+        gx = (jnp.arange(out_w * ratio) + 0.5) / ratio
+        ys = y1[:, None] + rh[:, None] * gy[None, :] / out_h
+        xs = x1[:, None] + rw[:, None] * gx[None, :] / out_w
+
+        def per_roi(r):
+            img = feat[batch_of_roi[r]]
+            yi = jnp.clip(jnp.round(ys[r]), 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(jnp.round(xs[r]), 0, W - 1).astype(jnp.int32)
+            s = img[:, yi][:, :, xi]
+            C = s.shape[0]
+            s = s.reshape(C, out_h, ratio, out_w, ratio)
+            return s.max(axis=(2, 4))
+        return jax.vmap(per_roi)(jnp.arange(rois.shape[0]))
+
+    return apply_op(f, x, boxes, op_name="roi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True, axis: int = 0, name=None):
+    """Encode/decode boxes against priors (reference: vision/ops.py
+    box_coder / phi box_coder kernel, SSD-style)."""
+    def enc(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = (pb[:, 0] + pb[:, 2]) / 2
+        pcy = (pb[:, 1] + pb[:, 3]) / 2
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = (tb[:, 0] + tb[:, 2]) / 2
+        tcy = (tb[:, 1] + tb[:, 3]) / 2
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+        return out / pbv if pbv is not None else out
+
+    def dec(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = (pb[:, 0] + pb[:, 2]) / 2
+        pcy = (pb[:, 1] + pb[:, 3]) / 2
+        t = tb * pbv if pbv is not None else tb
+        cx = t[:, 0] * pw + pcx
+        cy = t[:, 1] * ph + pcy
+        w = jnp.exp(t[:, 2]) * pw
+        h = jnp.exp(t[:, 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], axis=1)
+
+    if code_type not in ("encode_center_size", "decode_center_size"):
+        raise ValueError(
+            f"unknown code_type '{code_type}'; expected "
+            "'encode_center_size' or 'decode_center_size'")
+    fn = enc if code_type == "encode_center_size" else dec
+    if prior_box_var is None:
+        return apply_op(lambda pb, tb: fn(pb, None, tb), prior_box,
+                        target_box, op_name="box_coder")
+    return apply_op(fn, prior_box, prior_box_var, target_box,
+                    op_name="box_coder")

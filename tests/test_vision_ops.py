@@ -1,0 +1,191 @@
+"""paddle.vision.ops — nms/roi_align/roi_pool/box utils (torch CPU as the
+oracle where available)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as V
+
+
+def _t(x):
+    return pt.to_tensor(np.asarray(x))
+
+
+BOXES = np.array([
+    [0, 0, 10, 10],
+    [1, 1, 11, 11],     # heavy overlap with box 0
+    [20, 20, 30, 30],
+    [21, 21, 29, 29],   # heavy overlap with box 2
+    [50, 50, 60, 60],
+], np.float32)
+SCORES = np.array([0.9, 0.8, 0.7, 0.95, 0.5], np.float32)
+
+
+def test_box_area_and_iou():
+    areas = np.asarray(V.box_area(_t(BOXES)).data)
+    np.testing.assert_allclose(areas, [100, 100, 100, 64, 100], rtol=1e-6)
+    iou = np.asarray(V.box_iou(_t(BOXES[:2]), _t(BOXES[:2])).data)
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-6)
+    assert 0.5 < iou[0, 1] < 0.8
+
+
+def _np_nms(boxes, scores, thresh):
+    """Greedy NMS numpy oracle (the textbook algorithm)."""
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+            a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a_j = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a_i + a_j - inter) > thresh:
+                suppressed[j] = True
+    return np.array(keep)
+
+
+def test_nms_matches_numpy_oracle():
+    got = np.asarray(V.nms(_t(BOXES), 0.5, _t(SCORES)).data)
+    want = _np_nms(BOXES, SCORES, 0.5)
+    np.testing.assert_array_equal(got, want)
+
+    rng = np.random.RandomState(7)
+    for _ in range(3):
+        b = rng.rand(30, 2) * 50
+        boxes = np.hstack([b, b + rng.rand(30, 2) * 20 + 1]) \
+            .astype(np.float32)
+        scores = rng.rand(30).astype(np.float32)
+        got = np.asarray(V.nms(_t(boxes), 0.4, _t(scores)).data)
+        want = _np_nms(boxes, scores, 0.4)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_nms_no_scores_and_topk():
+    got = np.asarray(V.nms(_t(BOXES), 0.5, _t(SCORES), top_k=2).data)
+    assert len(got) == 2
+    assert got[0] == 3  # highest score survives first
+
+
+def test_nms_categories_do_not_suppress_across():
+    cats = np.array([0, 1, 0, 1, 0], np.int64)
+    got = set(np.asarray(V.nms(_t(BOXES), 0.5, _t(SCORES),
+                               category_idxs=_t(cats),
+                               categories=[0, 1]).data).tolist())
+    # boxes 0 and 1 overlap but are different categories: both kept
+    assert {0, 1} <= got
+
+
+def _np_roi_align(feat, rois, out, ratio):
+    """Straightforward-loop RoIAlign oracle (aligned=True)."""
+    C, H, W = feat.shape[1], feat.shape[2], feat.shape[3]
+    res = np.zeros((len(rois), C, out, out), np.float32)
+    for r, roi in enumerate(rois):
+        x1, y1, x2, y2 = roi * 1.0
+        x1, y1, x2, y2 = x1 - 0.5, y1 - 0.5, x2 - 0.5, y2 - 0.5
+        rw, rh = max(x2 - x1, 1e-3), max(y2 - y1, 1e-3)
+        for oy in range(out):
+            for ox in range(out):
+                acc = np.zeros(C)
+                for sy in range(ratio):
+                    for sx in range(ratio):
+                        yy = y1 + rh * (oy + (sy + 0.5) / ratio) / out
+                        xx = x1 + rw * (ox + (sx + 0.5) / ratio) / out
+                        y0 = int(np.clip(np.floor(yy), 0, H - 1))
+                        x0 = int(np.clip(np.floor(xx), 0, W - 1))
+                        y1_ = min(y0 + 1, H - 1)
+                        x1_ = min(x0 + 1, W - 1)
+                        wy = np.clip(yy, 0, H - 1) - y0
+                        wx = np.clip(xx, 0, W - 1) - x0
+                        acc += ((1 - wy) * (1 - wx) * feat[0, :, y0, x0]
+                                + (1 - wy) * wx * feat[0, :, y0, x1_]
+                                + wy * (1 - wx) * feat[0, :, y1_, x0]
+                                + wy * wx * feat[0, :, y1_, x1_])
+                res[r, :, oy, ox] = acc / (ratio * ratio)
+    return res
+
+
+def test_roi_align_matches_numpy_oracle():
+    rng = np.random.RandomState(0)
+    feat = rng.randn(1, 3, 16, 16).astype(np.float32)
+    rois = np.array([[2.0, 2.0, 10.0, 10.0], [0.0, 0.0, 16.0, 16.0]],
+                    np.float32)
+    got = np.asarray(V.roi_align(_t(feat), _t(rois),
+                                 _t(np.array([2], np.int64)),
+                                 output_size=4, sampling_ratio=2).data)
+    want = _np_roi_align(feat, rois, 4, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    feat = _t(np.random.RandomState(1).randn(1, 2, 8, 8)
+              .astype(np.float32))
+    feat.stop_gradient = False
+    rois = _t(np.array([[1.0, 1.0, 6.0, 6.0]], np.float32))
+    out = V.roi_align(feat, rois, _t(np.array([1], np.int64)), 2)
+    pt.ops.sum(out).backward()
+    assert feat.grad is not None
+    assert float(np.abs(np.asarray(feat.grad.data)).sum()) > 0
+
+
+def test_roi_pool_shape():
+    feat = _t(np.random.RandomState(2).randn(2, 3, 12, 12)
+              .astype(np.float32))
+    rois = _t(np.array([[0, 0, 6, 6], [2, 2, 10, 10], [0, 0, 12, 12]],
+                       np.float32))
+    out = V.roi_pool(feat, rois, _t(np.array([2, 1], np.int64)), (3, 3))
+    assert list(out.shape) == [3, 3, 3, 3]
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    var = np.full((2, 4), 0.1, np.float32)
+    targets = np.array([[1, 1, 9, 9], [6, 4, 16, 18]], np.float32)
+    enc = V.box_coder(_t(priors), _t(var), _t(targets),
+                      code_type="encode_center_size")
+    dec = V.box_coder(_t(priors), _t(var), enc,
+                      code_type="decode_center_size")
+    np.testing.assert_allclose(np.asarray(dec.data), targets, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_top_level_summary_and_flops():
+    import paddle_tpu.nn as nn
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    info = pt.summary(net, (1, 8))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+    f = pt.flops(net, (1, 8))
+    # 2 MACs per weight element, batch 1
+    assert f >= 2 * (8 * 16 + 16 * 4)
+
+
+def test_fused_ec_moe_with_gate_uses_it():
+    from paddle_tpu.incubate.nn import FusedEcMoe
+    pt.seed(5)
+    moe = FusedEcMoe(8, 16, num_experts=4)
+    x = _t(np.random.RandomState(5).randn(2, 3, 8).astype(np.float32))
+    # one-hot gate on expert 0 vs expert 1 must give different outputs
+    g0 = np.full((2, 3, 4), -1e9, np.float32); g0[..., 0] = 0
+    g1 = np.full((2, 3, 4), -1e9, np.float32); g1[..., 1] = 0
+    o0 = np.asarray(moe(x, _t(g0)).data)
+    o1 = np.asarray(moe(x, _t(g1)).data)
+    assert np.abs(o0 - o1).max() > 1e-4
+    # gate gradients flow
+    gt = _t(g0); gt.stop_gradient = False
+    out = moe(x, gt)
+    pt.ops.sum(out).backward()
+    assert gt.grad is not None
+
+
+def test_box_coder_rejects_bad_code_type():
+    with pytest.raises(ValueError, match="code_type"):
+        V.box_coder(_t(BOXES[:2]), None, _t(BOXES[:2]),
+                    code_type="encode_center")
