@@ -67,12 +67,24 @@ from paddle_tpu import reader  # noqa: F401
 from paddle_tpu import sysconfig  # noqa: F401
 from paddle_tpu import version  # noqa: F401
 from paddle_tpu.batch import batch  # noqa: F401
+from paddle_tpu import linalg  # noqa: F401
+from paddle_tpu import signal  # noqa: F401
+
+bool = bool_  # paddle.bool
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
 
 
 def summary(net, input_size=None, dtypes=None, input=None):
-    """paddle.summary parity (reference: hapi/model_summary.py) — layer
-    table + parameter counts."""
+    """paddle.summary parity (reference: hapi/model_summary.py) — module
+    tree + parameter counts. ``input`` (a Tensor/array) may replace
+    ``input_size``; ``dtypes`` is accepted for signature parity (the
+    count does not depend on dtype)."""
     from paddle_tpu.hapi import Model
+    if input_size is None and input is not None:
+        input_size = tuple(input.shape)
     return Model(net).summary(input_size)
 
 
@@ -80,10 +92,10 @@ def flops(net, input_size, custom_ops=None, print_detail: bool = False):
     """paddle.flops parity (reference: hapi/dynamic_flops.py) — here the
     count comes from XLA's own cost analysis of the compiled forward (the
     TPU-native flops oracle) instead of per-layer hooks."""
-    import numpy as np
+    import numpy as _np
     from paddle_tpu.distributed.auto_parallel import CostEstimator
 
-    x = np.zeros(input_size, np.float32)
+    x = _np.zeros(input_size, _np.float32)
 
     def fwd(arr):
         out = net(Tensor(arr))
@@ -94,14 +106,6 @@ def flops(net, input_size, custom_ops=None, print_detail: bool = False):
     if print_detail:
         print(f"FLOPs: {r['flops']:.3e}  bytes: {r['bytes_accessed']:.3e}")
     return int(r["flops"])
-from paddle_tpu import linalg  # noqa: F401
-from paddle_tpu import signal  # noqa: F401
-
-bool = bool_  # paddle.bool
-
-
-def is_compiled_with_tpu() -> bool:
-    return True
 
 
 _mode = {"dynamic": True}

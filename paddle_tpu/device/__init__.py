@@ -17,7 +17,8 @@ __all__ = ["set_device", "get_device", "get_all_custom_device_type",
            "is_compiled_with_cuda", "is_compiled_with_rocm",
            "is_compiled_with_xpu", "is_compiled_with_npu",
            "is_compiled_with_custom_device", "device_count", "synchronize",
-           "cuda"]
+           "cuda", "memory_stats", "memory_allocated",
+           "max_memory_allocated"]
 
 _state = {"device": None}
 
@@ -65,6 +66,32 @@ def synchronize(device: Optional[str] = None):
     computation."""
     import jax
     jax.block_until_ready(jax.numpy.zeros(()))
+
+
+def memory_stats(device: Optional[str] = None) -> dict:
+    """Per-device memory statistics from the PJRT runtime (the TPU analog
+    of the reference's allocator stats, ``fluid/memory/``; keys follow
+    jax's ``device.memory_stats()``: bytes_in_use, peak_bytes_in_use,
+    bytes_limit...). Empty dict when the backend doesn't report."""
+    devs = _devices()
+    idx = 0
+    if device and ":" in str(device):
+        idx = int(str(device).rsplit(":", 1)[1])
+    if idx >= len(devs):  # a typo'd device must error, not read as 0
+        raise IndexError(
+            f"device index {idx} out of range ({len(devs)} devices)")
+    try:
+        return dict(devs[idx].memory_stats() or {})
+    except (AttributeError, NotImplementedError, RuntimeError):
+        return {}  # backend doesn't report memory stats
+
+
+def memory_allocated(device: Optional[str] = None) -> int:
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device: Optional[str] = None) -> int:
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
 
 
 def is_compiled_with_cuda() -> bool:

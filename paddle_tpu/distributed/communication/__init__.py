@@ -1,0 +1,19 @@
+"""paddle.distributed.communication parity (reference:
+``python/paddle/distributed/communication/`` — the sync collective API
+plus ``stream/`` async variants).
+
+The implementations live in :mod:`paddle_tpu.distributed.collective`
+(GSPMD placements / shard_map collectives); this package is the
+namespace the reference exposes them under, with the ``stream`` module's
+task-object contract."""
+from ..collective import (  # noqa: F401
+    ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    barrier, broadcast, p2p_shift, recv, reduce, reduce_scatter, scatter,
+    send,
+)
+from . import stream  # noqa: F401
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+           "all_to_all", "barrier", "broadcast", "reduce",
+           "reduce_scatter", "scatter", "send", "recv", "p2p_shift",
+           "stream"]

@@ -1,0 +1,55 @@
+"""paddle.distributed.communication.stream parity (reference:
+``python/paddle/distributed/communication/stream/`` — collectives that
+return a task with ``wait()``, optionally on the calc stream).
+
+TPU mapping: XLA owns scheduling, so a collective issued inside a
+compiled program is already asynchronous with respect to the host; the
+task object exists for API parity and ``wait()`` blocks on the result
+buffer (``use_calc_stream=True`` waits immediately, matching the
+reference's synchronous-on-calc-stream semantics)."""
+from __future__ import annotations
+
+from .. import collective as C
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "all_to_all", "reduce", "scatter", "send", "recv"]
+
+
+class _Task:
+    def __init__(self, result):
+        self._result = result
+
+    def wait(self):
+        import jax
+        r = self._result
+        if r is not None and hasattr(r, "data"):
+            jax.block_until_ready(r.data)
+        return self._result
+
+    def is_completed(self) -> bool:
+        return True
+
+
+def _wrap(fn):
+    def stream_variant(*args, sync_op=True, use_calc_stream=False,
+                       **kwargs):
+        out = fn(*args, **kwargs)
+        task = _Task(out)
+        if use_calc_stream:
+            task.wait()
+        return task
+    stream_variant.__name__ = fn.__name__
+    stream_variant.__doc__ = (f"stream.{fn.__name__}: returns a task with "
+                              "wait() (reference stream API)")
+    return stream_variant
+
+
+all_reduce = _wrap(C.all_reduce)
+all_gather = _wrap(C.all_gather)
+reduce_scatter = _wrap(C.reduce_scatter)
+broadcast = _wrap(C.broadcast)
+all_to_all = _wrap(C.all_to_all)
+reduce = _wrap(C.reduce)
+scatter = _wrap(C.scatter)
+send = _wrap(C.send)
+recv = _wrap(C.recv)

@@ -14,7 +14,7 @@ import numpy as np
 
 from paddle_tpu.io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "FakeData", "Cifar10", "Cifar100"]
 
 
 class FakeData(Dataset):
@@ -112,3 +112,59 @@ class FashionMNIST(MNIST):
     """Same idx file format as MNIST but a distinct cache directory, so a
     default-root FashionMNIST() can never silently pick up MNIST digits."""
     _cache_name = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the local ``cifar-10-python.tar.gz`` archive
+    (reference file-format parity: ``python/paddle/vision/datasets/
+    cifar.py`` — pickle batches of 10000x3072 uint8 rows)."""
+
+    _mode_files = {"train": [f"data_batch_{i}" for i in range(1, 6)],
+                   "test": ["test_batch"]}
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        import pickle
+        import tarfile
+        if mode not in self._mode_files:
+            raise ValueError(
+                f"mode must be one of {sorted(self._mode_files)}, "
+                f"got '{mode}'")
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{type(self).__name__}: automatic download is unavailable "
+                "in this build (no network egress); pass data_file= "
+                "pointing at the local cifar python tar archive")
+        self.transform = transform
+        images, labels = [], []
+        wanted = self._mode_files[mode]
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in wanted:
+                    d = pickle.loads(tf.extractfile(member).read(),
+                                     encoding="bytes")
+                    images.append(np.asarray(d[b"data"], np.uint8))
+                    labels.extend(d[self._label_key])
+        if not images:
+            raise ValueError(
+                f"no {mode} batches ({wanted}) found in {data_file}")
+        self.data = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    """CIFAR-100 (fine labels) from ``cifar-100-python.tar.gz``."""
+
+    _mode_files = {"train": ["train"], "test": ["test"]}
+    _label_key = b"fine_labels"

@@ -77,6 +77,18 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None,
     else:
         st = scores if isinstance(scores, Tensor) \
             else Tensor(jnp.asarray(scores))
+    keep_map = None
+    if category_idxs is not None and categories is not None:
+        # reference semantics: only boxes whose category is listed
+        # participate; others are excluded from the output entirely
+        cat_np = np.asarray(category_idxs.data
+                            if isinstance(category_idxs, Tensor)
+                            else category_idxs)
+        sel = np.isin(cat_np, np.asarray(categories))
+        keep_map = np.where(sel)[0]
+        bt = Tensor(bt.data[keep_map])
+        st = Tensor(st.data[keep_map])
+        category_idxs = Tensor(jnp.asarray(cat_np[keep_map]))
     if category_idxs is not None:
         # batched NMS: offset boxes per category so cross-category boxes
         # never overlap (the reference applies NMS per category)
@@ -90,6 +102,8 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None,
     order_np = np.asarray(order.data)
     keep_np = np.asarray(keep.data)
     kept = order_np[np.where(keep_np)[0]]
+    if keep_map is not None:
+        kept = keep_map[kept]  # back to original box indices
     if top_k is not None:
         kept = kept[:top_k]
     return Tensor(jnp.asarray(kept.astype(np.int64)))
@@ -179,14 +193,17 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
              name=None):
-    """RoIPool = max instead of average, no subsampling (reference:
-    vision/ops.py roi_pool). Implemented as roi_align with dense sampling
-    + max reduction over each cell's samples."""
+    """RoIPool (reference: vision/ops.py roi_pool; kernel
+    ``phi/kernels/cpu/roi_pool_kernel.cc``): hard max over EVERY pixel in
+    each output cell (cell p-range: [floor(start), ceil(end))).
+
+    Expressed as two masked max-reductions (rows then columns) so cells of
+    any size reduce over all their pixels with static shapes.
+    """
     if isinstance(output_size, int):
         out_h = out_w = output_size
     else:
         out_h, out_w = output_size
-    ratio = 2
     bn = boxes_num.data if isinstance(boxes_num, Tensor) \
         else jnp.asarray(boxes_num)
     batch_of_roi = jnp.repeat(jnp.arange(bn.shape[0]), bn,
@@ -194,25 +211,40 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
 
     def f(feat, rois):
         H, W = feat.shape[2], feat.shape[3]
-        x1 = rois[:, 0] * spatial_scale
-        y1 = rois[:, 1] * spatial_scale
-        x2 = rois[:, 2] * spatial_scale
-        y2 = rois[:, 3] * spatial_scale
-        rw = jnp.maximum(x2 - x1, 1.0)
-        rh = jnp.maximum(y2 - y1, 1.0)
-        gy = (jnp.arange(out_h * ratio) + 0.5) / ratio
-        gx = (jnp.arange(out_w * ratio) + 0.5) / ratio
-        ys = y1[:, None] + rh[:, None] * gy[None, :] / out_h
-        xs = x1[:, None] + rw[:, None] * gx[None, :] / out_w
+        x1 = jnp.round(rois[:, 0] * spatial_scale)
+        y1 = jnp.round(rois[:, 1] * spatial_scale)
+        x2 = jnp.round(rois[:, 2] * spatial_scale)
+        y2 = jnp.round(rois[:, 3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        neg = jnp.array(-jnp.inf, feat.dtype)
+
+        def cell_mask(starts, spans, n_cells, size):
+            # mask[cell, pixel] — pixel within [floor(start), ceil(end))
+            cells = jnp.arange(n_cells, dtype=jnp.float32)
+            lo = jnp.floor(starts[:, None] + spans[:, None] * cells[None]
+                           / n_cells)                      # [R, cells]
+            hi = jnp.ceil(starts[:, None] + spans[:, None]
+                          * (cells[None] + 1) / n_cells)
+            lo = jnp.clip(lo, 0, size)
+            hi = jnp.clip(jnp.maximum(hi, lo + 1), 0, size)
+            p = jnp.arange(size, dtype=jnp.float32)
+            return (p[None, None, :] >= lo[..., None]) & \
+                (p[None, None, :] < hi[..., None])  # [R, cells, size]
+
+        row_m = cell_mask(y1, rh, out_h, H)  # [R, out_h, H]
+        col_m = cell_mask(x1, rw, out_w, W)  # [R, out_w, W]
 
         def per_roi(r):
-            img = feat[batch_of_roi[r]]
-            yi = jnp.clip(jnp.round(ys[r]), 0, H - 1).astype(jnp.int32)
-            xi = jnp.clip(jnp.round(xs[r]), 0, W - 1).astype(jnp.int32)
-            s = img[:, yi][:, :, xi]
-            C = s.shape[0]
-            s = s.reshape(C, out_h, ratio, out_w, ratio)
-            return s.max(axis=(2, 4))
+            img = feat[batch_of_roi[r]]  # [C, H, W]
+            # max over masked columns, then masked rows
+            tmp = jnp.max(jnp.where(col_m[r][None, None, :, :],
+                                    img[:, :, None, :], neg), axis=-1)
+            # tmp: [C, H, out_w]
+            out = jnp.max(jnp.where(row_m[r][None, :, :, None],
+                                    tmp[:, None, :, :], neg), axis=2)
+            # out: [C, out_h, out_w]; empty cells (fully clipped) -> 0
+            return jnp.where(jnp.isfinite(out), out, 0.0)
         return jax.vmap(per_roi)(jnp.arange(rois.shape[0]))
 
     return apply_op(f, x, boxes, op_name="roi_pool")
@@ -222,39 +254,55 @@ def box_coder(prior_box, prior_box_var, target_box,
               code_type: str = "encode_center_size",
               box_normalized: bool = True, axis: int = 0, name=None):
     """Encode/decode boxes against priors (reference: vision/ops.py
-    box_coder / phi box_coder kernel, SSD-style)."""
-    def enc(pb, pbv, tb):
-        norm = 0.0 if box_normalized else 1.0
-        pw = pb[:, 2] - pb[:, 0] + norm
-        ph = pb[:, 3] - pb[:, 1] + norm
-        pcx = (pb[:, 0] + pb[:, 2]) / 2
-        pcy = (pb[:, 1] + pb[:, 3]) / 2
-        tw = tb[:, 2] - tb[:, 0] + norm
-        th = tb[:, 3] - tb[:, 1] + norm
-        tcx = (tb[:, 0] + tb[:, 2]) / 2
-        tcy = (tb[:, 1] + tb[:, 3]) / 2
-        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
-                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
-        return out / pbv if pbv is not None else out
+    box_coder / phi box_coder kernel, SSD-style).
 
-    def dec(pb, pbv, tb):
-        norm = 0.0 if box_normalized else 1.0
-        pw = pb[:, 2] - pb[:, 0] + norm
-        ph = pb[:, 3] - pb[:, 1] + norm
-        pcx = (pb[:, 0] + pb[:, 2]) / 2
-        pcy = (pb[:, 1] + pb[:, 3]) / 2
-        t = tb * pbv if pbv is not None else tb
-        cx = t[:, 0] * pw + pcx
-        cy = t[:, 1] * ph + pcy
-        w = jnp.exp(t[:, 2]) * pw
-        h = jnp.exp(t[:, 3]) * ph
-        return jnp.stack([cx - w / 2, cy - h / 2,
-                          cx + w / 2 - norm, cy + h / 2 - norm], axis=1)
-
+    encode: target [N, 4] x prior [M, 4] -> [N, M, 4] (all pairs).
+    decode: target [N, M, 4] (or [N, 4]), prior broadcast along ``axis``
+    (0: prior indexed by M; 1: prior indexed by N) -> same shape as
+    target.
+    """
     if code_type not in ("encode_center_size", "decode_center_size"):
         raise ValueError(
             f"unknown code_type '{code_type}'; expected "
             "'encode_center_size' or 'decode_center_size'")
+    if axis not in (0, 1):
+        raise ValueError(f"axis must be 0 or 1, got {axis}")
+    norm = 0.0 if box_normalized else 1.0
+
+    def prior_parts(pb):
+        pw = pb[..., 2] - pb[..., 0] + norm
+        ph = pb[..., 3] - pb[..., 1] + norm
+        pcx = (pb[..., 0] + pb[..., 2]) / 2
+        pcy = (pb[..., 1] + pb[..., 3]) / 2
+        return pw, ph, pcx, pcy
+
+    def enc(pb, pbv, tb):
+        pw, ph, pcx, pcy = prior_parts(pb)          # [M]
+        tw = (tb[:, 2] - tb[:, 0] + norm)[:, None]  # [N, 1]
+        th = (tb[:, 3] - tb[:, 1] + norm)[:, None]
+        tcx = ((tb[:, 0] + tb[:, 2]) / 2)[:, None]
+        tcy = ((tb[:, 1] + tb[:, 3]) / 2)[:, None]
+        out = jnp.stack([(tcx - pcx[None]) / pw[None],
+                         (tcy - pcy[None]) / ph[None],
+                         jnp.log(tw / pw[None]),
+                         jnp.log(th / ph[None])], axis=-1)  # [N, M, 4]
+        return out / pbv if pbv is not None else out
+
+    def dec(pb, pbv, tb):
+        pw, ph, pcx, pcy = prior_parts(pb)
+        if tb.ndim == 3:
+            # broadcast the prior over the non-``axis`` dim
+            expand = (lambda a: a[None, :]) if axis == 0 \
+                else (lambda a: a[:, None])
+            pw, ph, pcx, pcy = map(expand, (pw, ph, pcx, pcy))
+        t = tb * pbv if pbv is not None else tb
+        cx = t[..., 0] * pw + pcx
+        cy = t[..., 1] * ph + pcy
+        w = jnp.exp(t[..., 2]) * pw
+        h = jnp.exp(t[..., 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], axis=-1)
+
     fn = enc if code_type == "encode_center_size" else dec
     if prior_box_var is None:
         return apply_op(lambda pb, tb: fn(pb, None, tb), prior_box,

@@ -230,3 +230,27 @@ class TestDataParallelTraining:
         np.testing.assert_allclose(
             np.asarray(p.data.addressable_shards[0].data),
             np.asarray(p.data.addressable_shards[1].data))
+
+
+def test_communication_namespace_and_stream():
+    """paddle.distributed.communication + .stream task contract."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import communication as comm
+
+    x = pt.to_tensor(np.ones(4, np.float32))
+    out = comm.all_reduce(x)  # single-process: identity
+    task = comm.stream.all_reduce(pt.to_tensor(np.ones(4, np.float32)))
+    assert task.is_completed() in (True,)
+    task.wait()
+    t2 = comm.stream.broadcast(pt.to_tensor(np.ones(2, np.float32)),
+                               src=0, use_calc_stream=True)
+    assert t2.is_completed()
+
+
+def test_device_memory_stats_surface():
+    import paddle_tpu as pt
+    stats = pt.device.memory_stats()
+    assert isinstance(stats, dict)
+    assert pt.device.memory_allocated() >= 0
+    assert pt.device.max_memory_allocated() >= 0

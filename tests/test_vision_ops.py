@@ -150,11 +150,13 @@ def test_box_coder_roundtrip():
     var = np.full((2, 4), 0.1, np.float32)
     targets = np.array([[1, 1, 9, 9], [6, 4, 16, 18]], np.float32)
     enc = V.box_coder(_t(priors), _t(var), _t(targets),
-                      code_type="encode_center_size")
-    dec = V.box_coder(_t(priors), _t(var), enc,
-                      code_type="decode_center_size")
-    np.testing.assert_allclose(np.asarray(dec.data), targets, rtol=1e-4,
-                               atol=1e-4)
+                      code_type="encode_center_size")  # [N, M, 4]
+    dec = np.asarray(V.box_coder(_t(priors), _t(var), enc,
+                                 code_type="decode_center_size",
+                                 axis=0).data)
+    for i in range(2):  # decode against the same prior inverts encode
+        np.testing.assert_allclose(dec[i, :], np.tile(targets[i], (2, 1)),
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_top_level_summary_and_flops():
@@ -189,3 +191,54 @@ def test_box_coder_rejects_bad_code_type():
     with pytest.raises(ValueError, match="code_type"):
         V.box_coder(_t(BOXES[:2]), None, _t(BOXES[:2]),
                     code_type="encode_center")
+
+
+def test_roi_pool_true_cell_max():
+    """Regression: every pixel in a cell participates in the max (the
+    2x2-sample shortcut missed corner pixels)."""
+    feat = np.zeros((1, 1, 12, 12), np.float32)
+    feat[0, 0, 0, 0] = 100.0
+    rois = np.array([[0, 0, 12, 12]], np.float32)
+    out = np.asarray(V.roi_pool(_t(feat), _t(rois),
+                                _t(np.array([1], np.int64)), 3).data)
+    assert out[0, 0, 0, 0] == 100.0
+    # and a dense random case vs a numpy loop oracle
+    rng = np.random.RandomState(3)
+    f2 = rng.randn(1, 2, 12, 12).astype(np.float32)
+    out2 = np.asarray(V.roi_pool(_t(f2), _t(rois),
+                                 _t(np.array([1], np.int64)), 3).data)
+    for oy in range(3):
+        for ox in range(3):
+            ys = slice(int(np.floor(oy * 13 / 3)),
+                       int(np.ceil((oy + 1) * 13 / 3)))
+            xs = slice(int(np.floor(ox * 13 / 3)),
+                       int(np.ceil((ox + 1) * 13 / 3)))
+            want = f2[0, :, :12, :12][:, ys, xs].reshape(2, -1).max(1)
+            np.testing.assert_allclose(out2[0, :, oy, ox], want, rtol=1e-5)
+
+
+def test_nms_categories_filters_unlisted():
+    cats = np.array([0, 1, 0, 1, 2], np.int64)
+    got = np.asarray(V.nms(_t(BOXES), 0.5, _t(SCORES),
+                           category_idxs=_t(cats),
+                           categories=[0, 1]).data)
+    assert 4 not in got  # category 2 excluded entirely
+    assert {0, 1} <= set(got.tolist())
+
+
+def test_box_coder_encode_all_pairs_and_axis_decode():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+    targets = np.array([[1, 1, 9, 9], [6, 4, 16, 18], [0, 0, 4, 4]],
+                       np.float32)
+    enc = np.asarray(V.box_coder(_t(priors), None, _t(targets)).data)
+    assert enc.shape == (3, 2, 4)  # all pairs
+    dec = np.asarray(V.box_coder(_t(priors), None, _t(enc),
+                                 code_type="decode_center_size",
+                                 axis=0).data)
+    assert dec.shape == (3, 2, 4)
+    for i in range(3):
+        for m in range(2):
+            np.testing.assert_allclose(dec[i, m], targets[i], rtol=1e-4,
+                                       atol=1e-4)
+    with pytest.raises(ValueError, match="axis"):
+        V.box_coder(_t(priors), None, _t(targets), axis=2)
