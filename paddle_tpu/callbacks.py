@@ -1,8 +1,8 @@
 """paddle.callbacks parity (reference: ``python/paddle/callbacks.py`` —
 re-export of the hapi callback set)."""
 from paddle_tpu.hapi.model import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger, VisualDL,
 )
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping", "VisualDL",
            "LRScheduler"]

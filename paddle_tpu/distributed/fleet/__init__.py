@@ -88,8 +88,15 @@ def get_hybrid_communicate_group() -> HybridCommunicateGroup:
 def distributed_model(model):
     """Reference: model.py:30 — wrap by mode. DP wrapping covers the pure
     data-parallel case; TP/PP models are built from mpu/pipeline layers and
-    pass through (their parallelism already lives in the shardings)."""
+    pass through (their parallelism already lives in the shardings).
+    ``strategy.recompute`` is honored for models that expose a
+    ``cfg.recompute`` switch (the zoo models do)."""
     hcg = get_hybrid_communicate_group()
+    strategy = _state.get("strategy")
+    if strategy is not None and strategy.recompute:
+        cfg = getattr(model, "cfg", None)
+        if cfg is not None and hasattr(cfg, "recompute"):
+            cfg.recompute = True
     if hcg.get_data_parallel_world_size() > 1 and \
             hcg.get_model_parallel_world_size() == 1 and \
             hcg.get_pipe_parallel_world_size() == 1:

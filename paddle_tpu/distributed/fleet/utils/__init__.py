@@ -23,8 +23,11 @@ import jax
 from paddle_tpu.core import autograd as _ag
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer_base import Layer
+from . import fs  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
 
-__all__ = ["recompute", "recompute_sequential"]
+__all__ = ["recompute", "recompute_sequential", "LocalFS", "HDFSClient",
+           "fs"]
 
 
 def _owning_layer(function) -> Layer | None:

@@ -1,0 +1,122 @@
+"""Filesystem abstraction for checkpoint storage (reference:
+``python/paddle/distributed/fleet/utils/fs.py`` — FS base + LocalFS +
+HDFSClient used by save_persistables/auto-checkpoint).
+
+LocalFS is fully functional; HDFSClient keeps the surface and raises on
+use (no hadoop client in this build) so recipe code fails with a clear
+message at the call site rather than an AttributeError.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Reference: fs.py LocalFS."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_dir(self, fs_path) -> bool:
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path) -> bool:
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path) -> bool:
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+        elif self.is_file(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not overwrite and os.path.exists(dst_path):
+            raise FileExistsError(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path) -> List[str]:
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """Surface parity only (reference: fs.py HDFSClient, a hadoop-cli
+    wrapper). No hadoop client exists in this build: every method raises
+    with guidance to use LocalFS or a mounted path. Deliberately NOT an
+    FS subclass — the base's NotImplementedError defaults would shadow
+    the helpful message."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        self._err = RuntimeError(
+            "HDFSClient requires a hadoop client, which this build does "
+            "not ship; mount the storage and use LocalFS instead")
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def stub(*a, **k):
+            raise self._err
+        return stub

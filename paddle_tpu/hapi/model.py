@@ -106,6 +106,54 @@ class EarlyStopping(Callback):
                 self.model._stop_training = True
 
 
+class VisualDL(Callback):
+    """Scalar logger callback (reference: hapi/callbacks.py VisualDL).
+
+    The visualdl package is not available in this build, so scalars are
+    written as JSON lines (`{"step", "epoch", "tag", "value"}` per line)
+    under ``log_dir`` — trivially parseable and plottable."""
+
+    def __init__(self, log_dir: str = "./vdl_log"):
+        import os
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._path = None
+        self._step = 0
+        self._epoch = 0
+
+    def _file(self):
+        if self._path is None:
+            import os
+            import time
+            self._path = os.path.join(
+                self.log_dir, f"scalars_{int(time.time())}.jsonl")
+        return self._path
+
+    def _write(self, tag, value):
+        import json
+        with open(self._file(), "a") as f:
+            f.write(json.dumps({"step": self._step, "epoch": self._epoch,
+                                "tag": tag, "value": float(value)}) + "\n")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"train/{k}", v)
+            except (TypeError, ValueError):
+                pass  # non-scalar log entries are skipped
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"eval/{k}", v)
+            except (TypeError, ValueError):
+                pass
+
+
 class LRScheduler(Callback):
     def __init__(self, by_step=True, by_epoch=False):
         self.by_step = by_step

@@ -4,5 +4,7 @@ sparsity) and functional/forward-mode autodiff (``incubate.autograd``)."""
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import checkpoint  # noqa: F401
 
-__all__ = ["asp", "autograd", "nn"]
+__all__ = ["asp", "autograd", "nn", "optimizer", "checkpoint"]

@@ -1,0 +1,93 @@
+"""Auto-checkpoint: epoch-scoped snapshot/resume (reference:
+``python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:72``
+AutoCheckpointChecker + ``train_epoch_range`` — SURVEY.md §5 "snapshots
+exe scope ... and resumes by epoch id, keyed by job env").
+
+TPU-native shape: instead of snapshotting an executor scope, the range
+object holds (model, optimizer) references and pickles their state_dicts
+through ``paddle.save`` — the same artifact format as manual
+checkpointing, so resumes are inspectable.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterator, Optional
+
+__all__ = ["TrainEpochRange", "train_epoch_range"]
+
+
+class TrainEpochRange:
+    """Iterate epochs with automatic snapshot + resume.
+
+    >>> r = TrainEpochRange(10, "ckpt_dir", model=m, optimizer=opt)
+    >>> for epoch in r:            # resumes after the last saved epoch
+    ...     train_one_epoch()
+    ...     # snapshot happens automatically at the end of each epoch
+    """
+
+    def __init__(self, max_epoch_num: int, save_dir: Optional[str] = None,
+                 model=None, optimizer=None, save_checkpoint_inter: int = 1,
+                 name: Optional[str] = None):
+        self.max_epoch_num = int(max_epoch_num)
+        self.save_dir = save_dir or os.environ.get(
+            "PADDLE_CHECKPOINT_DIR", "./paddle_tpu_auto_ckpt")
+        job = name or os.environ.get("PADDLE_JOB_ID", "default")
+        self._dir = os.path.join(self.save_dir, job)
+        self.model = model
+        self.optimizer = optimizer
+        self.inter = max(int(save_checkpoint_inter), 1)
+        self._meta = os.path.join(self._dir, "meta.json")
+        self.restored_from = self._load_meta()
+
+    # -- persistence ---------------------------------------------------------
+    def _load_meta(self) -> int:
+        """Returns the next epoch to run (0 if no checkpoint)."""
+        if not os.path.exists(self._meta):
+            return 0
+        with open(self._meta) as f:
+            meta = json.load(f)
+        epoch = int(meta.get("epoch", -1)) + 1
+        import paddle_tpu as pt
+        if self.model is not None:
+            path = os.path.join(self._dir, "model.pdparams")
+            if os.path.exists(path):
+                self.model.set_state_dict(pt.load(path))
+        if self.optimizer is not None:
+            path = os.path.join(self._dir, "opt.pdopt")
+            if os.path.exists(path) and hasattr(self.optimizer,
+                                                "set_state_dict"):
+                self.optimizer.set_state_dict(pt.load(path))
+        return epoch
+
+    def _save(self, epoch: int):
+        import paddle_tpu as pt
+        os.makedirs(self._dir, exist_ok=True)
+        if self.model is not None:
+            pt.save(self.model.state_dict(),
+                    os.path.join(self._dir, "model.pdparams"))
+        if self.optimizer is not None and hasattr(self.optimizer,
+                                                  "state_dict"):
+            pt.save(self.optimizer.state_dict(),
+                    os.path.join(self._dir, "opt.pdopt"))
+        tmp = self._meta + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch,
+                       "max_epoch_num": self.max_epoch_num}, f)
+        os.replace(tmp, self._meta)  # atomic: a crash never corrupts meta
+
+    # -- iteration -----------------------------------------------------------
+    def __iter__(self) -> Iterator[int]:
+        for epoch in range(self.restored_from, self.max_epoch_num):
+            yield epoch
+            if (epoch + 1) % self.inter == 0 or \
+                    epoch == self.max_epoch_num - 1:
+                self._save(epoch)
+
+
+def train_epoch_range(max_epoch_num: int, save_checkpoint_inter: int = 1,
+                      **kwargs) -> TrainEpochRange:
+    """Reference surface: ``acp.train_epoch_range(n)``."""
+    return TrainEpochRange(max_epoch_num,
+                           save_checkpoint_inter=save_checkpoint_inter,
+                           **kwargs)

@@ -1,0 +1,157 @@
+"""paddle.incubate.optimizer parity — LookAhead, ModelAverage, and the
+ExponentialMovingAverage helper (reference:
+``python/paddle/incubate/optimizer/lookahead.py``, ``modelaverage.py``;
+EMA lives in ``paddle/fluid/optimizer.py`` ExponentialMovingAverage).
+
+All three are parameter-space wrappers: they keep shadow copies as host
+jax arrays and swap them into the live parameters — no optimizer-rule
+changes, so they compose with any inner optimizer (including inside a
+compiled TrainStep, where only the post-step host update differs).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage"]
+
+
+class LookAhead:
+    """k steps forward, one step back (reference: lookahead.py LookAhead).
+
+    Wraps an inner optimizer: every ``k`` fast steps the slow weights
+    move ``alpha`` toward the fast weights and the fast weights reset to
+    the slow ones.
+    """
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if k < 1:
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow: Dict[int, jnp.ndarray] = {
+            id(p): p.data for p in inner_optimizer._parameter_list}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k == 0:
+            for p in self._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p.data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow  # fast weights reset to the slow ones
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    def state_dict(self):
+        sd = {"inner": self.inner_optimizer.state_dict(),
+              "step": self._step,
+              "slow": {i: s for i, (pid, s) in
+                       enumerate(self._slow.items())}}
+        return sd
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd["inner"])
+        self._step = sd["step"]
+        for i, p in enumerate(self._parameter_list):
+            if i in sd["slow"]:
+                self._slow[id(p)] = jnp.asarray(sd["slow"][i])
+
+
+class _ShadowAverager:
+    """Shared mechanics: maintain averaged params + apply()/restore()."""
+
+    def __init__(self, parameters):
+        self._params = list(parameters)
+        self._shadow: Dict[int, jnp.ndarray] = {
+            id(p): p.data for p in self._params}
+        self._backup: Optional[Dict[int, jnp.ndarray]] = None
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap the averaged weights in (reference ModelAverage.apply is
+        a context manager in dygraph)."""
+        self._backup = {id(p): p.data for p in self._params}
+        for p in self._params:
+            p._data = self._shadow[id(p)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._params:
+                p._data = self._backup[id(p)]
+            self._backup = None
+
+
+class ModelAverage(_ShadowAverager):
+    """Running average of parameter values over training (reference:
+    modelaverage.py ModelAverage — window-accumulated averages; here the
+    numerically-equivalent streaming mean over the window).
+    """
+
+    def __init__(self, average_window_rate: float = 0.15, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        if parameters is None:
+            raise ValueError("parameters is required in dygraph mode")
+        super().__init__(parameters)
+        self.max_average_window = max_average_window
+        self._n = 0
+
+    def step(self):
+        """Accumulate the current parameter values (call after the inner
+        optimizer's step)."""
+        self._n = min(self._n + 1, self.max_average_window)
+        inv = 1.0 / self._n
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = s + (p.data - s) * inv
+
+
+class ExponentialMovingAverage(_ShadowAverager):
+    """EMA of parameters (reference: fluid ExponentialMovingAverage):
+    shadow = decay * shadow + (1 - decay) * param, with optional
+    step-based decay warmup (min(decay, (1+t)/(10+t)))."""
+
+    def __init__(self, parameters, decay: float = 0.999,
+                 thres_steps=None, name=None):
+        # reference default: no warmup unless thres_steps is given
+        # (fluid/optimizer.py:4322)
+        super().__init__(parameters)
+        self.decay = decay
+        self.thres_steps = thres_steps
+        self._t = 0
+
+    def update(self):
+        self._t += 1
+        d = min(self.decay, (1 + self._t) / (10 + self._t)) \
+            if self.thres_steps is not None else self.decay
+        for p in self._params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1.0 - d) * p.data

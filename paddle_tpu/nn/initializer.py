@@ -16,7 +16,7 @@ from paddle_tpu.core.dtype import convert_dtype
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "calculate_gain",
+    "Assign", "calculate_gain", "Orthogonal", "Dirac",
 ]
 
 
@@ -170,3 +170,55 @@ class Assign(Initializer):
         if tuple(arr.shape) != tuple(shape):
             arr = jnp.reshape(arr, tuple(shape))
         return arr
+
+
+class Orthogonal(Initializer):
+    """Orthogonal matrix initializer (reference:
+    ``python/paddle/nn/initializer/orthogonal.py`` — QR of a gaussian,
+    sign-corrected; rows/cols orthonormal up to ``gain``)."""
+
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal initializer needs rank >= 2")
+        # reference flattening (orthogonal.py:95): row = shape[0],
+        # col = prod(shape[1:]) — a conv kernel becomes [out, in*k*k]
+        # with orthonormal output-channel rows
+        rows = int(shape[0])
+        cols = int(np.prod(shape[1:]))
+        flat = (max(rows, cols), min(rows, cols))
+        from paddle_tpu.core.generator import next_key
+        import jax
+        a = jax.random.normal(next_key(), flat, jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))  # unique decomposition
+        if rows < cols:
+            q = q.T
+        out = self.gain * q.reshape(shape)
+        return out.astype(convert_dtype(dtype).np_dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv initializer (reference:
+    ``python/paddle/nn/initializer/dirac.py``): channel i's kernel is a
+    delta at the spatial center, groups supported."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        if len(shape) < 3:
+            raise ValueError("Dirac initializer needs a conv kernel "
+                             "(rank >= 3: [out, in, *spatial])")
+        out_ch, in_ch = shape[0], shape[1]
+        if out_ch % self.groups:
+            raise ValueError("out_channels must be divisible by groups")
+        arr = np.zeros(shape, np.float32)
+        centers = tuple(s // 2 for s in shape[2:])
+        per_group = out_ch // self.groups
+        for g in range(self.groups):
+            for i in range(min(per_group, in_ch)):
+                arr[(g * per_group + i, i) + centers] = 1.0
+        return jnp.asarray(arr, convert_dtype(dtype).np_dtype)

@@ -1,4 +1,11 @@
 """paddle.utils parity (reference: ``python/paddle/utils/``)."""
 from . import cpp_extension  # noqa: F401
+from . import unique_name  # noqa: F401
+from .misc import (  # noqa: F401
+    deprecated, from_dlpack, get_weights_path_from_url, run_check,
+    to_dlpack, try_import,
+)
 
-__all__ = ["cpp_extension"]
+__all__ = ["cpp_extension", "unique_name", "deprecated", "try_import",
+           "to_dlpack", "from_dlpack", "get_weights_path_from_url",
+           "run_check"]

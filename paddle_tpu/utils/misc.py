@@ -1,0 +1,92 @@
+"""paddle.utils small tools: deprecated decorator, try_import, dlpack,
+download surface, run_check (reference: ``python/paddle/utils/``
+``deprecated.py``, ``lazy_import.py``, ``dlpack.py``, ``download.py``,
+``install_check.py``)."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated", "try_import", "to_dlpack", "from_dlpack",
+           "get_weights_path_from_url", "run_check"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Mark an API deprecated (reference: utils/deprecated.py) — warns
+    once per call site; ``level=2`` raises instead."""
+    def decorator(fn):
+        msg = f"API '{fn.__qualname__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use '{update_to}' instead"
+        if reason:
+            msg += f" ({reason})"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        wrapper.__doc__ = f"(deprecated) {fn.__doc__ or ''}"
+        return wrapper
+    return decorator
+
+
+def try_import(module_name: str):
+    """Import or raise with install guidance (reference:
+    utils/lazy_import.py try_import)."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            f"Failed importing {module_name}. This likely means the "
+            f"package is not installed; this build cannot download "
+            f"packages (no network egress).") from None
+
+
+def to_dlpack(x):
+    """Tensor → DLPack capsule (reference: utils/dlpack.py). The jax
+    array itself implements ``__dlpack__``."""
+    from paddle_tpu.core.tensor import Tensor
+    arr = x.data if isinstance(x, Tensor) else x
+    return arr.__dlpack__()
+
+
+def from_dlpack(capsule):
+    """DLPack capsule (or any ``__dlpack__`` object, e.g. a torch CPU
+    tensor) → Tensor."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    return Tensor(jnp.from_dlpack(capsule))
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    raise NotImplementedError(
+        "weight download is unavailable in this build (no network "
+        "egress); place the file locally and load it with paddle.load")
+
+
+def run_check():
+    """Install sanity check (reference: utils/install_check.py
+    paddle.utils.run_check): runs one tiny compiled train step on the
+    available device and reports."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+
+    model = nn.Linear(4, 4)
+    opt = pt.optimizer.SGD(learning_rate=0.1,
+                           parameters=model.parameters())
+    x = pt.to_tensor(np.ones((2, 4), np.float32))
+    loss = pt.ops.mean(pt.ops.square(model(x)))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    dev = pt.get_device()
+    print(f"PaddlePaddle(TPU-native) works on {dev}: one train step OK "
+          f"(loss {float(loss.numpy()):.4f})")
+    return True

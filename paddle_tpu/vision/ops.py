@@ -86,6 +86,8 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None,
                             else category_idxs)
         sel = np.isin(cat_np, np.asarray(categories))
         keep_map = np.where(sel)[0]
+        if len(keep_map) == 0:  # nothing listed: empty result, no reduce
+            return Tensor(jnp.zeros((0,), jnp.int64))
         bt = Tensor(bt.data[keep_map])
         st = Tensor(st.data[keep_map])
         category_idxs = Tensor(jnp.asarray(cat_np[keep_map]))
@@ -291,10 +293,13 @@ def box_coder(prior_box, prior_box_var, target_box,
     def dec(pb, pbv, tb):
         pw, ph, pcx, pcy = prior_parts(pb)
         if tb.ndim == 3:
-            # broadcast the prior over the non-``axis`` dim
+            # broadcast the prior (and its variance) over the
+            # non-``axis`` dim
             expand = (lambda a: a[None, :]) if axis == 0 \
                 else (lambda a: a[:, None])
             pw, ph, pcx, pcy = map(expand, (pw, ph, pcx, pcy))
+            if pbv is not None and pbv.ndim == 2:
+                pbv = pbv[None, :, :] if axis == 0 else pbv[:, None, :]
         t = tb * pbv if pbv is not None else tb
         cx = t[..., 0] * pw + pcx
         cy = t[..., 1] * ph + pcy

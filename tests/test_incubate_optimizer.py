@@ -1,0 +1,91 @@
+"""incubate.optimizer — LookAhead / ModelAverage / EMA."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate.optimizer import (
+    ExponentialMovingAverage, LookAhead, ModelAverage,
+)
+
+
+def _setup(seed=0):
+    pt.seed(seed)
+    m = nn.Linear(4, 4)
+    x = pt.to_tensor(np.random.RandomState(seed).randn(8, 4)
+                     .astype(np.float32))
+    return m, x
+
+
+def test_lookahead_trains_and_syncs_slow_weights():
+    m, x = _setup()
+    inner = pt.optimizer.SGD(learning_rate=0.1,
+                             parameters=m.parameters())
+    la = LookAhead(inner, alpha=0.5, k=2)
+    w0 = np.asarray(m.weight.data).copy()
+    losses = []
+    for _ in range(6):
+        loss = pt.ops.mean(pt.ops.square(m(x)))
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    # after a sync step, fast weights == slow weights
+    assert la._step % la.k == 0
+    np.testing.assert_allclose(np.asarray(m.weight.data),
+                               np.asarray(la._slow[id(m.weight)]),
+                               rtol=1e-6)
+    assert not np.allclose(np.asarray(m.weight.data), w0)
+    with pytest.raises(ValueError):
+        LookAhead(inner, alpha=2.0)
+
+
+def test_lookahead_state_roundtrip():
+    m, x = _setup(1)
+    inner = pt.optimizer.Adam(learning_rate=0.01,
+                              parameters=m.parameters())
+    la = LookAhead(inner, k=3)
+    for _ in range(2):
+        loss = pt.ops.mean(pt.ops.square(m(x)))
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    sd = la.state_dict()
+    la2 = LookAhead(inner, k=3)
+    la2.set_state_dict(sd)
+    assert la2._step == la._step
+
+
+def test_model_average_apply_restore():
+    m, x = _setup(2)
+    opt = pt.optimizer.SGD(learning_rate=0.2, parameters=m.parameters())
+    ma = ModelAverage(parameters=m.parameters())
+    snapshots = []
+    for _ in range(5):
+        loss = pt.ops.mean(pt.ops.square(m(x)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        ma.step()
+        snapshots.append(np.asarray(m.weight.data).copy())
+    live = np.asarray(m.weight.data).copy()
+    with ma.apply():
+        avg = np.asarray(m.weight.data)
+        np.testing.assert_allclose(avg, np.mean(snapshots, axis=0),
+                                   rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(m.weight.data), live, rtol=1e-7)
+
+
+def test_ema_update_and_apply():
+    m, x = _setup(3)
+    ema = ExponentialMovingAverage(m.parameters(), decay=0.5)
+    w0 = np.asarray(m.weight.data).copy()
+    m.weight._data = m.weight.data + 1.0
+    ema.update()
+    with ema.apply():
+        got = np.asarray(m.weight.data)
+        np.testing.assert_allclose(got, 0.5 * w0 + 0.5 * (w0 + 1.0),
+                                   rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(m.weight.data), w0 + 1.0,
+                               rtol=1e-6)

@@ -1,0 +1,108 @@
+"""paddle.utils toolbox + Orthogonal/Dirac initializers."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.utils import unique_name
+
+
+def test_orthogonal_initializer_orthonormal():
+    pt.seed(0)
+    w = np.asarray(I.Orthogonal()( [16, 8]))
+    np.testing.assert_allclose(w.T @ w, np.eye(8), atol=1e-4)
+    w2 = np.asarray(I.Orthogonal(gain=2.0)([8, 16]))
+    np.testing.assert_allclose(w2 @ w2.T, 4 * np.eye(8), atol=1e-3)
+    with pytest.raises(ValueError):
+        I.Orthogonal()([8])
+
+
+def test_dirac_initializer_identity_conv():
+    w = np.asarray(I.Dirac()([4, 4, 3, 3]))
+    # conv with this kernel is identity on 4 channels
+    assert w.shape == (4, 4, 3, 3)
+    for i in range(4):
+        assert w[i, i, 1, 1] == 1.0
+    assert w.sum() == 4.0
+    # groups
+    wg = np.asarray(I.Dirac(groups=2)([4, 2, 3]))
+    assert wg[0, 0, 1] == 1.0 and wg[2, 0, 1] == 1.0
+    assert wg.sum() == 4.0
+
+
+def test_unique_name_generate_and_guard():
+    a = unique_name.generate("fc")
+    b = unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with unique_name.guard():
+        c = unique_name.generate("fc")
+        assert c == "fc_0"  # fresh scope
+    d = unique_name.generate("fc")
+    assert d != c or d.startswith("fc_")  # outer counter restored
+
+
+def test_deprecated_decorator():
+    @pt.utils.deprecated(update_to="paddle.new_api", since="2.5")
+    def old_api():
+        return 42
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert old_api() == 42
+    assert any("deprecated" in str(w.message) for w in rec)
+
+    @pt.utils.deprecated(level=2)
+    def dead_api():
+        return 1
+
+    with pytest.raises(RuntimeError):
+        dead_api()
+
+
+def test_try_import():
+    assert pt.utils.try_import("json") is not None
+    with pytest.raises(ImportError, match="not installed"):
+        pt.utils.try_import("definitely_not_a_module_xyz")
+
+
+def test_dlpack_roundtrip_with_torch():
+    import torch
+    t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    x = pt.utils.from_dlpack(t)
+    np.testing.assert_allclose(np.asarray(x.data),
+                               t.numpy(), rtol=1e-6)
+    cap = pt.utils.to_dlpack(x)
+    back = torch.from_dlpack(cap)
+    np.testing.assert_allclose(back.numpy(), t.numpy(), rtol=1e-6)
+
+
+def test_download_raises_and_run_check(capsys):
+    with pytest.raises(NotImplementedError):
+        pt.utils.get_weights_path_from_url("http://example.com/w.pdparams")
+    assert pt.utils.run_check()
+    assert "works on" in capsys.readouterr().out
+
+
+def test_local_fs(tmp_path):
+    import os
+    from paddle_tpu.distributed.fleet.utils import LocalFS, HDFSClient
+    fs = LocalFS()
+    d = os.path.join(tmp_path, "ckpt")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = os.path.join(d, "model.pdparams")
+    fs.touch(f)
+    assert fs.is_file(f) and fs.is_exist(f)
+    dirs, files = fs.ls_dir(d)
+    assert files == ["model.pdparams"]
+    f2 = os.path.join(d, "renamed.pdparams")
+    fs.rename(f, f2)
+    assert fs.is_file(f2) and not fs.is_exist(f)
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+    hdfs = HDFSClient()
+    with pytest.raises(RuntimeError, match="hadoop"):
+        hdfs.ls_dir("/remote/path")

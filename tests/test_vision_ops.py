@@ -242,3 +242,18 @@ def test_box_coder_encode_all_pairs_and_axis_decode():
                                        atol=1e-4)
     with pytest.raises(ValueError, match="axis"):
         V.box_coder(_t(priors), None, _t(targets), axis=2)
+
+
+def test_box_coder_axis1_with_var_and_nms_empty_categories():
+    priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [1, 1, 3, 3]],
+                      np.float32)
+    var = np.full((3, 4), 0.2, np.float32)
+    tb = np.random.RandomState(8).rand(3, 2, 4).astype(np.float32)
+    out = V.box_coder(_t(priors), _t(var), _t(tb),
+                      code_type="decode_center_size", axis=1)
+    assert list(out.shape) == [3, 2, 4]
+
+    empty = np.asarray(V.nms(_t(BOXES), 0.5, _t(SCORES),
+                             category_idxs=_t(np.zeros(5, np.int64)),
+                             categories=[7]).data)
+    assert empty.shape == (0,)
