@@ -92,6 +92,8 @@ class LocalFS(FS):
         open(fs_path, "a").close()
 
     def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if test_exists and not os.path.exists(src_path):
+            raise FileNotFoundError(f"mv source does not exist: {src_path}")
         if not overwrite and os.path.exists(dst_path):
             raise FileExistsError(dst_path)
         shutil.move(src_path, dst_path)

@@ -15,7 +15,7 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer_base import Layer
 
-__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
            "EarlyStopping", "LRScheduler"]
 
 

@@ -18,6 +18,7 @@ from .linalg import *  # noqa: F401,F403
 from .array import (  # noqa: F401
     TensorArray, array_length, array_read, array_write, create_array,
 )
+from .extras import *  # noqa: F401,F403
 
 from . import math as _math
 from . import creation as _creation

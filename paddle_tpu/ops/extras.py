@@ -1,0 +1,260 @@
+"""Long-tail tensor ops (reference: ``python/paddle/tensor/{math,
+manipulation,linalg,creation}.py`` — the remaining surface found by the
+coverage probe). One jnp delegate per op, recorded on the tape like every
+other op."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.autograd import apply_op
+from paddle_tpu.core.tensor import Tensor
+from ._registry import op
+
+__all__ = [
+    "kron", "trapezoid", "cumulative_trapezoid", "rad2deg", "deg2rad",
+    "polygamma", "igamma", "igammac", "i0", "i1", "renorm", "floor_mod",
+    "clip_", "label_smooth", "increment", "nanquantile", "digitize",
+    "polar", "matrix_exp", "vander", "householder_product", "pdist",
+    "tensordot", "mm", "trace", "clone", "unstack", "index_fill", "rank",
+    "vsplit", "hsplit", "dsplit", "tensor_split", "binomial",
+]
+
+
+def _d(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+@op
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@op
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@op
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    # cumulative form of the trapezoid rule along axis
+    y1 = jnp.moveaxis(y, axis, -1)
+    if x is not None:
+        if x.ndim > 1:
+            xs = jnp.moveaxis(jnp.broadcast_to(x, y.shape), axis, -1)
+            widths = jnp.diff(xs, axis=-1)
+        else:
+            widths = jnp.diff(x)
+    else:
+        widths = 1.0 if dx is None else dx
+    areas = (y1[..., 1:] + y1[..., :-1]) / 2 * widths
+    return jnp.moveaxis(jnp.cumsum(areas, axis=-1), -1, axis)
+
+
+@op
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@op
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@op
+def polygamma(x, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@op
+def igamma(x, a):
+    # torch/paddle convention: igamma = lower regularized P(x, a),
+    # igammac = upper Q(x, a)
+    return jax.scipy.special.gammainc(x, a)
+
+
+@op
+def igammac(x, a):
+    return jax.scipy.special.gammaincc(x, a)
+
+
+@op
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+@op
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+@op
+def renorm(x, p, axis, max_norm):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.linalg.norm(flat, ord=p, axis=1)
+    scale = jnp.where(norms > max_norm,
+                      max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+@op
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+@op
+def polar(abs, angle):
+    return abs * jnp.exp(1j * angle.astype(jnp.result_type(angle,
+                                                           jnp.complex64)))
+
+
+@op
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@op
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+@op
+def householder_product(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+@op
+def pdist(x, p=2.0):
+    d = x[:, None, :] - x[None, :, :]
+    dm = jnp.linalg.norm(d, ord=p, axis=-1)
+    n = x.shape[0]
+    iu = jnp.triu_indices(n, k=1)
+    return dm[iu]
+
+
+@op
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@op
+def mm(input, mat2):
+    return jnp.matmul(input, mat2)
+
+
+@op
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op
+def clone(x):
+    return x + 0  # new buffer, gradient-transparent
+
+
+@op
+def index_fill(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(value)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def unstack(x, axis=0, num=None):
+    """paddle.unstack: split along axis and squeeze it."""
+    def f(a):
+        return tuple(jnp.squeeze(s, axis)
+                     for s in jnp.split(a, a.shape[axis], axis))
+    out = apply_op(f, x, op_name="unstack")
+    return list(out)
+
+
+def rank(x):
+    return Tensor(jnp.asarray(_d(x).ndim, jnp.int32))
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    def f(a):
+        return jnp.nanquantile(a, q, axis=axis, keepdims=keepdim)
+    return apply_op(f, x, op_name="nanquantile")
+
+
+def digitize(x, bins, right=False):
+    def f(a, b):
+        return jnp.digitize(a, b, right=right)
+    return apply_op(f, x, bins, op_name="digitize")
+
+
+def _split_helper(x, indices_or_sections, axis):
+    def f(a):
+        return tuple(jnp.array_split(a, indices_or_sections, axis=axis)
+                     if isinstance(indices_or_sections, int)
+                     else jnp.split(a, list(indices_or_sections),
+                                    axis=axis))
+    return list(apply_op(f, x, op_name="tensor_split"))
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    return _split_helper(x, num_or_indices, axis)
+
+
+def vsplit(x, num_or_indices):
+    return _split_helper(x, num_or_indices, 0)
+
+
+def hsplit(x, num_or_indices):
+    return _split_helper(x, num_or_indices, 1)
+
+
+def dsplit(x, num_or_indices):
+    return _split_helper(x, num_or_indices, 2)
+
+
+def clip_(x, min=None, max=None):
+    """In-place clip (paddle clip_): rebinds the tensor's storage."""
+    x._data = jnp.clip(_d(x), min, max)
+    x._version += 1
+    return x
+
+
+def increment(x, value=1.0):
+    """paddle.increment: in-place scalar add (static-graph counter op)."""
+    x._data = _d(x) + value
+    x._version += 1
+    return x
+
+
+def floor_mod(x, y):
+    from . import math as _m
+    return _m.mod(x, y)
+
+
+def binomial(count, prob):
+    """Sample Binomial(count, prob) elementwise (paddle.binomial).
+
+    Exact bernoulli-sum for small counts; for max(count) > 4096 the
+    normal approximation (rounded, clipped to [0, count]) keeps memory
+    O(shape) instead of O(max_count * shape)."""
+    from paddle_tpu.core.generator import next_key
+    c = np.asarray(_d(count))
+    p = _d(prob)
+    cmax = int(c.max()) if c.size else 0
+    if cmax > 4096:
+        mean = jnp.asarray(c) * p
+        std = jnp.sqrt(jnp.asarray(c) * p * (1 - p))
+        g = jax.random.normal(next_key(), jnp.broadcast_shapes(
+            p.shape, c.shape))
+        draw = jnp.round(mean + std * g)
+        return Tensor(jnp.clip(draw, 0, jnp.asarray(c)).astype(jnp.int64))
+    draws = jax.random.bernoulli(
+        next_key(), jnp.broadcast_to(p, (cmax,) + p.shape))
+    idx = jnp.arange(cmax)
+    mask = idx[(...,) + (None,) * p.ndim] < jnp.asarray(c)
+    return Tensor(jnp.sum(draws * mask, axis=0).astype(jnp.int64))

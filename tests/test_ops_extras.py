@@ -1,0 +1,147 @@
+"""Long-tail ops (ops/extras.py) — numpy/scipy oracles."""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as pt
+
+
+def _t(x):
+    return pt.to_tensor(np.asarray(x))
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def test_kron_trace_mm_tensordot():
+    a = np.arange(4, dtype=np.float32).reshape(2, 2)
+    b = np.ones((2, 2), np.float32)
+    np.testing.assert_allclose(_np(pt.kron(_t(a), _t(b))), np.kron(a, b))
+    np.testing.assert_allclose(float(_np(pt.trace(_t(a)))), np.trace(a))
+    np.testing.assert_allclose(_np(pt.mm(_t(a), _t(b))), a @ b)
+    np.testing.assert_allclose(_np(pt.tensordot(_t(a), _t(b), axes=1)),
+                               np.tensordot(a, b, axes=1))
+
+
+def test_trapezoid_family():
+    y = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    np.testing.assert_allclose(float(_np(pt.trapezoid(_t(y), dx=0.5))),
+                               np.trapezoid(y, dx=0.5))
+    cum = _np(pt.cumulative_trapezoid(_t(y), dx=1.0))
+    np.testing.assert_allclose(cum, [1.5, 4.0, 7.5], rtol=1e-6)
+
+
+def test_angles_and_special():
+    x = np.array([0.5, 1.5], np.float32)
+    np.testing.assert_allclose(_np(pt.rad2deg(_t(x))), np.rad2deg(x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_np(pt.deg2rad(_t(x))), np.deg2rad(x),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_np(pt.i0(_t(x))), sps.i0(x), rtol=1e-5)
+    np.testing.assert_allclose(_np(pt.i1(_t(x))), sps.i1(x), rtol=1e-5)
+    a = np.array([0.5, 2.0], np.float32)
+    v = np.array([1.5, 0.3], np.float32)
+    # torch/paddle convention: igamma = lower P, igammac = upper Q
+    import torch
+    np.testing.assert_allclose(_np(pt.igamma(_t(a), _t(v))),
+                               torch.igamma(torch.tensor(a),
+                                            torch.tensor(v)).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(pt.igammac(_t(a), _t(v))),
+                               torch.igammac(torch.tensor(a),
+                                             torch.tensor(v)).numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(_np(pt.polygamma(_t(x), 1)),
+                               sps.polygamma(1, x), rtol=1e-4)
+
+
+def test_renorm_caps_norms():
+    x = np.array([[3.0, 4.0], [0.3, 0.4]], np.float32)
+    out = _np(pt.renorm(_t(x), p=2.0, axis=0, max_norm=1.0))
+    np.testing.assert_allclose(np.linalg.norm(out[0]), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[1], x[1], rtol=1e-6)  # under the cap
+
+
+def test_label_smooth_and_splits():
+    onehot = np.eye(4, dtype=np.float32)[:2]
+    sm = _np(pt.label_smooth(_t(onehot), epsilon=0.1))
+    np.testing.assert_allclose(sm[0, 0], 0.9 + 0.1 / 4, rtol=1e-6)
+    np.testing.assert_allclose(sm[0, 1], 0.1 / 4, rtol=1e-6)
+
+    x = np.arange(24, dtype=np.float32).reshape(4, 3, 2)
+    parts = pt.vsplit(_t(x), 2)
+    assert len(parts) == 2 and list(parts[0].shape) == [2, 3, 2]
+    parts = pt.tensor_split(_t(x), [1, 3], axis=0)
+    assert [p.shape[0] for p in parts] == [1, 2, 1]
+    us = pt.unstack(_t(x), axis=1)
+    assert len(us) == 3 and list(us[0].shape) == [4, 2]
+
+
+def test_matrix_exp_vander_householder_pdist():
+    a = np.diag([0.0, np.log(2.0)]).astype(np.float32)
+    np.testing.assert_allclose(_np(pt.matrix_exp(_t(a))),
+                               np.diag([1.0, 2.0]), rtol=1e-5, atol=1e-6)
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(_np(pt.vander(_t(v))), np.vander(v),
+                               rtol=1e-6)
+    pts = np.array([[0.0, 0.0], [3.0, 4.0], [0.0, 1.0]], np.float32)
+    np.testing.assert_allclose(_np(pt.pdist(_t(pts))),
+                               [5.0, 1.0, np.sqrt(18.0)], rtol=1e-6)
+
+
+def test_inplace_clone_index_fill():
+    x = _t(np.array([1.0, 5.0, -3.0], np.float32))
+    c = pt.clone(x)
+    pt.clip_(x, min=0.0, max=2.0)
+    np.testing.assert_allclose(_np(x), [1.0, 2.0, 0.0])
+    np.testing.assert_allclose(_np(c), [1.0, 5.0, -3.0])  # clone unaffected
+    pt.increment(x, 1.0)
+    np.testing.assert_allclose(_np(x), [2.0, 3.0, 1.0])
+    y = pt.index_fill(_t(np.zeros((3, 2), np.float32)),
+                      _t(np.array([0, 2])), 0, 7.0)
+    np.testing.assert_allclose(_np(y)[:, 0], [7.0, 0.0, 7.0])
+    assert int(_np(pt.rank(_t(np.zeros((2, 3)))))) == 2
+
+
+def test_quantile_digitize_polar_binomial():
+    x = np.array([1.0, np.nan, 3.0, 2.0], np.float32)
+    np.testing.assert_allclose(float(_np(pt.nanquantile(_t(x), 0.5))),
+                               2.0, rtol=1e-6)
+    bins = np.array([0.0, 1.0, 2.0], np.float32)
+    np.testing.assert_array_equal(
+        _np(pt.digitize(_t(np.array([0.5, 1.5, 5.0], np.float32)),
+                        _t(bins))), [1, 2, 3])
+    z = _np(pt.polar(_t(np.array([2.0], np.float32)),
+                     _t(np.array([np.pi / 2], np.float32))))
+    np.testing.assert_allclose([z[0].real, z[0].imag], [0.0, 2.0],
+                               atol=1e-6)
+    pt.seed(0)
+    draws = _np(pt.binomial(_t(np.array([100], np.int64)),
+                            _t(np.array([0.3], np.float32))))
+    assert 10 < int(draws[0]) < 60
+
+
+def test_extras_gradients():
+    x = _t(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    x.stop_gradient = False
+    pt.ops.sum(pt.kron(x, x)).backward()
+    assert x.grad is not None
+    assert np.all(np.isfinite(_np(x.grad)))
+
+
+def test_cumulative_trapezoid_with_x_2d():
+    y = np.arange(12, dtype=np.float32).reshape(3, 4)
+    x = np.cumsum(np.ones((3, 4), np.float32), axis=0)
+    out = _np(pt.cumulative_trapezoid(_t(y), _t(x), axis=0))
+    import scipy.integrate as si
+    want = si.cumulative_trapezoid(y, x, axis=0)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_binomial_large_count_normal_approx():
+    pt.seed(1)
+    draws = _np(pt.binomial(_t(np.array([1_000_000], np.int64)),
+                            _t(np.array([0.5], np.float32))))
+    # mean 500k, std 500: a 6-sigma window
+    assert 497_000 < int(draws[0]) < 503_000
