@@ -14,7 +14,8 @@ no scaling (the scaler passes through when disabled, as the reference does).
 """
 from .auto_cast import (  # noqa: F401
     auto_cast, amp_guard, amp_state, decorate, white_list, black_list,
+    is_bfloat16_supported, is_float16_supported,
 )
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 
-__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler"]
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler", "is_bfloat16_supported", "is_float16_supported"]

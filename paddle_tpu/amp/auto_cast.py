@@ -5,7 +5,7 @@ import contextlib
 import threading
 from typing import Optional, Set
 
-__all__ = ["auto_cast", "amp_guard", "amp_state", "decorate", "white_list",
+__all__ = ["auto_cast", "amp_guard", "amp_state", "decorate", "white_list", "is_bfloat16_supported", "is_float16_supported",
            "black_list"]
 
 _tls = threading.local()
@@ -123,3 +123,15 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
             opt_list = opt_list[0]
         return (model_list[0] if single_model else model_list), opt_list
     return model_list[0] if single_model else model_list
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is native on every TPU generation (and fine on CPU for test
+    runs) — reference: amp/auto_cast.py is_bfloat16_supported."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    """fp16 compute is supported via XLA on TPU (bf16 is preferred;
+    GradScaler exists for fp16 parity)."""
+    return True

@@ -79,13 +79,19 @@ def _rope_cache(seq_len: int, dim: int, theta: float, dtype_name: str):
     return (np.cos(freqs).astype(to), np.sin(freqs).astype(to))
 
 
-def apply_rotary(q, k, theta: float = 500000.0):
-    """Rotate q,k ([B,S,H,D]) by position. One tape node, fused by XLA."""
+def apply_rotary(q, k, theta: float = 500000.0, pos_offset: int = 0,
+                 table_len: int = 0):
+    """Rotate q,k ([B,S,H,D]) by absolute position (``pos_offset`` shifts
+    the position index — the KV-cached decode path's token lands at
+    position P, not 0). ``table_len`` fixes the cached table size (pass
+    max_position_embeddings so every decode step hits ONE lru entry
+    instead of minting a new table per length). One tape node."""
     def f(qa, ka):
         s, d = qa.shape[1], qa.shape[-1]
-        cos, sin = _rope_cache(s, d, theta, str(qa.dtype))
-        cos = jnp.asarray(cos)[None, :, None, :]
-        sin = jnp.asarray(sin)[None, :, None, :]
+        n = max(table_len, pos_offset + s)
+        cos, sin = _rope_cache(n, d, theta, str(qa.dtype))
+        cos = jnp.asarray(cos)[None, pos_offset:pos_offset + s, None, :]
+        sin = jnp.asarray(sin)[None, pos_offset:pos_offset + s, None, :]
 
         def rot(x):
             x1, x2 = x[..., 0::2], x[..., 1::2]
@@ -130,25 +136,53 @@ class LlamaAttention(nn.Layer):
         self.o_proj = _make_linear(cfg, self.n_heads * self.head_dim,
                                    cfg.hidden_size, "row")
 
-    def forward(self, x):
+    def _expand_kv(self, k, v):
+        if self.n_kv == self.n_heads:
+            return k, v
+        # GQA: expand KV heads by broadcast (free under XLA)
+        B, S = k.shape[0], k.shape[1]
+        rep = self.n_heads // self.n_kv
+        k = ops.reshape(
+            ops.expand(ops.unsqueeze(k, 3), [B, S, self.n_kv, rep,
+                                             self.head_dim]),
+            [B, S, self.n_heads, self.head_dim])
+        v = ops.reshape(
+            ops.expand(ops.unsqueeze(v, 3), [B, S, self.n_kv, rep,
+                                             self.head_dim]),
+            [B, S, self.n_heads, self.head_dim])
+        return k, v
+
+    def forward(self, x, cache=None):
+        """``cache=(k, v)`` ([B, P, n_kv, hd] each, P may be 0) switches to
+        the incremental-decode path: returns (out, (k', v')). Without a
+        cache, plain causal flash attention returns just ``out``."""
         B, S = x.shape[0], x.shape[1]
         q = ops.reshape(self.q_proj(x), [B, S, self.n_heads, self.head_dim])
         k = ops.reshape(self.k_proj(x), [B, S, self.n_kv, self.head_dim])
         v = ops.reshape(self.v_proj(x), [B, S, self.n_kv, self.head_dim])
-        q, k = apply_rotary(q, k, self.cfg.rope_theta)
-        if self.n_kv != self.n_heads:
-            # GQA: expand KV heads by broadcast (free under XLA)
-            rep = self.n_heads // self.n_kv
-            k = ops.reshape(
-                ops.expand(ops.unsqueeze(k, 3), [B, S, self.n_kv, rep,
-                                                 self.head_dim]),
-                [B, S, self.n_heads, self.head_dim])
-            v = ops.reshape(
-                ops.expand(ops.unsqueeze(v, 3), [B, S, self.n_kv, rep,
-                                                 self.head_dim]),
-                [B, S, self.n_heads, self.head_dim])
-        out = F.flash_attention(q, k, v, causal=True)
-        return self.o_proj(ops.reshape(out, [B, S, -1]))
+        if cache is None:
+            q, k = apply_rotary(q, k, self.cfg.rope_theta)
+            k, v = self._expand_kv(k, v)
+            out = F.flash_attention(q, k, v, causal=True)
+            return self.o_proj(ops.reshape(out, [B, S, -1]))
+        past_k, past_v = cache
+        P = 0 if past_k is None else past_k.shape[1]
+        if S > 1 and P > 0:
+            raise NotImplementedError(
+                "chunked prefill with an existing cache is not supported; "
+                "prefill once, then decode token-by-token")
+        q, k = apply_rotary(q, k, self.cfg.rope_theta, pos_offset=P,
+                            table_len=self.cfg.max_position_embeddings)
+        if P:
+            k_all = ops.concat([past_k, k], axis=1)
+            v_all = ops.concat([past_v, v], axis=1)
+        else:
+            k_all, v_all = k, v
+        ke, ve = self._expand_kv(k_all, v_all)
+        # prefill (P == 0): causal over the prompt; decode (S == 1): the
+        # single query attends the whole prefix
+        out = F.scaled_dot_product_attention(q, ke, ve, is_causal=(S > 1))
+        return self.o_proj(ops.reshape(out, [B, S, -1])), (k_all, v_all)
 
 
 class LlamaMLP(nn.Layer):
@@ -176,10 +210,16 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x):
-        x = ops.add(x, self.self_attn(self.input_layernorm(x)))
+    def forward(self, x, cache=None):
+        if cache is None:
+            x = ops.add(x, self.self_attn(self.input_layernorm(x)))
+            x = ops.add(x, self.mlp(self.post_attention_layernorm(x)))
+            return x
+        attn_out, new_cache = self.self_attn(self.input_layernorm(x),
+                                             cache=cache)
+        x = ops.add(x, attn_out)
         x = ops.add(x, self.mlp(self.post_attention_layernorm(x)))
-        return x
+        return x, new_cache
 
 
 class LlamaModel(nn.Layer):
@@ -196,15 +236,21 @@ class LlamaModel(nn.Layer):
                                     for _ in range(cfg.num_hidden_layers)])
         self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            if self.cfg.recompute and self.training:
-                from paddle_tpu.distributed.fleet import recompute
-                x = recompute(layer, x)
-            else:
-                x = layer(x)
-        return self.norm(x)
+        if caches is None:
+            for layer in self.layers:
+                if self.cfg.recompute and self.training:
+                    from paddle_tpu.distributed.fleet import recompute
+                    x = recompute(layer, x)
+                else:
+                    x = layer(x)
+            return self.norm(x)
+        new_caches = []
+        for layer, c in zip(self.layers, caches):
+            x, nc = layer(x, cache=c)
+            new_caches.append(nc)
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -220,17 +266,74 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
-        if self.lm_head is not None:
-            logits = self.lm_head(h)
-        else:
-            logits = ops.matmul(h, ops.transpose(
-                self.model.embed_tokens.weight, [1, 0]))
+        logits = self._logits(h)
         if labels is None:
             return logits
+        # causal-LM shift: position t predicts token t+1
         loss = F.cross_entropy(
-            ops.reshape(logits, [-1, logits.shape[-1]]),
-            ops.reshape(labels, [-1]))
+            ops.reshape(logits[:, :-1], [-1, logits.shape[-1]]),
+            ops.reshape(labels[:, 1:], [-1]))
         return logits, loss
+
+    def _logits(self, h):
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        return ops.matmul(h, ops.transpose(
+            self.model.embed_tokens.weight, [1, 0]))
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_token_id=None):
+        """KV-cached autoregressive decoding (greedy when
+        ``temperature == 0``). Eager-mode: the cache grows per step —
+        the paddle-ecosystem ``model.generate`` surface.
+
+        Returns the full sequence [B, S + new] including the prompt.
+        """
+        import jax
+        import numpy as np
+        from paddle_tpu.core import generator as G
+        from paddle_tpu.core.autograd import no_grad
+        from paddle_tpu.core.tensor import Tensor
+
+        with no_grad():
+            ids = input_ids
+            # prefill: run the whole prompt once, seeding per-layer caches
+            caches = [(None, None)] * self.cfg.num_hidden_layers
+            h, caches = self.model(ids, caches=caches)
+            logits = self._logits(h[:, -1:])
+            out_np = np.asarray(ids.data)
+            finished = np.zeros(out_np.shape[0], bool)
+            for i in range(max_new_tokens):
+                step_logits = jnp.squeeze(logits.data, 1)  # [B, V]
+                if temperature == 0:
+                    nxt = jnp.argmax(step_logits, -1)
+                else:
+                    sl = step_logits / temperature
+                    if top_k > 0:
+                        kth = jnp.sort(sl, -1)[:, -top_k][:, None]
+                        sl = jnp.where(sl < kth, -jnp.inf, sl)
+                    if top_p < 1.0:
+                        srt = jnp.sort(sl, -1)[:, ::-1]
+                        probs = jax.nn.softmax(srt, -1)
+                        cum = jnp.cumsum(probs, -1)
+                        cutoff_idx = jnp.sum(cum < top_p, -1)
+                        cutoff = jnp.take_along_axis(
+                            srt, cutoff_idx[:, None], -1)
+                        sl = jnp.where(sl < cutoff, -jnp.inf, sl)
+                    nxt = jax.random.categorical(G.next_key(), sl)
+                nxt_np = np.asarray(nxt)
+                if eos_token_id is not None:
+                    nxt_np = np.where(finished, eos_token_id, nxt_np)
+                    finished |= (nxt_np == eos_token_id)
+                out_np = np.concatenate([out_np, nxt_np[:, None]], 1)
+                if (eos_token_id is not None and finished.all()) or \
+                        i == max_new_tokens - 1:
+                    break  # budget spent: skip the unused final forward
+                tok = Tensor(jnp.asarray(nxt_np[:, None]))
+                h, caches = self.model(tok, caches=caches)
+                logits = self._logits(h)
+            return Tensor(jnp.asarray(out_np))
 
     @staticmethod
     def flops_per_token(cfg: LlamaConfig) -> float:

@@ -144,9 +144,10 @@ class MoeForCausalLM(nn.Layer):
         logits = self.lm_head(self.norm(x))
         if labels is None:
             return logits
+        # causal-LM shift: position t predicts token t+1
         loss = F.cross_entropy(
-            ops.reshape(logits, [-1, logits.shape[-1]]),
-            ops.reshape(labels, [-1]))
+            ops.reshape(logits[:, :-1], [-1, logits.shape[-1]]),
+            ops.reshape(labels[:, 1:], [-1]))
         aux = self.aux_loss()
         if aux is not None:
             loss = ops.add(loss, ops.scale(aux, self.cfg.aux_loss_weight))

@@ -141,3 +141,18 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
             p.grad = Tensor(p.grad.data * scale.astype(p.grad.data.dtype),
                             stop_gradient=True)
     return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value: float):
+    """torch-style in-place gradient value clipping (reference:
+    nn/utils/clip_grad_value_)."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    if clip_value < 0:
+        raise ValueError("clip_value must be non-negative")
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    for p in params:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad.data, -clip_value, clip_value),
+                            stop_gradient=True)

@@ -1,4 +1,4 @@
 """paddle.nn.utils parity (reference: ``python/paddle/nn/utils/``)."""
-from paddle_tpu.nn.clip import clip_grad_norm_  # noqa: F401
+from paddle_tpu.nn.clip import clip_grad_norm_, clip_grad_value_  # noqa: F401
 from .weight_norm import weight_norm, remove_weight_norm  # noqa: F401
 from .params import parameters_to_vector, vector_to_parameters  # noqa: F401

@@ -106,3 +106,15 @@ def test_local_fs(tmp_path):
     hdfs = HDFSClient()
     with pytest.raises(RuntimeError, match="hadoop"):
         hdfs.ls_dir("/remote/path")
+
+
+def test_clip_grad_value_exported_and_works():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.utils import clip_grad_value_
+    m = nn.Linear(3, 3)
+    x = pt.to_tensor(np.full((2, 3), 10.0, np.float32))
+    pt.ops.sum(m(x)).backward()
+    clip_grad_value_(m.parameters(), 0.5)
+    for _, p in m.named_parameters():
+        g = np.asarray(p.grad.data)
+        assert np.all(np.abs(g) <= 0.5 + 1e-7)
