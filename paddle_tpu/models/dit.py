@@ -42,9 +42,11 @@ class DiTConfig:
 
     @staticmethod
     def tiny(**kw) -> "DiTConfig":
-        return DiTConfig(input_size=8, patch_size=2, in_channels=4,
+        base = dict(input_size=8, patch_size=2, in_channels=4,
                          hidden_size=32, depth=2, num_heads=2,
-                         num_classes=10, **kw)
+                         num_classes=10)
+        base.update(kw)
+        return DiTConfig(**base)
 
 
 def timestep_embedding(t, dim: int, max_period: float = 10000.0):

@@ -34,11 +34,12 @@ class ErnieConfig:
 
     @staticmethod
     def tiny(**kw) -> "ErnieConfig":
-        return ErnieConfig(vocab_size=128, hidden_size=32,
-                           num_hidden_layers=2, num_attention_heads=2,
-                           intermediate_size=64,
-                           max_position_embeddings=64, type_vocab_size=2,
-                           **kw)
+        base = dict(vocab_size=128, hidden_size=32,
+                    num_hidden_layers=2, num_attention_heads=2,
+                    intermediate_size=64,
+                    max_position_embeddings=64, type_vocab_size=2)
+        base.update(kw)
+        return ErnieConfig(**base)
 
 
 class ErnieEmbeddings(nn.Layer):

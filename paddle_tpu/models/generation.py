@@ -1,0 +1,61 @@
+"""Shared autoregressive decoding loop (the paddle-ecosystem
+``model.generate`` surface) used by the zoo's causal LMs.
+
+A model plugs in two hooks:
+  * ``prefill(ids)   -> (logits_last [B,1,V], caches)``
+  * ``decode(tok, caches) -> (logits [B,1,V], caches)``
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import generator as G
+from paddle_tpu.core.autograd import no_grad
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["sample_token", "generate_loop"]
+
+
+def sample_token(step_logits, temperature: float, top_k: int,
+                 top_p: float):
+    """[B, V] logits -> [B] token ids (greedy when temperature == 0)."""
+    if temperature == 0:
+        return jnp.argmax(step_logits, -1)
+    sl = step_logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(sl, -1)[:, -top_k][:, None]
+        sl = jnp.where(sl < kth, -jnp.inf, sl)
+    if top_p < 1.0:
+        srt = jnp.sort(sl, -1)[:, ::-1]
+        probs = jax.nn.softmax(srt, -1)
+        cum = jnp.cumsum(probs, -1)
+        cutoff_idx = jnp.sum(cum < top_p, -1)
+        cutoff = jnp.take_along_axis(srt, cutoff_idx[:, None], -1)
+        sl = jnp.where(sl < cutoff, -jnp.inf, sl)
+    return jax.random.categorical(G.next_key(), sl)
+
+
+def generate_loop(prefill, decode, input_ids, max_new_tokens: int = 32,
+                  temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 1.0, eos_token_id=None) -> Tensor:
+    """Returns the full sequence [B, S + new] including the prompt."""
+    with no_grad():
+        logits, caches = prefill(input_ids)
+        out_np = np.asarray(input_ids.data)
+        finished = np.zeros(out_np.shape[0], bool)
+        for i in range(max_new_tokens):
+            step_logits = jnp.squeeze(logits.data, 1)
+            nxt_np = np.asarray(sample_token(step_logits, temperature,
+                                             top_k, top_p))
+            if eos_token_id is not None:
+                nxt_np = np.where(finished, eos_token_id, nxt_np)
+                finished |= (nxt_np == eos_token_id)
+            out_np = np.concatenate([out_np, nxt_np[:, None]], 1)
+            if (eos_token_id is not None and finished.all()) or \
+                    i == max_new_tokens - 1:
+                break  # budget spent: skip the unused final forward
+            tok = Tensor(jnp.asarray(nxt_np[:, None]))
+            logits, caches = decode(tok, caches)
+        return Tensor(jnp.asarray(out_np))

@@ -52,9 +52,11 @@ class LlamaConfig:
 
     @staticmethod
     def llama3_70b(**kw) -> "LlamaConfig":
-        return LlamaConfig(hidden_size=8192, intermediate_size=28672,
-                           num_hidden_layers=80, num_attention_heads=64,
-                           num_key_value_heads=8, **kw)
+        base = dict(hidden_size=8192, intermediate_size=28672,
+                    num_hidden_layers=80, num_attention_heads=64,
+                    num_key_value_heads=8)
+        base.update(kw)
+        return LlamaConfig(**base)
 
     @staticmethod
     def tiny(**kw) -> "LlamaConfig":
@@ -246,6 +248,10 @@ class LlamaModel(nn.Layer):
                 else:
                     x = layer(x)
             return self.norm(x)
+        if len(caches) != len(self.layers):
+            raise ValueError(
+                f"caches has {len(caches)} entries for "
+                f"{len(self.layers)} layers")
         new_caches = []
         for layer, c in zip(self.layers, caches):
             x, nc = layer(x, cache=c)
@@ -269,7 +275,12 @@ class LlamaForCausalLM(nn.Layer):
         logits = self._logits(h)
         if labels is None:
             return logits
-        # causal-LM shift: position t predicts token t+1
+        # HF-style contract: labels == input_ids; the shift happens HERE
+        # (position t predicts token t+1) — do not pre-shift labels
+        if labels.shape[1] < 2:
+            raise ValueError(
+                "causal-LM loss needs sequences of length >= 2 (the "
+                "internal shift leaves nothing to predict for length 1)")
         loss = F.cross_entropy(
             ops.reshape(logits[:, :-1], [-1, logits.shape[-1]]),
             ops.reshape(labels[:, 1:], [-1]))
@@ -285,55 +296,20 @@ class LlamaForCausalLM(nn.Layer):
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_token_id=None):
         """KV-cached autoregressive decoding (greedy when
-        ``temperature == 0``). Eager-mode: the cache grows per step —
-        the paddle-ecosystem ``model.generate`` surface.
+        ``temperature == 0``); see models/generation.py for the loop."""
+        from .generation import generate_loop
 
-        Returns the full sequence [B, S + new] including the prompt.
-        """
-        import jax
-        import numpy as np
-        from paddle_tpu.core import generator as G
-        from paddle_tpu.core.autograd import no_grad
-        from paddle_tpu.core.tensor import Tensor
-
-        with no_grad():
-            ids = input_ids
-            # prefill: run the whole prompt once, seeding per-layer caches
+        def prefill(ids):
             caches = [(None, None)] * self.cfg.num_hidden_layers
             h, caches = self.model(ids, caches=caches)
-            logits = self._logits(h[:, -1:])
-            out_np = np.asarray(ids.data)
-            finished = np.zeros(out_np.shape[0], bool)
-            for i in range(max_new_tokens):
-                step_logits = jnp.squeeze(logits.data, 1)  # [B, V]
-                if temperature == 0:
-                    nxt = jnp.argmax(step_logits, -1)
-                else:
-                    sl = step_logits / temperature
-                    if top_k > 0:
-                        kth = jnp.sort(sl, -1)[:, -top_k][:, None]
-                        sl = jnp.where(sl < kth, -jnp.inf, sl)
-                    if top_p < 1.0:
-                        srt = jnp.sort(sl, -1)[:, ::-1]
-                        probs = jax.nn.softmax(srt, -1)
-                        cum = jnp.cumsum(probs, -1)
-                        cutoff_idx = jnp.sum(cum < top_p, -1)
-                        cutoff = jnp.take_along_axis(
-                            srt, cutoff_idx[:, None], -1)
-                        sl = jnp.where(sl < cutoff, -jnp.inf, sl)
-                    nxt = jax.random.categorical(G.next_key(), sl)
-                nxt_np = np.asarray(nxt)
-                if eos_token_id is not None:
-                    nxt_np = np.where(finished, eos_token_id, nxt_np)
-                    finished |= (nxt_np == eos_token_id)
-                out_np = np.concatenate([out_np, nxt_np[:, None]], 1)
-                if (eos_token_id is not None and finished.all()) or \
-                        i == max_new_tokens - 1:
-                    break  # budget spent: skip the unused final forward
-                tok = Tensor(jnp.asarray(nxt_np[:, None]))
-                h, caches = self.model(tok, caches=caches)
-                logits = self._logits(h)
-            return Tensor(jnp.asarray(out_np))
+            return self._logits(h[:, -1:]), caches
+
+        def decode(tok, caches):
+            h, caches = self.model(tok, caches=caches)
+            return self._logits(h), caches
+
+        return generate_loop(prefill, decode, input_ids, max_new_tokens,
+                             temperature, top_k, top_p, eos_token_id)
 
     @staticmethod
     def flops_per_token(cfg: LlamaConfig) -> float:

@@ -44,29 +44,35 @@ class MoeConfig:
 
     @staticmethod
     def qwen2_moe_a14b(**kw) -> "MoeConfig":
-        return MoeConfig(hidden_size=3584, intermediate_size=18944,
+        base = dict(hidden_size=3584, intermediate_size=18944,
                          moe_intermediate_size=2560, num_hidden_layers=28,
                          num_attention_heads=28, num_key_value_heads=4,
                          num_experts=64, num_experts_per_tok=8,
-                         first_k_dense_replace=0, **kw)
+                         first_k_dense_replace=0)
+        base.update(kw)
+        return MoeConfig(**base)
 
     @staticmethod
     def deepseek_moe_16b(**kw) -> "MoeConfig":
-        return MoeConfig(vocab_size=102400, hidden_size=2048,
+        base = dict(vocab_size=102400, hidden_size=2048,
                          intermediate_size=10944, moe_intermediate_size=1408,
                          num_hidden_layers=28, num_attention_heads=16,
                          num_key_value_heads=16, num_experts=64,
                          num_experts_per_tok=6, num_shared_experts=2,
-                         first_k_dense_replace=1, **kw)
+                         first_k_dense_replace=1)
+        base.update(kw)
+        return MoeConfig(**base)
 
     @staticmethod
     def tiny(**kw) -> "MoeConfig":
-        return MoeConfig(vocab_size=128, hidden_size=32,
+        base = dict(vocab_size=128, hidden_size=32,
                          intermediate_size=64, moe_intermediate_size=32,
                          num_hidden_layers=2, num_attention_heads=2,
                          num_key_value_heads=2, num_experts=4,
                          num_experts_per_tok=2, num_shared_experts=1,
-                         first_k_dense_replace=1, **kw)
+                         first_k_dense_replace=1)
+        base.update(kw)
+        return MoeConfig(**base)
 
     def _attn_cfg(self) -> LlamaConfig:
         return LlamaConfig(
@@ -104,15 +110,22 @@ class MoeDecoderLayer(nn.Layer):
             else:
                 self.shared_expert = None
 
-    def forward(self, x):
-        x = ops.add(x, self.self_attn(self.input_layernorm(x)))
+    def forward(self, x, cache=None):
+        if cache is None:
+            x = ops.add(x, self.self_attn(self.input_layernorm(x)))
+        else:
+            attn_out, new_cache = self.self_attn(self.input_layernorm(x),
+                                                 cache=cache)
+            x = ops.add(x, attn_out)
         h = self.post_attention_layernorm(x)
         if self.is_dense:
-            return ops.add(x, self.mlp(h))
-        routed = self.mlp(h)
-        if self.shared_expert is not None:
-            routed = ops.add(routed, self.shared_expert(h))
-        return ops.add(x, routed)
+            out = ops.add(x, self.mlp(h))
+        else:
+            routed = self.mlp(h)
+            if self.shared_expert is not None:
+                routed = ops.add(routed, self.shared_expert(h))
+            out = ops.add(x, routed)
+        return out if cache is None else (out, new_cache)
 
 
 class MoeForCausalLM(nn.Layer):
@@ -137,14 +150,30 @@ class MoeForCausalLM(nn.Layer):
                 total = la if total is None else ops.add(total, la)
         return total
 
-    def forward(self, input_ids, labels=None):
+    def forward(self, input_ids, labels=None, caches=None):
         x = self.embed_tokens(input_ids)
+        if caches is not None:
+            # cached path returns NORMALIZED HIDDEN states (not logits):
+            # generate() projects only the positions it needs — a long
+            # prefill must not pay a [B, S, vocab] lm_head matmul
+            if len(caches) != len(self.layers):
+                raise ValueError(
+                    f"caches has {len(caches)} entries for "
+                    f"{len(self.layers)} layers")
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                x, nc = layer(x, cache=c)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         for layer in self.layers:
             x = layer(x)
         logits = self.lm_head(self.norm(x))
         if labels is None:
             return logits
-        # causal-LM shift: position t predicts token t+1
+        # HF-style contract: labels == input_ids; the shift happens HERE
+        if labels.shape[1] < 2:
+            raise ValueError(
+                "causal-LM loss needs sequences of length >= 2")
         loss = F.cross_entropy(
             ops.reshape(logits[:, :-1], [-1, logits.shape[-1]]),
             ops.reshape(labels[:, 1:], [-1]))
@@ -152,3 +181,21 @@ class MoeForCausalLM(nn.Layer):
         if aux is not None:
             loss = ops.add(loss, ops.scale(aux, self.cfg.aux_loss_weight))
         return logits, loss
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_token_id=None):
+        """KV-cached decoding (see models/generation.py)."""
+        from .generation import generate_loop
+
+        def prefill(ids):
+            caches = [(None, None)] * self.cfg.num_hidden_layers
+            h, caches = self(ids, caches=caches)
+            return self.lm_head(h[:, -1:]), caches
+
+        def decode(tok, caches):
+            h, caches = self(tok, caches=caches)
+            return self.lm_head(h), caches
+
+        return generate_loop(prefill, decode, input_ids, max_new_tokens,
+                             temperature, top_k, top_p, eos_token_id)

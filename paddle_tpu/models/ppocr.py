@@ -29,8 +29,10 @@ class PPOCRRecConfig:
 
     @staticmethod
     def tiny(**kw) -> "PPOCRRecConfig":
-        return PPOCRRecConfig(num_classes=16, hidden_size=32,
-                              img_height=16, widths=(8, 16, 24, 32), **kw)
+        base = dict(num_classes=16, hidden_size=32,
+                              img_height=16, widths=(8, 16, 24, 32))
+        base.update(kw)
+        return PPOCRRecConfig(**base)
 
 
 class ConvBNLayer(nn.Layer):

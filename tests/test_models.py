@@ -218,3 +218,32 @@ def test_llama_generate_eos_stops():
     out2 = model.generate(prompt, max_new_tokens=50, temperature=0,
                           eos_token_id=greedy_first)
     assert out2.shape[1] == 3
+
+
+def test_moe_generate_kv_cache():
+    pt.seed(13)
+    cfg = MoeConfig.tiny()
+    model = MoeForCausalLM(cfg)
+    model.eval()
+    # capacity routing is not length-equivariant (dropping depends on the
+    # token count); raise capacity so no token drops — then incremental
+    # and full logits must agree
+    for layer in model.layers:
+        if not layer.is_dense:
+            layer.mlp.capacity_factor = 64.0
+    prompt = pt.to_tensor(np.array([[3, 5, 7]], np.int64))
+    out = model.generate(prompt, max_new_tokens=5, temperature=0)
+    assert list(out.shape) == [1, 8]
+    ids_np = np.asarray(out.data)
+    full = np.asarray(model(pt.to_tensor(ids_np)).data)
+    caches = [(None, None)] * cfg.num_hidden_layers
+    h, caches = model(pt.to_tensor(ids_np[:, :4]), caches=caches)
+    lg = model.lm_head(h)  # cached path returns hidden states
+    np.testing.assert_allclose(np.asarray(lg.data), full[:, :4],
+                               rtol=3e-3, atol=3e-3)
+    for t in range(4, 8):
+        h, caches = model(pt.to_tensor(ids_np[:, t:t + 1]),
+                          caches=caches)
+        lg = model.lm_head(h)
+        np.testing.assert_allclose(np.asarray(lg.data)[:, 0], full[:, t],
+                                   rtol=3e-3, atol=3e-3, err_msg=f"t={t}")
