@@ -223,6 +223,81 @@ class Tensor:
         self._version += 1
         return self
 
+    def _inplace(self, new_data):
+        self._data = new_data
+        self._version += 1
+        return self
+
+    def _inplace_keep_dtype(self, new_data):
+        # in-place ops preserve the tensor's dtype (set_value invariant):
+        # an int tensor must not silently become float
+        return self._inplace(new_data.astype(self._data.dtype))
+
+    def add_(self, other):
+        return self._inplace_keep_dtype(self._data + (
+            other._data if isinstance(other, Tensor) else other))
+
+    def subtract_(self, other):
+        return self._inplace_keep_dtype(self._data - (
+            other._data if isinstance(other, Tensor) else other))
+
+    def multiply_(self, other):
+        return self._inplace_keep_dtype(self._data * (
+            other._data if isinstance(other, Tensor) else other))
+
+    def clip_(self, min=None, max=None):
+        return self._inplace(jnp.clip(self._data, min, max))
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        import jax as _jax
+        from .generator import Generator, next_key
+        # paddle semantics: a nonzero seed pins the stream for this call
+        key = Generator(seed).next_key() if seed else next_key()
+        return self._inplace(_jax.random.uniform(
+            key, self._data.shape, self._data.dtype, min, max))
+
+    def normal_(self, mean=0.0, std=1.0, name=None):
+        import jax as _jax
+        from .generator import next_key
+        return self._inplace(mean + std * _jax.random.normal(
+            next_key(), self._data.shape, self._data.dtype))
+
+    def exponential_(self, lam=1.0):
+        import jax as _jax
+        from .generator import next_key
+        return self._inplace(_jax.random.exponential(
+            next_key(), self._data.shape, self._data.dtype) / lam)
+
+    # -- torch/paddle convenience surface -------------------------------------
+    def element_size(self) -> int:
+        return self._data.dtype.itemsize
+
+    def nelement(self) -> int:
+        return self.size
+
+    def is_contiguous(self) -> bool:
+        return True  # jax arrays are always dense row-major to the user
+
+    def contiguous(self):
+        return self
+
+    def cuda(self, device_id=None, blocking=True):
+        # no CUDA in this build (BASELINE.md); the accelerator is whatever
+        # PJRT provides — placement is a no-op like .cpu()
+        return self
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def half(self):
+        return self.astype("float16")
+
+    def float(self):
+        return self.astype("float32")
+
+    def sub(self, other):
+        return _ops().subtract(self, other)
+
     # -- indexing --------------------------------------------------------------
     def __getitem__(self, idx):
         if isinstance(idx, Tensor):

@@ -138,3 +138,47 @@ def test_parameter():
     assert not p.stop_gradient
     assert p.persistable
     assert p.trainable
+
+
+def test_tensor_inplace_and_convenience_methods():
+    import numpy as np
+    import paddle_tpu as pt
+    x = pt.to_tensor(np.ones((2, 3), np.float32))
+    x.add_(1.0).multiply_(2.0).subtract_(1.0)
+    np.testing.assert_allclose(np.asarray(x.data), 3 * np.ones((2, 3)))
+    x.clip_(max=2.5)
+    assert float(np.asarray(x.data).max()) == 2.5
+    assert x.element_size() == 4 and x.nelement() == 6
+    assert x.is_contiguous() and x.contiguous() is x
+    assert x.cuda() is x  # no CUDA: placement no-ops
+    assert x.bfloat16().dtype.name == "bfloat16"
+    assert x.half().dtype.name == "float16"
+    assert x.float().dtype.name == "float32"
+    y = x.sub(pt.to_tensor(np.ones((2, 3), np.float32)))
+    np.testing.assert_allclose(np.asarray(y.data),
+                               np.asarray(x.data) - 1)
+
+    pt.seed(0)
+    u = pt.to_tensor(np.zeros((100,), np.float32))
+    u.uniform_(0.0, 1.0)
+    arr = np.asarray(u.data)
+    assert 0 <= arr.min() and arr.max() <= 1 and arr.std() > 0.1
+    n = pt.to_tensor(np.zeros((500,), np.float32))
+    n.normal_(mean=2.0, std=0.1)
+    assert abs(float(np.asarray(n.data).mean()) - 2.0) < 0.05
+    e = pt.to_tensor(np.zeros((500,), np.float32))
+    e.exponential_(lam=2.0)
+    assert abs(float(np.asarray(e.data).mean()) - 0.5) < 0.1
+
+
+def test_inplace_preserves_dtype_and_seeded_uniform():
+    import numpy as np
+    import paddle_tpu as pt
+    t = pt.to_tensor(np.array([1, 2], np.int32))
+    t.add_(0.9)  # must not promote to float
+    assert t.dtype.name == "int32"
+    np.testing.assert_array_equal(np.asarray(t.data), [1, 2])
+
+    a = pt.to_tensor(np.zeros(16, np.float32)).uniform_(0, 1, seed=42)
+    b = pt.to_tensor(np.zeros(16, np.float32)).uniform_(0, 1, seed=42)
+    np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
