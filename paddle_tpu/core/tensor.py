@@ -229,8 +229,13 @@ class Tensor:
         return self
 
     def _inplace_keep_dtype(self, new_data):
-        # in-place ops preserve the tensor's dtype (set_value invariant):
-        # an int tensor must not silently become float
+        # in-place ops preserve dtype AND shape (set_value invariants):
+        # an int tensor must not silently become float, and a parameter
+        # must not be broadcast into a new shape under its optimizer
+        if tuple(new_data.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"in-place op would change shape "
+                f"{tuple(self._data.shape)} -> {tuple(new_data.shape)}")
         return self._inplace(new_data.astype(self._data.dtype))
 
     def add_(self, other):
@@ -246,27 +251,23 @@ class Tensor:
             other._data if isinstance(other, Tensor) else other))
 
     def clip_(self, min=None, max=None):
-        return self._inplace(jnp.clip(self._data, min, max))
+        return self._inplace_keep_dtype(jnp.clip(self._data, min, max))
 
     def uniform_(self, min=-1.0, max=1.0, seed=0):
-        import jax as _jax
-        from .generator import Generator, next_key
-        # paddle semantics: a nonzero seed pins the stream for this call
-        key = Generator(seed).next_key() if seed else next_key()
-        return self._inplace(_jax.random.uniform(
+        # same key derivation as ops.uniform (creation.py): identical
+        # seeds must reproduce across the two APIs
+        from .generator import next_key
+        key = jax.random.key(seed) if seed else next_key()
+        return self._inplace(jax.random.uniform(
             key, self._data.shape, self._data.dtype, min, max))
 
     def normal_(self, mean=0.0, std=1.0, name=None):
-        import jax as _jax
         from .generator import next_key
-        return self._inplace(mean + std * _jax.random.normal(
+        return self._inplace(mean + std * jax.random.normal(
             next_key(), self._data.shape, self._data.dtype))
 
     def exponential_(self, lam=1.0):
-        import jax as _jax
-        from .generator import next_key
-        return self._inplace(_jax.random.exponential(
-            next_key(), self._data.shape, self._data.dtype) / lam)
+        return _ops().exponential_(self, lam)
 
     # -- torch/paddle convenience surface -------------------------------------
     def element_size(self) -> int:

@@ -1196,3 +1196,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
     return apply_op(f, log_probs, labels, input_lengths, label_lengths,
                     op_name="ctc_loss")
+
+from paddle_tpu.nn import functional_extras as _fx  # noqa: E402
+from paddle_tpu.nn.functional_extras import *  # noqa: F401,F403,E402
+__all__ = list(__all__) + list(_fx.__all__)

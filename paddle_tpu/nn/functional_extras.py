@@ -1,0 +1,359 @@
+"""nn.functional long tail (reference: ``python/paddle/nn/functional/``
+— common.py pad/interpolate helpers, vision.py grid_sample/affine_grid,
+loss.py remaining losses, pooling.py max-unpool).
+
+Each is one differentiable tape node over a jnp body, like the rest of
+the functional library."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.autograd import apply_op
+
+__all__ = [
+    "pad", "zeropad2d", "diag_embed", "gumbel_softmax", "grid_sample",
+    "affine_grid", "poisson_nll_loss", "multi_label_soft_margin_loss",
+    "sigmoid_focal_loss", "dice_loss", "npair_loss", "gaussian_nll_loss",
+    "max_pool2d_with_index", "max_unpool2d",
+]
+
+_PAD_MODES = {"constant": "constant", "reflect": "reflect",
+              "replicate": "edge", "circular": "wrap"}
+
+
+def pad(x, pad, mode: str = "constant", value: float = 0.0,
+        data_format: str = "NCHW", name=None):
+    """Reference: nn/functional/common.py pad.
+
+    ``pad`` is the paddle convention: for rank-n input either
+    ``len(pad) == 2n`` ([lo, hi] per dim, innermost LAST like torch) or,
+    for NCHW/NCDHW-style data, a spatial-only list ([left, right, top,
+    bottom, ...]).
+    """
+    if mode not in _PAD_MODES:
+        raise ValueError(f"unknown pad mode '{mode}'")
+    np_mode = _PAD_MODES[mode]
+
+    def f(a):
+        nd = a.ndim
+        p = list(int(v) for v in pad)
+        if len(p) == 2 * nd:
+            cfg = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # spatial-only: innermost dim FIRST in the list (paddle/torch)
+            n_spatial = len(p) // 2
+            cfg = [(0, 0)] * nd
+            channel_last = data_format.endswith("C")
+            for i in range(n_spatial):
+                axis = (nd - 1 - i) if not channel_last else (nd - 2 - i)
+                cfg[axis] = (p[2 * i], p[2 * i + 1])
+        if np_mode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=np_mode)
+    return apply_op(f, x, op_name="pad")
+
+
+def zeropad2d(x, padding, data_format: str = "NCHW", name=None):
+    """Reference: common.py zeropad2d — [left, right, top, bottom]."""
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    return pad(x, list(padding), mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def diag_embed(input, offset: int = 0, dim1: int = -2, dim2: int = -1):
+    """Reference: tensor/creation.py diag_embed."""
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        # move the two new axes to dim1/dim2
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = []
+        src = iter([nd - 2, nd - 1])
+        pi = iter(perm)
+        for i in range(nd):
+            if i == d1:
+                order.append(nd - 2)
+            elif i == d2:
+                order.append(nd - 1)
+            else:
+                order.append(next(pi))
+        return jnp.transpose(out, order)
+    return apply_op(f, input, op_name="diag_embed")
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1, name=None):
+    """Reference: nn/functional/activation.py gumbel_softmax."""
+    from paddle_tpu.core.generator import next_key
+    g = jax.random.gumbel(next_key(),
+                          x.data.shape if hasattr(x, "data")
+                          else jnp.asarray(x).shape)
+
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            onehot = jax.nn.one_hot(idx, a.shape[axis], axis=axis,
+                                    dtype=y.dtype)
+            # straight-through estimator
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply_op(f, x, op_name="gumbel_softmax")
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True, name=None):
+    """Reference: vision.py affine_grid — [N,2,3] theta -> [N,H,W,2]
+    sampling grid in [-1, 1] coords."""
+    N, C, H, W = [int(s) for s in out_shape]
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, H)
+            xs = jnp.linspace(-1, 1, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+        return jnp.einsum("nij,hwj->nhwi", th, base)  # [N, H, W, 2]
+    return apply_op(f, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True,
+                name=None):
+    """Reference: vision.py grid_sample — sample NCHW ``x`` at ``grid``
+    [N,H',W',2] (x,y in [-1,1])."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unknown mode '{mode}'")
+
+    def f(img, g):
+        N, C, H, W = img.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) / 2 * (W - 1)
+            fy = (gy + 1) / 2 * (H - 1)
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, H - 1)
+            ixc = jnp.clip(ix, 0, W - 1)
+            out = jax.vmap(lambda im, yy, xx: im[:, yy, xx])(
+                img, iyc, ixc)  # [N, C, H', W']
+            if padding_mode == "zeros":
+                inside = ((iy >= 0) & (iy <= H - 1) & (ix >= 0)
+                          & (ix <= W - 1))
+                out = out * inside[:, None, :, :]
+            return out
+
+        if mode == "nearest":
+            return gather(jnp.round(fy).astype(jnp.int32),
+                          jnp.round(fx).astype(jnp.int32))
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        wx = fx - x0
+        wy = fy - y0
+        x0i = x0.astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        v00 = gather(y0i, x0i)
+        v01 = gather(y0i, x0i + 1)
+        v10 = gather(y0i + 1, x0i)
+        v11 = gather(y0i + 1, x0i + 1)
+        wx_ = wx[:, None]
+        wy_ = wy[:, None]
+        return (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+                + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    return apply_op(f, x, grid, op_name="grid_sample")
+
+
+# ------------------------------------------------------------------ losses
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def poisson_nll_loss(input, label, log_input: bool = True,
+                     full: bool = False, epsilon: float = 1e-8,
+                     reduction: str = "mean", name=None):
+    """Reference: loss.py poisson_nll_loss."""
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y) - y + 0.5 * jnp.log(2 * math.pi * y)
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op(f, input, label, op_name="poisson_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction: str = "mean", name=None):
+    """Reference: loss.py multi_label_soft_margin_loss."""
+    def f(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args, op_name="multi_label_soft_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum",
+                       name=None):
+    """Reference: loss.py sigmoid_focal_loss (RetinaNet loss)."""
+    def f(x, y, *norm):
+        p = jax.nn.sigmoid(x)
+        ce = -(y * jax.nn.log_sigmoid(x)
+               + (1 - y) * jax.nn.log_sigmoid(-x))
+        p_t = p * y + (1 - p) * (1 - y)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            a_t = alpha * y + (1 - alpha) * (1 - y)
+            loss = a_t * loss
+        if norm:
+            loss = loss / norm[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply_op(f, *args, op_name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon: float = 1e-5, name=None):
+    """Reference: loss.py dice_loss — input [N, ..., C] probabilities,
+    label [N, ..., 1] class ids."""
+    def f(x, y):
+        n_classes = x.shape[-1]
+        y_oh = jax.nn.one_hot(jnp.squeeze(y, -1), n_classes, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * y_oh, axis=red)
+        union = jnp.sum(x, axis=red) + jnp.sum(y_oh, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op(f, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002,
+               name=None):
+    """Reference: loss.py npair_loss."""
+    def f(a, p, y):
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) / 4
+        sim = a @ p.T  # [B, B]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, -1, keepdims=True)
+        ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, -1), -1)
+        return jnp.mean(ce) + reg
+    return apply_op(f, anchor, positive, labels, op_name="npair_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean",
+                      name=None):
+    """Reference: loss.py gaussian_nll_loss."""
+    def f(mu, y, var):
+        v = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(v) + (y - mu) ** 2 / v)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+    return apply_op(f, input, label, variance, op_name="gaussian_nll_loss")
+
+
+# ------------------------------------------------------------- max unpool
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          name=None):
+    """Max pool returning (output, flat H*W indices per channel) — the
+    producer side of max_unpool2d (reference: max_pool2d(return_mask=True)
+    backed by max_pool2d_with_index kernels)."""
+    if isinstance(kernel_size, int):
+        kh = kw = kernel_size
+    else:
+        kh, kw = kernel_size
+    if stride is None:
+        sh, sw = kh, kw
+    elif isinstance(stride, int):
+        sh = sw = stride
+    else:
+        sh, sw = stride
+    if isinstance(padding, int):
+        ph = pw = padding
+    else:
+        ph, pw = padding
+
+    def f(a):
+        N, C, H, W = a.shape
+        oh = (H + 2 * ph - kh) // sh + 1
+        ow = (W + 2 * pw - kw) // sw + 1
+        ry = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :] - ph
+        rx = jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :] - pw
+        valid = ((ry >= 0) & (ry < H))[:, None, :, None] & \
+            ((rx >= 0) & (rx < W))[None, :, None, :]  # [oh,ow,kh,kw]
+        ryc = jnp.clip(ry, 0, H - 1)
+        rxc = jnp.clip(rx, 0, W - 1)
+        patches = a[:, :, ryc[:, None, :, None],
+                    rxc[None, :, None, :]]  # [N,C,oh,ow,kh,kw]
+        neg = jnp.array(-jnp.inf, a.dtype)
+        patches = jnp.where(valid[None, None], patches, neg)
+        flat = patches.reshape(N, C, oh, ow, kh * kw)
+        arg = jnp.argmax(flat, -1)
+        out = jnp.max(flat, -1)
+        ky, kx = arg // kw, arg % kw
+        # absolute input coordinates of each max
+        iy = (jnp.arange(oh)[None, None, :, None] * sh - ph) + ky
+        ix = (jnp.arange(ow)[None, None, None, :] * sw - pw) + kx
+        idx = (iy * W + ix).astype(jnp.int32)
+        return out, idx
+    return apply_op(f, x, op_name="max_pool2d_with_index")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Reference: pooling.py max_unpool2d — scatter pooled values back to
+    the positions recorded in ``indices`` (flat H*W per channel)."""
+    if isinstance(kernel_size, int):
+        kh = kw = kernel_size
+    else:
+        kh, kw = kernel_size
+    if stride is None:
+        sh, sw = kh, kw
+    elif isinstance(stride, int):
+        sh = sw = stride
+    else:
+        sh, sw = stride
+
+    def f(a, idx):
+        N, C, oh, ow = a.shape
+        if output_size is not None:
+            H, W = (output_size[-2], output_size[-1])
+        else:
+            H = (oh - 1) * sh + kh - 2 * (padding if isinstance(
+                padding, int) else padding[0])
+            W = (ow - 1) * sw + kw - 2 * (padding if isinstance(
+                padding, int) else padding[1])
+        flat = jnp.zeros((N, C, H * W), a.dtype)
+        out = flat.at[
+            jnp.arange(N)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            idx.reshape(N, C, -1)].add(a.reshape(N, C, -1))
+        return out.reshape(N, C, H, W)
+    return apply_op(f, x, indices, op_name="max_unpool2d")

@@ -218,8 +218,9 @@ def dsplit(x, num_or_indices):
 
 
 def clip_(x, min=None, max=None):
-    """In-place clip (paddle clip_): rebinds the tensor's storage."""
-    x._data = jnp.clip(_d(x), min, max)
+    """In-place clip (paddle clip_): rebinds the tensor's storage,
+    preserving dtype (an int tensor stays int, like paddle)."""
+    x._data = jnp.clip(_d(x), min, max).astype(x._data.dtype)
     x._version += 1
     return x
 

@@ -1,0 +1,158 @@
+"""nn.functional long tail — torch CPU and analytic oracles."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def _t(x):
+    return pt.to_tensor(np.asarray(x))
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def test_pad_modes_vs_torch():
+    x = np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4)
+    for mode, tmode in [("constant", "constant"), ("reflect", "reflect"),
+                        ("replicate", "replicate"),
+                        ("circular", "circular")]:
+        got = _np(F.pad(_t(x), [1, 2, 1, 0], mode=mode, value=9.0))
+        want = TF.pad(torch.tensor(x), (1, 2, 1, 0), mode=tmode,
+                      value=9.0 if mode == "constant" else 0.0).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=mode)
+    # full-rank pad list
+    got = _np(F.pad(_t(x), [0, 0, 0, 1, 2, 0, 0, 3]))
+    assert got.shape == (1, 3, 5, 7)
+
+
+def test_zeropad2d():
+    x = np.ones((1, 1, 2, 2), np.float32)
+    out = _np(F.zeropad2d(_t(x), [1, 1, 2, 0]))
+    assert out.shape == (1, 1, 4, 4)
+    assert out.sum() == 4.0
+
+
+def test_diag_embed_vs_torch():
+    x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(_np(F.diag_embed(_t(x))),
+                               torch.diag_embed(torch.tensor(x)).numpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        _np(F.diag_embed(_t(x), offset=1)),
+        torch.diag_embed(torch.tensor(x), offset=1).numpy(), rtol=1e-6)
+
+
+def test_gumbel_softmax_hard_is_onehot_and_differentiable():
+    pt.seed(0)
+    x = _t(np.random.RandomState(1).randn(4, 6).astype(np.float32))
+    x.stop_gradient = False
+    y = F.gumbel_softmax(x, temperature=0.5, hard=True)
+    arr = _np(y)
+    np.testing.assert_allclose(arr.sum(-1), 1.0, rtol=1e-5)
+    assert ((arr == 0) | (np.isclose(arr, 1.0))).all()
+    pt.ops.sum(pt.ops.multiply(y, y)).backward()  # straight-through grads
+    assert x.grad is not None
+
+
+def test_affine_grid_and_grid_sample_identity_vs_torch():
+    x = np.random.RandomState(2).randn(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32),
+                    (2, 1, 1))
+    grid = F.affine_grid(_t(theta), [2, 3, 5, 7], align_corners=True)
+    tgrid = TF.affine_grid(torch.tensor(theta), [2, 3, 5, 7],
+                           align_corners=True)
+    np.testing.assert_allclose(_np(grid), tgrid.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    out = F.grid_sample(_t(x), grid, align_corners=True)
+    tout = TF.grid_sample(torch.tensor(x), tgrid, align_corners=True)
+    np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    # identity transform reproduces the input
+    np.testing.assert_allclose(_np(out), x, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sample_rotation_vs_torch():
+    x = np.random.RandomState(3).randn(1, 2, 8, 8).astype(np.float32)
+    th = np.array([[[0.0, -1.0, 0.1], [1.0, 0.0, -0.2]]], np.float32)
+    for ac in (True, False):
+        grid = F.affine_grid(_t(th), [1, 2, 8, 8], align_corners=ac)
+        out = F.grid_sample(_t(x), grid, align_corners=ac)
+        tg = TF.affine_grid(torch.tensor(th), [1, 2, 8, 8],
+                            align_corners=ac)
+        tout = TF.grid_sample(torch.tensor(x), tg, align_corners=ac)
+        np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-3,
+                                   atol=1e-4, err_msg=f"ac={ac}")
+
+
+def test_losses_vs_torch():
+    rng = np.random.RandomState(4)
+    x = rng.randn(6, 5).astype(np.float32)
+    y = (rng.rand(6, 5) > 0.5).astype(np.float32)
+    np.testing.assert_allclose(
+        float(_np(F.multi_label_soft_margin_loss(_t(x), _t(y)))),
+        TF.multilabel_soft_margin_loss(torch.tensor(x),
+                                       torch.tensor(y)).item(),
+        rtol=1e-5)
+
+    logx = rng.rand(8).astype(np.float32)
+    tgt = rng.poisson(2.0, 8).astype(np.float32)
+    np.testing.assert_allclose(
+        float(_np(F.poisson_nll_loss(_t(logx), _t(tgt)))),
+        TF.poisson_nll_loss(torch.tensor(logx),
+                            torch.tensor(tgt)).item(), rtol=1e-5)
+
+    mu = rng.randn(8).astype(np.float32)
+    var = rng.rand(8).astype(np.float32) + 0.1
+    tgt2 = rng.randn(8).astype(np.float32)
+    np.testing.assert_allclose(
+        float(_np(F.gaussian_nll_loss(_t(mu), _t(tgt2), _t(var)))),
+        TF.gaussian_nll_loss(torch.tensor(mu), torch.tensor(tgt2),
+                             torch.tensor(var)).item(), rtol=1e-4)
+
+
+def test_sigmoid_focal_loss_matches_torchvision_formula():
+    rng = np.random.RandomState(5)
+    x = rng.randn(10).astype(np.float32)
+    y = (rng.rand(10) > 0.5).astype(np.float32)
+    got = float(_np(F.sigmoid_focal_loss(_t(x), _t(y), reduction="sum")))
+    # reference formula oracle
+    p = 1 / (1 + np.exp(-x))
+    ce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    p_t = p * y + (1 - p) * (1 - y)
+    want = (0.25 * y + 0.75 * (1 - y)) * ce * (1 - p_t) ** 2
+    np.testing.assert_allclose(got, want.sum(), rtol=1e-4)
+
+
+def test_dice_loss_perfect_prediction_is_zero():
+    label = np.array([[[0], [1], [2]]], np.int64)  # [1, 3, 1]
+    probs = np.eye(3, dtype=np.float32)[label[..., 0]]  # [1, 3, 3]
+    loss = float(_np(F.dice_loss(_t(probs), _t(label))))
+    assert loss < 1e-4
+
+
+def test_npair_loss_runs_and_separates():
+    a = np.eye(4, dtype=np.float32)
+    p = np.eye(4, dtype=np.float32)
+    y = np.arange(4, dtype=np.int64)
+    aligned = float(_np(F.npair_loss(_t(a), _t(p), _t(y))))
+    shuffled = float(_np(F.npair_loss(_t(a), _t(np.roll(p, 1, 0)),
+                                      _t(y))))
+    assert aligned < shuffled
+
+
+def test_max_pool_index_unpool_roundtrip_vs_torch():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    out, idx = F.max_pool2d_with_index(_t(x), 2, stride=2)
+    tout, tidx = TF.max_pool2d(torch.tensor(x), 2, stride=2,
+                               return_indices=True)
+    np.testing.assert_allclose(_np(out), tout.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(_np(idx), tidx.numpy())
+    un = F.max_unpool2d(out, idx, 2, stride=2)
+    tun = TF.max_unpool2d(tout, tidx, 2, stride=2)
+    np.testing.assert_allclose(_np(un), tun.numpy(), rtol=1e-6)

@@ -182,3 +182,19 @@ def test_inplace_preserves_dtype_and_seeded_uniform():
     a = pt.to_tensor(np.zeros(16, np.float32)).uniform_(0, 1, seed=42)
     b = pt.to_tensor(np.zeros(16, np.float32)).uniform_(0, 1, seed=42)
     np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+
+
+def test_inplace_shape_guard_and_clip_dtype():
+    import numpy as np
+    import paddle_tpu as pt
+    t = pt.to_tensor(np.array([1.0], np.float32))
+    import pytest
+    with pytest.raises(ValueError, match="shape"):
+        t.add_(pt.to_tensor(np.ones((2, 3), np.float32)))
+    ti = pt.to_tensor(np.array([1, 2, 3], np.int32))
+    ti.clip_(min=0.5, max=2.5)
+    assert ti.dtype.name == "int32"
+    # seed parity with ops.uniform
+    a = pt.to_tensor(np.zeros(4, np.float32)).uniform_(0, 1, seed=7)
+    b = pt.uniform([4], min=0.0, max=1.0, seed=7)
+    np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
