@@ -21,40 +21,15 @@ __all__ = [
     "max_pool2d_with_index", "max_unpool2d",
 ]
 
-_PAD_MODES = {"constant": "constant", "reflect": "reflect",
-              "replicate": "edge", "circular": "wrap"}
-
-
 def pad(x, pad, mode: str = "constant", value: float = 0.0,
         data_format: str = "NCHW", name=None):
-    """Reference: nn/functional/common.py pad.
-
-    ``pad`` is the paddle convention: for rank-n input either
-    ``len(pad) == 2n`` ([lo, hi] per dim, innermost LAST like torch) or,
-    for NCHW/NCDHW-style data, a spatial-only list ([left, right, top,
-    bottom, ...]).
-    """
-    if mode not in _PAD_MODES:
+    """Reference: nn/functional/common.py pad — delegates to the single
+    pad implementation in ops.manipulation (paddle spatial-list or
+    full-rank [lo, hi]-per-dim conventions)."""
+    if mode not in ("constant", "reflect", "replicate", "circular"):
         raise ValueError(f"unknown pad mode '{mode}'")
-    np_mode = _PAD_MODES[mode]
-
-    def f(a):
-        nd = a.ndim
-        p = list(int(v) for v in pad)
-        if len(p) == 2 * nd:
-            cfg = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
-        else:
-            # spatial-only: innermost dim FIRST in the list (paddle/torch)
-            n_spatial = len(p) // 2
-            cfg = [(0, 0)] * nd
-            channel_last = data_format.endswith("C")
-            for i in range(n_spatial):
-                axis = (nd - 1 - i) if not channel_last else (nd - 2 - i)
-                cfg[axis] = (p[2 * i], p[2 * i + 1])
-        if np_mode == "constant":
-            return jnp.pad(a, cfg, mode="constant", constant_values=value)
-        return jnp.pad(a, cfg, mode=np_mode)
-    return apply_op(f, x, op_name="pad")
+    from paddle_tpu.ops import manipulation as _m
+    return _m.pad(x, pad, mode=mode, value=value, data_format=data_format)
 
 
 def zeropad2d(x, padding, data_format: str = "NCHW", name=None):
@@ -80,7 +55,6 @@ def diag_embed(input, offset: int = 0, dim1: int = -2, dim2: int = -1):
         d2 = dim2 % nd
         perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
         order = []
-        src = iter([nd - 2, nd - 1])
         pi = iter(perm)
         for i in range(nd):
             if i == d1:
@@ -139,6 +113,9 @@ def grid_sample(x, grid, mode: str = "bilinear",
     [N,H',W',2] (x,y in [-1,1])."""
     if mode not in ("bilinear", "nearest"):
         raise ValueError(f"unknown mode '{mode}'")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"padding_mode '{padding_mode}' not supported (zeros/border)")
 
     def f(img, g):
         N, C, H, W = img.shape
@@ -183,11 +160,10 @@ def grid_sample(x, grid, mode: str = "bilinear",
 
 # ------------------------------------------------------------------ losses
 def _reduce(loss, reduction):
-    if reduction == "mean":
-        return jnp.mean(loss)
-    if reduction == "sum":
-        return jnp.sum(loss)
-    return loss
+    # canonical helper lives in nn.functional (deferred import: this
+    # module is imported at the end of functional.py's own init)
+    from paddle_tpu.nn import functional as _f
+    return _f._reduce(loss, reduction)
 
 
 def poisson_nll_loss(input, label, log_input: bool = True,
@@ -330,6 +306,8 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  output_size=None, data_format="NCHW", name=None):
     """Reference: pooling.py max_unpool2d — scatter pooled values back to
     the positions recorded in ``indices`` (flat H*W per channel)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d supports NCHW only")
     if isinstance(kernel_size, int):
         kh = kw = kernel_size
     else:
@@ -351,9 +329,11 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
             W = (ow - 1) * sw + kw - 2 * (padding if isinstance(
                 padding, int) else padding[1])
         flat = jnp.zeros((N, C, H * W), a.dtype)
+        # .set, not .add: overlapping windows record the same max index
+        # several times and torch/paddle write the value once
         out = flat.at[
             jnp.arange(N)[:, None, None],
             jnp.arange(C)[None, :, None],
-            idx.reshape(N, C, -1)].add(a.reshape(N, C, -1))
+            idx.reshape(N, C, -1)].set(a.reshape(N, C, -1))
         return out.reshape(N, C, H, W)
     return apply_op(f, x, indices, op_name="max_unpool2d")

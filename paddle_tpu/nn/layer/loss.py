@@ -4,7 +4,8 @@ from __future__ import annotations
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layer_base import Layer
 
-__all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
+__all__ = ["PoissonNLLLoss", "GaussianNLLLoss", "MultiLabelSoftMarginLoss",
+           "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "SmoothL1Loss", "KLDivLoss",
            "MarginRankingLoss", "CTCLoss", "CosineEmbeddingLoss",
            "TripletMarginLoss", "HingeEmbeddingLoss", "SoftMarginLoss",
@@ -189,3 +190,48 @@ class LogLoss(Layer):
 
     def forward(self, input, label):
         return F.log_loss(input, label, self._epsilon)
+
+
+class PoissonNLLLoss(Layer):
+    """Reference: nn/layer/loss.py PoissonNLLLoss."""
+
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._log_input = log_input
+        self._full = full
+        self._epsilon = epsilon
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, self._log_input,
+                                  self._full, self._epsilon,
+                                  self._reduction)
+
+
+class GaussianNLLLoss(Layer):
+    """Reference: nn/layer/loss.py GaussianNLLLoss."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._full = full
+        self._epsilon = epsilon
+        self._reduction = reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self._full,
+                                   self._epsilon, self._reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    """Reference: nn/layer/loss.py MultiLabelSoftMarginLoss."""
+
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._weight = weight
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self._weight,
+                                              self._reduction)

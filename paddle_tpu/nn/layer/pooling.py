@@ -4,7 +4,8 @@ from __future__ import annotations
 from paddle_tpu.nn import functional as F
 from paddle_tpu.nn.layer_base import Layer
 
-__all__ = ["MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+__all__ = ["MaxUnPool2D",
+           "MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
            "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
            "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
            "AdaptiveMaxPool3D"]
@@ -135,3 +136,22 @@ class AdaptiveMaxPool3D(_AdaptivePoolNd):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self._output_size,
                                      data_format=self._data_format)
+
+
+class MaxUnPool2D(Layer):
+    """Reference: nn/layer/pooling.py MaxUnPool2D — inverse of
+    max_pool2d given the recorded indices."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._data_format = data_format
+        self._output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self._kernel_size, self._stride,
+                              self._padding, self._output_size,
+                              self._data_format)

@@ -218,11 +218,9 @@ def dsplit(x, num_or_indices):
 
 
 def clip_(x, min=None, max=None):
-    """In-place clip (paddle clip_): rebinds the tensor's storage,
-    preserving dtype (an int tensor stays int, like paddle)."""
-    x._data = jnp.clip(_d(x), min, max).astype(x._data.dtype)
-    x._version += 1
-    return x
+    """In-place clip (paddle clip_) — delegates to Tensor.clip_ (the
+    single dtype/shape-preserving implementation)."""
+    return x.clip_(min, max)
 
 
 def increment(x, value=1.0):

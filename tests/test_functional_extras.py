@@ -156,3 +156,40 @@ def test_max_pool_index_unpool_roundtrip_vs_torch():
     un = F.max_unpool2d(out, idx, 2, stride=2)
     tun = TF.max_unpool2d(tout, tidx, 2, stride=2)
     np.testing.assert_allclose(_np(un), tun.numpy(), rtol=1e-6)
+
+
+def test_new_layer_classes():
+    import paddle_tpu.nn as nn
+    rng = np.random.RandomState(7)
+    x = _t(rng.randn(2, 3, 8, 8).astype(np.float32))
+    out, idx = F.max_pool2d_with_index(x, 2, stride=2)
+    un = nn.MaxUnPool2D(2, stride=2)(out, idx)
+    assert list(un.shape) == [2, 3, 8, 8]
+
+    mu = _t(rng.randn(8).astype(np.float32))
+    var = _t((rng.rand(8) + 0.1).astype(np.float32))
+    y = _t(rng.randn(8).astype(np.float32))
+    l1 = nn.GaussianNLLLoss()(mu, y, var)
+    assert np.isfinite(float(_np(l1)))
+    l2 = nn.PoissonNLLLoss()(_t(rng.rand(8).astype(np.float32)),
+                             _t(rng.poisson(2.0, 8).astype(np.float32)))
+    assert np.isfinite(float(_np(l2)))
+    l3 = nn.MultiLabelSoftMarginLoss()(
+        _t(rng.randn(4, 5).astype(np.float32)),
+        _t((rng.rand(4, 5) > 0.5).astype(np.float32)))
+    assert np.isfinite(float(_np(l3)))
+
+
+def test_unpool_overlapping_windows_write_once():
+    # kernel 2 stride 1: the center max is recorded by several windows;
+    # unpool must write v, not k*v
+    x = np.zeros((1, 1, 3, 3), np.float32)
+    x[0, 0, 1, 1] = 5.0
+    out, idx = F.max_pool2d_with_index(_t(x), 2, stride=1)
+    un = _np(F.max_unpool2d(out, idx, 2, stride=1))
+    assert un[0, 0, 1, 1] == 5.0
+    with pytest.raises(NotImplementedError):
+        F.max_unpool2d(out, idx, 2, stride=1, data_format="NHWC")
+    with pytest.raises(NotImplementedError):
+        F.grid_sample(_t(x), _t(np.zeros((1, 3, 3, 2), np.float32)),
+                      padding_mode="reflection")
