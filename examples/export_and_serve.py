@@ -1,0 +1,32 @@
+"""Export the flagship model as an XLA artifact and serve it with the
+inference Predictor (the TensorRT/ONNX-engine analog). Run:
+    python examples/export_and_serve.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Config, Predictor
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.static import InputSpec
+
+
+def main():
+    import os
+    import tempfile
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
+    model.eval()
+    path = os.path.join(tempfile.mkdtemp(prefix="llama_serving_"),
+                        "model")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([2, 16], "int32")])
+    print("exported to", path)
+
+    predictor = Predictor(Config(path))
+    ids = np.random.RandomState(0).randint(0, 256, (2, 16)).astype(np.int32)
+    (logits,) = predictor.run([ids])
+    print("served logits:", np.asarray(logits).shape)
+
+
+if __name__ == "__main__":
+    main()
