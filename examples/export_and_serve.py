@@ -16,16 +16,16 @@ def main():
     paddle.seed(0)
     model = LlamaForCausalLM(LlamaConfig.tiny(num_hidden_layers=1))
     model.eval()
-    path = os.path.join(tempfile.mkdtemp(prefix="llama_serving_"),
-                        "model")
-    paddle.jit.save(model, path,
-                    input_spec=[InputSpec([2, 16], "int32")])
-    print("exported to", path)
+    with tempfile.TemporaryDirectory(prefix="llama_serving_") as tmp:
+        path = os.path.join(tmp, "model")
+        paddle.jit.save(model, path,
+                        input_spec=[InputSpec([2, 16], "int32")])
+        print("exported to", path)
 
-    predictor = Predictor(Config(path))
-    ids = np.random.RandomState(0).randint(0, 256, (2, 16)).astype(np.int32)
-    (logits,) = predictor.run([ids])
-    print("served logits:", np.asarray(logits).shape)
+        predictor = Predictor(Config(path))
+        ids = np.random.RandomState(0).randint(0, 256, (2, 16))             .astype(np.int32)
+        (logits,) = predictor.run([ids])
+        print("served logits:", np.asarray(logits).shape)
 
 
 if __name__ == "__main__":

@@ -20,7 +20,7 @@ from paddle_tpu.nn.layer_base import Layer
 
 __all__ = ["FusedLinear", "FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer", "FusedMultiTransformer",
-           "FusedEcMoe"]
+           "FusedEcMoe", "memory_efficient_attention"]
 
 
 class FusedLinear(Layer):
@@ -252,3 +252,22 @@ class FusedEcMoe(Layer):
             return jnp.einsum("...e,...ed->...d", probs, y)
         return apply_op(f, x, gate, self.moe.w1, self.moe.b1, self.moe.w2,
                         self.moe.b2, op_name="fused_ec_moe")
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """Reference: ``python/paddle/incubate/nn/memory_efficient_attention
+    .py:67`` (cutlass kernel). On TPU the memory-efficient path IS the
+    Pallas flash kernel — same O(S) memory property."""
+    import math as _math
+    if scale is not None:
+        # the inner attention scales by 1/sqrt(d); pre-scaling q by
+        # scale*sqrt(d) yields logits of exactly scale * q.k
+        d = int(query.shape[-1])
+        query = ops.scale(query, scale * _math.sqrt(d))
+    if attn_bias is not None:
+        return F.scaled_dot_product_attention(
+            query, key, value, attn_mask=attn_bias, dropout_p=p,
+            training=training)
+    return F.flash_attention(query, key, value, dropout=p,
+                             training=training)

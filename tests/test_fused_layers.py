@@ -93,3 +93,32 @@ def test_tensor_array_ops():
         np.asarray(pt.ops.array_read(arr, 0).data), np.zeros(3))
     with pytest.raises(IndexError):
         pt.ops.array_write(a, 5, arr)
+
+
+def test_memory_efficient_attention_alias():
+    from paddle_tpu.incubate.nn import memory_efficient_attention
+    rng = np.random.RandomState(8)
+    q = pt.to_tensor(rng.randn(2, 8, 4, 16).astype(np.float32))
+    k = pt.to_tensor(rng.randn(2, 8, 4, 16).astype(np.float32))
+    v = pt.to_tensor(rng.randn(2, 8, 4, 16).astype(np.float32))
+    out = memory_efficient_attention(q, k, v, training=False)
+    assert list(out.shape) == [2, 8, 4, 16]
+    # matches the plain SDPA path
+    import paddle_tpu.nn.functional as F
+    want = F.flash_attention(q, k, v, training=False)
+    np.testing.assert_allclose(np.asarray(out.data),
+                               np.asarray(want.data), rtol=1e-5)
+
+
+def test_memory_efficient_attention_scale():
+    from paddle_tpu.incubate.nn import memory_efficient_attention
+    rng = np.random.RandomState(9)
+    q = pt.to_tensor(rng.randn(1, 4, 2, 16).astype(np.float32))
+    k = pt.to_tensor(rng.randn(1, 4, 2, 16).astype(np.float32))
+    v = pt.to_tensor(rng.randn(1, 4, 2, 16).astype(np.float32))
+    # scale=0 -> uniform attention weights -> output = mean over keys
+    out = memory_efficient_attention(q, k, v, scale=0.0, training=False)
+    want = np.asarray(v.data).mean(axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(out.data),
+                               np.broadcast_to(want, out.shape),
+                               rtol=1e-5, atol=1e-6)
