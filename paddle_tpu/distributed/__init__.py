@@ -34,3 +34,10 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import Engine, Strategy  # noqa: F401
 from . import rpc  # noqa: F401
 from .fleet.utils import recompute  # noqa: F401
+from . import launch  # noqa: F401
+from .communication import stream  # noqa: F401
+from .compat import (  # noqa: F401
+    P2POp, batch_isend_irecv, broadcast_object_list, destroy_process_group,
+    gather, get_backend, irecv, is_initialized, isend, scatter_object_list,
+    spawn, split, wait,
+)

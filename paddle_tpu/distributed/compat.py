@@ -1,0 +1,215 @@
+"""Remaining paddle.distributed surface (reference:
+``python/paddle/distributed/__init__.py`` exports) — process-group
+queries, async p2p wrappers, object collectives, spawn.
+
+Single-controller SPMD notes: under jax one host process drives every
+local device, so single-process object collectives are identities and
+"async" p2p completes on dispatch (XLA schedules the transfer); the task
+objects exist for API parity, like communication.stream.
+"""
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from . import collective as C
+from .env import get_rank, get_world_size
+
+__all__ = ["is_initialized", "destroy_process_group", "get_backend",
+           "wait", "gather", "isend", "irecv", "P2POp",
+           "batch_isend_irecv", "broadcast_object_list",
+           "scatter_object_list", "split", "spawn"]
+
+def is_initialized() -> bool:
+    """Reference: parallel.py is_initialized — True once
+    init_parallel_env (or fleet.init) built the mesh."""
+    from . import env
+    from .mesh import get_mesh
+    return env._initialized["done"] or get_mesh() is not None
+
+
+def destroy_process_group(group=None):
+    """Reference: parallel.py destroy_process_group — tears down the mesh
+    AND resets init_parallel_env's guard so a later init rebuilds it."""
+    from . import env
+    from .mesh import set_mesh
+    if group is None:
+        set_mesh(None)
+        env._initialized["done"] = False
+
+
+def get_backend(group=None) -> str:
+    """The communication backend name — XLA collectives over ICI/DCN
+    (the NCCL/GLOO analog)."""
+    return "XCCL"
+
+
+class _Task:
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        import jax
+        r = self._result
+        if r is not None and hasattr(r, "data"):
+            jax.block_until_ready(r.data)
+        return r
+
+    def is_completed(self) -> bool:
+        return True
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True):
+    """Reference: communication/wait.py — block until ``tensor`` is
+    materialized."""
+    import jax
+    if tensor is not None and hasattr(tensor, "data"):
+        jax.block_until_ready(tensor.data)
+    return tensor
+
+
+def gather(tensor, gather_list: Optional[list] = None, dst: int = 0,
+           group=None, sync_op: bool = True):
+    """Reference: communication/gather.py — collect shards to ``dst``.
+    Under SPMD every rank computes the gathered value (an all-gather);
+    the reference contract of dst-only results is relaxed to
+    everyone-gets-it, which is strictly more available."""
+    parts: list = []
+    C.all_gather(parts, tensor, group=group)  # list form: per-rank shards
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(parts)
+    return parts
+
+
+def isend(tensor, dst: int = 0, group=None) -> _Task:
+    """Reference: communication/send.py isend. Raw p2p has no XLA analog
+    outside an spmd region (same contract as dist.send): use
+    ``dist.p2p_shift`` (collective_permute) — the PP engine does."""
+    return _Task(C.send(tensor, dst=dst, group=group))
+
+
+def irecv(tensor, src: int = 0, group=None) -> _Task:
+    """Reference: communication/recv.py irecv (see :func:`isend`)."""
+    return _Task(C.recv(tensor, src=src, group=group))
+
+
+@dataclass
+class P2POp:
+    """Reference: communication/batch_isend_irecv.py P2POp."""
+    op: Callable
+    tensor: object
+    peer: int
+    group: object = None
+
+
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[_Task]:
+    """Reference: batch_isend_irecv — issue a batch of p2p ops; XLA
+    schedules them together inside the compiled program."""
+    tasks = []
+    for p in p2p_op_list:
+        if p.op in (isend, C.send):
+            tasks.append(isend(p.tensor, dst=p.peer, group=p.group))
+        elif p.op in (irecv, C.recv):
+            tasks.append(irecv(p.tensor, src=p.peer, group=p.group))
+        else:
+            raise ValueError(f"P2POp.op must be isend/irecv, got {p.op}")
+    return tasks
+
+
+def _single_process() -> bool:
+    import jax
+    return jax.process_count() == 1
+
+
+def broadcast_object_list(object_list: list, src: int = 0, group=None):
+    """Reference: communication/broadcast.py broadcast_object_list.
+    Single-controller: the src host's objects already are everyone's
+    objects; multi-host goes through the job store (planned with the
+    DCN bring-up, like all_gather_object)."""
+    if _single_process():
+        return None
+    raise NotImplementedError(
+        "multi-host broadcast_object_list requires the DCN store")
+
+
+def scatter_object_list(out_object_list: list, in_object_list=None,
+                        src: int = 0, group=None):
+    """Reference: communication/scatter.py scatter_object_list."""
+    if _single_process():
+        rank = get_rank(group)
+        out_object_list.clear()
+        if in_object_list:
+            out_object_list.append(in_object_list[rank
+                                                  % len(in_object_list)])
+        return None
+    raise NotImplementedError(
+        "multi-host scatter_object_list requires the DCN store")
+
+
+def split(x, size, operation: str = "linear", axis: int = 0, num_partitions=1,
+          gather_out: bool = True, weight_attr=None, bias_attr=None,
+          name=None):
+    """Reference: fleet/layers/mpu/mp_ops.py:653 paddle.distributed.split
+    — build a row/column-parallel linear or vocab-parallel embedding from
+    a plain op call. Delegates to the mpu layers (the dygraph analog)."""
+    from .fleet import (ColumnParallelLinear, RowParallelLinear,
+                        VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        else:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        out = layer(x)
+        out._split_layer = layer  # keep params alive with the output
+        return out
+    if operation == "embedding":
+        vocab, hidden = size
+        layer = VocabParallelEmbedding(vocab, hidden,
+                                       weight_attr=weight_attr)
+        out = layer(x)
+        out._split_layer = layer
+        return out
+    raise ValueError(f"unknown operation '{operation}'")
+
+
+def _spawn_entry(func, rank, nprocs, args):
+    import os
+    # the reference launcher's env contract: workers discover their rank
+    # through PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM (env.get_rank reads
+    # these), then call func(*args) — paddle's spawn signature
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
+def spawn(func: Callable, args=(), nprocs: int = -1, join: bool = True,
+          **options):
+    """Reference: spawn.py paddle.distributed.spawn — start ``nprocs``
+    worker processes running ``func(*args)`` with per-worker
+    PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM set (rank comes from
+    ``dist.get_rank()``, matching the reference contract)."""
+    import multiprocessing as mp
+    if nprocs <= 0:
+        import jax
+        nprocs = jax.device_count()
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_entry,
+                        args=(func, rank, nprocs, tuple(args)))
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode != 0]
+        if bad:
+            raise RuntimeError(f"spawned workers failed: exit {bad}")
+    return procs
