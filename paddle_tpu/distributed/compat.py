@@ -3,9 +3,9 @@
 queries, async p2p wrappers, object collectives, spawn.
 
 Single-controller SPMD notes: under jax one host process drives every
-local device, so single-process object collectives are identities and
-"async" p2p completes on dispatch (XLA schedules the transfer); the task
-objects exist for API parity, like communication.stream.
+local device, so single-process object collectives are identities. Raw
+p2p (isend/irecv) keeps dist.send's honest contract — it has no XLA
+analog outside an spmd region and raises, pointing at ``p2p_shift``.
 """
 from __future__ import annotations
 
@@ -34,30 +34,18 @@ def destroy_process_group(group=None):
     AND resets init_parallel_env's guard so a later init rebuilds it."""
     from . import env
     from .mesh import set_mesh
-    if group is None:
-        set_mesh(None)
-        env._initialized["done"] = False
+    if group is not None:
+        raise NotImplementedError(
+            "per-group destruction is not supported; groups are mesh-axis "
+            "views — destroy the whole process group (group=None)")
+    set_mesh(None)
+    env._initialized["done"] = False
 
 
 def get_backend(group=None) -> str:
     """The communication backend name — XLA collectives over ICI/DCN
     (the NCCL/GLOO analog)."""
     return "XCCL"
-
-
-class _Task:
-    def __init__(self, result=None):
-        self._result = result
-
-    def wait(self):
-        import jax
-        r = self._result
-        if r is not None and hasattr(r, "data"):
-            jax.block_until_ready(r.data)
-        return r
-
-    def is_completed(self) -> bool:
-        return True
 
 
 def wait(tensor, group=None, use_calc_stream: bool = True):
@@ -83,16 +71,16 @@ def gather(tensor, gather_list: Optional[list] = None, dst: int = 0,
     return parts
 
 
-def isend(tensor, dst: int = 0, group=None) -> _Task:
+def isend(tensor, dst: int = 0, group=None):
     """Reference: communication/send.py isend. Raw p2p has no XLA analog
     outside an spmd region (same contract as dist.send): use
     ``dist.p2p_shift`` (collective_permute) — the PP engine does."""
-    return _Task(C.send(tensor, dst=dst, group=group))
+    return C.send(tensor, dst=dst, group=group)
 
 
-def irecv(tensor, src: int = 0, group=None) -> _Task:
+def irecv(tensor, src: int = 0, group=None):
     """Reference: communication/recv.py irecv (see :func:`isend`)."""
-    return _Task(C.recv(tensor, src=src, group=group))
+    return C.recv(tensor, src=src, group=group)
 
 
 @dataclass
@@ -104,7 +92,7 @@ class P2POp:
     group: object = None
 
 
-def batch_isend_irecv(p2p_op_list: List[P2POp]) -> List[_Task]:
+def batch_isend_irecv(p2p_op_list: List[P2POp]) -> list:
     """Reference: batch_isend_irecv — issue a batch of p2p ops; XLA
     schedules them together inside the compiled program."""
     tasks = []
@@ -153,7 +141,13 @@ def split(x, size, operation: str = "linear", axis: int = 0, num_partitions=1,
           name=None):
     """Reference: fleet/layers/mpu/mp_ops.py:653 paddle.distributed.split
     — build a row/column-parallel linear or vocab-parallel embedding from
-    a plain op call. Delegates to the mpu layers (the dygraph analog)."""
+    a plain op call. Delegates to the mpu layers (the dygraph analog).
+
+    NOTE: like the reference's static-mode split, each call CREATES the
+    parallel layer (fresh parameters). Call it once at model-build time
+    and keep ``out._split_layer`` (register it on your Layer) so the
+    parameters reach the optimizer; calling split per step would
+    re-initialize weights every step."""
     from .fleet import (ColumnParallelLinear, RowParallelLinear,
                         VocabParallelEmbedding)
     if operation == "linear":

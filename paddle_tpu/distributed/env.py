@@ -48,21 +48,32 @@ def init_parallel_env(mesh_shape: Optional[dict] = None):
 
 
 def get_rank(group=None) -> int:
-    """Host process index (reference: paddle.distributed.get_rank).
+    """Host process rank (reference: paddle.distributed.get_rank).
 
-    Under SPMD one process drives many devices; this is the *process* rank
-    (device-level rank only exists inside shard_map, via lax.axis_index).
+    The launcher/spawn env contract wins when present (PADDLE_TRAINER_ID,
+    exactly like the reference reads it); otherwise the PJRT process
+    index. Under SPMD one process drives many devices; device-level rank
+    only exists inside shard_map, via lax.axis_index.
     """
+    import os
+    env = os.environ.get("PADDLE_TRAINER_ID")
+    if env is not None:
+        return int(env)
     import jax
     return jax.process_index()
 
 
 def get_world_size(group=None) -> int:
-    """Total device count across the job (paddle world-size semantics map to
-    chips on TPU — each chip was a paddle "rank")."""
-    import jax
+    """Total worker count: the launcher env contract (PADDLE_TRAINERS_NUM)
+    when present, else the device count (paddle world-size semantics map
+    to chips on TPU — each chip was a paddle "rank")."""
     if group is not None and hasattr(group, "nranks"):
         return group.nranks
+    import os
+    env = os.environ.get("PADDLE_TRAINERS_NUM")
+    if env is not None:
+        return int(env)
+    import jax
     return jax.device_count()
 
 

@@ -169,10 +169,6 @@ class LlamaAttention(nn.Layer):
             return self.o_proj(ops.reshape(out, [B, S, -1]))
         past_k, past_v = cache
         P = 0 if past_k is None else past_k.shape[1]
-        if S > 1 and P > 0:
-            raise NotImplementedError(
-                "chunked prefill with an existing cache is not supported; "
-                "prefill once, then decode token-by-token")
         q, k = apply_rotary(q, k, self.cfg.rope_theta, pos_offset=P,
                             table_len=self.cfg.max_position_embeddings)
         if P:
@@ -181,9 +177,11 @@ class LlamaAttention(nn.Layer):
         else:
             k_all, v_all = k, v
         ke, ve = self._expand_kv(k_all, v_all)
-        # prefill (P == 0): causal over the prompt; decode (S == 1): the
-        # single query attends the whole prefix
-        out = F.scaled_dot_product_attention(q, ke, ve, is_causal=(S > 1))
+        # offset-causal over [S queries x P+S keys]: query j (absolute
+        # position P+j) sees keys <= P+j — covers full prefill (P=0),
+        # CHUNKED prefill (P>0, S>1), and decode (S=1: all keys) in one
+        # mask (sdpa's tril offset is s_k - s_q = P)
+        out = F.scaled_dot_product_attention(q, ke, ve, is_causal=True)
         return self.o_proj(ops.reshape(out, [B, S, -1])), (k_all, v_all)
 
 

@@ -318,3 +318,23 @@ def _spawn_worker(marker):
     import os
     rank = os.environ["PADDLE_TRAINER_ID"]
     open(marker + rank, "w").write("ok")
+
+
+def test_spawn_workers_see_their_rank():
+    """Regression: dist.get_rank()/get_world_size() inside spawned
+    workers honor the injected launcher env (the documented contract)."""
+    import os
+    import paddle_tpu.distributed as dist
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        marker = os.path.join(tmp, "r")
+        dist.spawn(_rank_worker, args=(marker,), nprocs=2)
+        got = {open(marker + str(i)).read() for i in range(2)}
+        assert got == {"0/2", "1/2"}
+
+
+def _rank_worker(marker):
+    import os
+    import paddle_tpu.distributed as dist
+    r, w = dist.get_rank(), dist.get_world_size()
+    open(marker + os.environ["PADDLE_TRAINER_ID"], "w").write(f"{r}/{w}")

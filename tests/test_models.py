@@ -247,3 +247,23 @@ def test_moe_generate_kv_cache():
         lg = model.lm_head(h)
         np.testing.assert_allclose(np.asarray(lg.data)[:, 0], full[:, t],
                                    rtol=3e-3, atol=3e-3, err_msg=f"t={t}")
+
+
+def test_llama_chunked_prefill_matches_full_forward():
+    """Prefill a long prompt in chunks: logits must match the one-shot
+    forward (the offset-causal mask covers P>0, S>1)."""
+    pt.seed(14)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids_np = np.random.RandomState(14).randint(
+        0, cfg.vocab_size, (2, 12)).astype(np.int64)
+    full = np.asarray(model(pt.to_tensor(ids_np)).data)
+
+    caches = [(None, None)] * cfg.num_hidden_layers
+    outs = []
+    for chunk in (ids_np[:, :5], ids_np[:, 5:9], ids_np[:, 9:]):
+        h, caches = model.model(pt.to_tensor(chunk), caches=caches)
+        outs.append(np.asarray(model._logits(h).data))
+    got = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(got, full, rtol=2e-3, atol=2e-3)
