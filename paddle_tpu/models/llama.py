@@ -138,22 +138,6 @@ class LlamaAttention(nn.Layer):
         self.o_proj = _make_linear(cfg, self.n_heads * self.head_dim,
                                    cfg.hidden_size, "row")
 
-    def _expand_kv(self, k, v):
-        if self.n_kv == self.n_heads:
-            return k, v
-        # GQA: expand KV heads by broadcast (free under XLA)
-        B, S = k.shape[0], k.shape[1]
-        rep = self.n_heads // self.n_kv
-        k = ops.reshape(
-            ops.expand(ops.unsqueeze(k, 3), [B, S, self.n_kv, rep,
-                                             self.head_dim]),
-            [B, S, self.n_heads, self.head_dim])
-        v = ops.reshape(
-            ops.expand(ops.unsqueeze(v, 3), [B, S, self.n_kv, rep,
-                                             self.head_dim]),
-            [B, S, self.n_heads, self.head_dim])
-        return k, v
-
     def forward(self, x, cache=None):
         """``cache=(k, v)`` ([B, P, n_kv, hd] each, P may be 0) switches to
         the incremental-decode path: returns (out, (k', v')). Without a
@@ -164,7 +148,8 @@ class LlamaAttention(nn.Layer):
         v = ops.reshape(self.v_proj(x), [B, S, self.n_kv, self.head_dim])
         if cache is None:
             q, k = apply_rotary(q, k, self.cfg.rope_theta)
-            k, v = self._expand_kv(k, v)
+            # GQA served natively by the attention kernel: KV stay at n_kv
+            # heads end-to-end (no replication in HBM)
             out = F.flash_attention(q, k, v, causal=True)
             return self.o_proj(ops.reshape(out, [B, S, -1]))
         past_k, past_v = cache
@@ -176,12 +161,11 @@ class LlamaAttention(nn.Layer):
             v_all = ops.concat([past_v, v], axis=1)
         else:
             k_all, v_all = k, v
-        ke, ve = self._expand_kv(k_all, v_all)
         # offset-causal over [S queries x P+S keys]: query j (absolute
         # position P+j) sees keys <= P+j — covers full prefill (P=0),
         # CHUNKED prefill (P>0, S>1), and decode (S=1: all keys) in one
-        # mask (sdpa's tril offset is s_k - s_q = P)
-        out = F.scaled_dot_product_attention(q, ke, ve, is_causal=True)
+        # mask (sdpa's tril offset is s_k - s_q = P); GQA heads stay at n_kv
+        out = F.scaled_dot_product_attention(q, k_all, v_all, is_causal=True)
         return self.o_proj(ops.reshape(out, [B, S, -1])), (k_all, v_all)
 
 

@@ -885,64 +885,146 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
 
 
 # =========================== attention =======================================
+def _unwrap(x):
+    """Tensor → raw jnp array (attention masks/ids are constants, not
+    taped)."""
+    return x.data if hasattr(x, "data") else jnp.asarray(x)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True):
+                                 training=True, q_segment_ids=None,
+                                 kv_segment_ids=None):
     """SDPA with [batch, seq, heads, head_dim] layout (paddle convention,
-    reference: python/paddle/nn/functional/flash_attention.py). Routes to the
-    Pallas flash kernel on TPU when enabled, else a jnp composite."""
+    reference: python/paddle/nn/functional/flash_attention.py). Routes to
+    the Pallas flash kernel on TPU when enabled, else a jnp composite.
+
+    ``key``/``value`` may carry fewer heads than ``query`` (GQA/MQA) — the
+    Pallas kernel serves them natively (no KV replication in HBM); the
+    composite broadcasts. ``attn_mask`` of any float/bool shape
+    broadcastable to [B, H, Sq, Sk] is streamed through the kernel as an
+    additive bias tile-by-tile (reference's fused_attention_op.cc arbitrary
+    -mask seam). Masks produced by
+    ``Transformer.generate_square_subsequent_mask`` are *recognized* (a
+    ``_causal_diag`` tag) and served by the kernel's causal block-skip path
+    without ever materializing or reading the S×S mask. Segment ids map the
+    reference's varlen/unpadded flash variant.
+
+    ``attn_mask`` is a *constant* by contract on every route (the
+    reference's fused attention emits no mask gradient either)."""
     from paddle_tpu.core.flags import flag
     use_pallas = flag("use_pallas_kernels")
-    # the Pallas kernel implements only the mask-free (optionally causal),
-    # dropout-free case — anything else must take the composite path rather
-    # than silently dropping arguments
-    pallas_eligible = attn_mask is None and (
-        dropout_p == 0.0 or not training)
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError(
+            "q_segment_ids and kv_segment_ids must be passed together; for "
+            "pure key padding use all-ones q_segment_ids")
+    if attn_mask is not None and \
+            getattr(attn_mask, "stop_gradient", True) is False:
+        import warnings
+        warnings.warn(
+            "scaled_dot_product_attention treats attn_mask as a constant: "
+            "no gradient will flow to it. For a trainable additive bias, "
+            "add it to the logits of a composite attention instead.",
+            stacklevel=2)
+    # dropout is the one feature the Pallas kernel does not implement —
+    # active dropout must take the composite path rather than silently
+    # dropping the argument
+    pallas_eligible = dropout_p == 0.0 or not training
+    s_q, s_k = query.shape[1], key.shape[1]
+    causal_tagged = (
+        attn_mask is not None
+        and getattr(attn_mask, "_causal_diag", False)
+        and s_q == s_k and tuple(attn_mask.shape)[-2:] == (s_q, s_k))
     if use_pallas and pallas_eligible:
         try:
             import jax as _j
             if _j.default_backend() == "tpu":
                 from paddle_tpu.ops.pallas.flash_attention import (
                     flash_attention_bshd)
-                return flash_attention_bshd(query, key, value,
-                                            causal=is_causal)
+                if attn_mask is None or causal_tagged:
+                    return flash_attention_bshd(
+                        query, key, value,
+                        causal=is_causal or causal_tagged,
+                        q_segment_ids=q_segment_ids,
+                        kv_segment_ids=kv_segment_ids)
+                bias = _additive_mask(attn_mask)
+                return flash_attention_bshd(
+                    query, key, value, causal=is_causal, bias=bias,
+                    q_segment_ids=q_segment_ids,
+                    kv_segment_ids=kv_segment_ids)
         except Exception:
             pass
 
     drop_key = _gen.next_key() if (dropout_p > 0 and training) else None
+    seg_mask = _segment_mask(q_segment_ids, kv_segment_ids)
+    # attn_mask is a constant by contract (the reference's fused attention
+    # emits no mask gradient either) — closed over, NOT taped, so both the
+    # Pallas route (zero bias grad) and this composite agree
+    mask_arr = None if attn_mask is None else _unwrap(attn_mask)
 
-    def f(q, k, v, *mask):
+    def f(q, k, v):
         scale = 1.0 / math.sqrt(q.shape[-1])
         # [B,S,H,D] -> [B,H,S,D]
         qt = jnp.swapaxes(q, 1, 2)
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
+        if kt.shape[1] != qt.shape[1]:  # GQA: broadcast KV heads
+            rep = qt.shape[1] // kt.shape[1]
+            kt = jnp.repeat(kt, rep, axis=1)
+            vt = jnp.repeat(vt, rep, axis=1)
         logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
         if is_causal:
-            s_q, s_k = logits.shape[-2], logits.shape[-1]
-            causal = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
+            sq_, sk_ = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((sq_, sk_), bool), sk_ - sq_)
             logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
-        if mask:
-            m = mask[0]
+        if seg_mask is not None:
+            logits = jnp.where(seg_mask[:, None],
+                               logits, jnp.finfo(logits.dtype).min)
+        if mask_arr is not None:
+            m = jax.lax.stop_gradient(mask_arr)
             if m.dtype == jnp.bool_:
                 logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
             else:
                 logits = logits + m
         w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+        if seg_mask is not None:  # zero fully-masked rows (pure padding)
+            rowlive = jnp.any(seg_mask[:, None], axis=-1, keepdims=True)
+            w = jnp.where(rowlive, w, 0.0)
         if drop_key is not None:
             keep = jax.random.bernoulli(drop_key, 1 - dropout_p, w.shape)
             w = jnp.where(keep, w / (1 - dropout_p), 0)
         out = jnp.einsum("bhqk,bhkd->bhqd", w, vt)
         return jnp.swapaxes(out, 1, 2)
 
-    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
-    return apply_op(f, *args, op_name="scaled_dot_product_attention")
+    return apply_op(f, query, key, value,
+                    op_name="scaled_dot_product_attention")
+
+
+def _additive_mask(mask):
+    """bool (True = attend) → additive f32; float passes through raw."""
+    m = _unwrap(mask)
+    if m.dtype == jnp.bool_:
+        return jnp.where(m, 0.0, jnp.float32(jnp.finfo(jnp.float32).min))
+    return m
+
+
+def _segment_mask(q_seg, kv_seg):
+    """[B, Sq] x [B, Sk] ids → bool [B, Sq, Sk] (True = attend)."""
+    if q_seg is None:
+        return None
+    qs = _unwrap(q_seg)
+    ks = _unwrap(kv_seg)
+    return qs[:, :, None] == ks[:, None, :]
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
-                    training=True):
-    return scaled_dot_product_attention(query, key, value, None, dropout,
-                                        causal, training)
+                    training=True, q_segment_ids=None, kv_segment_ids=None):
+    """Reference: python/paddle/nn/functional/flash_attention.py
+    ``flash_attention`` / ``flash_attn_unpadded`` (segment ids are the
+    TPU-idiomatic varlen form). GQA key/value head counts pass through."""
+    return scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training,
+        q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids)
 
 
 # =========================== losses ==========================================

@@ -383,4 +383,9 @@ class Transformer(Layer):
         import jax.numpy as jnp
         m = jnp.where(jnp.tril(jnp.ones((length, length), bool)), 0.0,
                       jnp.finfo(jnp.float32).min)
-        return Tensor(m)
+        t = Tensor(m)
+        # recognized by scaled_dot_product_attention: masks built here route
+        # to the flash kernel's causal block-skip path — the S×S mask is
+        # never read on TPU
+        t._causal_diag = True
+        return t

@@ -1,19 +1,38 @@
 """Flash attention as Pallas TPU kernels.
 
 Capability parity with the reference's FlashAttention integration
-(``paddle/phi/kernels/gpu/flash_attn_kernel.cu`` wrapping the external CUDA
-lib): O(S) memory attention with online softmax, plus the standard
+(``paddle/phi/kernels/gpu/flash_attn_kernel.cu`` — ``FlashAttnKernel`` and
+``FlashAttnUnpaddedKernel`` wrapping the external CUDA lib, plus
+``paddle/fluid/operators/fused/fused_attention_op.cc`` which takes arbitrary
+additive masks): O(S) memory attention with online softmax and the standard
 recompute-based flash backward (dq and dk/dv kernels), wired into the tape
 via ``jax.custom_vjp``.
 
-Kernel shape: inputs are flattened to [BH, S, D]; every kernel walks a
-(batch*heads, outer blocks, inner blocks) grid with the inner dimension
-marked "arbitrary" so K/V (or Q) blocks stream HBM→VMEM with double
-buffering — VMEM holds only a handful of blocks regardless of sequence
-length (seq 16K+ runs in the same footprint as 1K). Softmax statistics are
-carried across inner steps in fp32 VMEM scratch, lane-replicated to honor
-the (8, 128) tile rule. Causal blocks above the diagonal are skipped with
-``pl.when`` predication.
+Supported generality (all combinations compose):
+  * causal masking with a key/query length offset (chunked prefill, decode);
+  * cross attention: ``kv_len != q_len``;
+  * native GQA/MQA: ``num_kv_heads < num_q_heads`` served by grid index maps
+    — each query head streams its shared KV head straight from HBM, no
+    KV replication materialized (the reference replicates KV for its
+    non-flash path);
+  * segment ids (the TPU-idiomatic form of the reference's
+    varlen/unpadded seam): per-token integer ids for q and kv; tokens
+    attend only within equal ids. Padding masks are segment ids with a
+    sentinel. Fully-masked *tiles* are skipped dynamically — padding-heavy
+    batches don't pay for dead FLOPs. Fully-masked rows produce 0 output
+    and 0 gradient (exactly, via the l==0 guard).
+  * arbitrary additive bias/mask, streamed tile-by-tile from HBM
+    ([B|1, H|1, Sq, Sk] broadcasting): O(S) VMEM still holds, and the
+    backward is the fused flash backward. Bias is treated as a constant
+    (zero gradient) — it serves attention *masks*, which never train.
+
+Kernel shape: q flattens to [B*Hq, Sq, D], kv to [B*Hkv, Sk, D]; every
+kernel walks a (flat heads, outer blocks, inner blocks) grid with the inner
+dimension marked "arbitrary" so K/V (or Q) blocks stream HBM→VMEM with
+double buffering. Softmax statistics are carried across inner steps in fp32
+VMEM scratch, lane-replicated to honor the (8, 128) tile rule. Causal tiles
+above the diagonal are skipped with static ``pl.when`` predication;
+segment-dead tiles with dynamic predication.
 
 Off-TPU the kernels run in Pallas interpret mode so the numerics are
 testable on the CPU mesh (the reference cannot test its CUDA kernel without
@@ -23,6 +42,8 @@ from __future__ import annotations
 
 import functools
 import math
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +55,13 @@ __all__ = ["flash_attention_bshd", "flash_attention_bhsd"]
 _DEF_BLOCK_Q = 512
 _DEF_BLOCK_K = 512
 _LANES = 128
+# refuse block sizes that can't double-buffer in ~16MB VMEM; callers fall
+# back to the composite instead of paying a doomed Mosaic compile (hit by
+# odd kv lengths — e.g. decode at long context — that force block == seq)
+_MAX_BLOCK = 2048
+# finite stand-in for -inf (the official TPU flash kernels use the same
+# trick): keeps m/l/alpha arithmetic NaN-free when a tile is fully masked
+_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 
 def _interpret() -> bool:
@@ -48,17 +76,52 @@ def _compiler_params():
         return pltpu.TPUCompilerParams(dimension_semantics=sem)
 
 
-def _causal_mask(s, j, i, block_q, block_k):
-    qi = j * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    ki = i * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    return jnp.where(qi >= ki, s, -jnp.inf)
+def _masked_scores(q, k, bias_ref, seg, j, i, *, sm_scale, causal, offset,
+                   block_q, block_k):
+    """Scaled q·kᵀ for one tile with causal/segment/bias masking applied,
+    clamped finite. Shared verbatim by forward and both backward kernels so
+    the recomputed probabilities match the forward bit-for-bit."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if bias_ref is not None:
+        s = s + bias_ref[...].astype(jnp.float32)
+    if seg is not None:
+        s = jnp.where(seg, s, _MASK_VALUE)
+    if causal:
+        qi = j * block_q + offset + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        ki = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qi >= ki, s, _MASK_VALUE)
+    return jnp.maximum(s, _MASK_VALUE)
+
+
+def _causal_live(j, i, *, offset, block_q, block_k):
+    """Static tile-liveness: any (q row, k col) in tile satisfies
+    q_abs >= k_abs, where q_abs = q + offset (offset = Sk - Sq)."""
+    return i * block_k < (j + 1) * block_q + offset
+
+
+def _segments(qseg_ref, kvseg_ref):
+    if qseg_ref is None:
+        return None
+    qs = qseg_ref[0, :]   # [block_q] (stored lane-tiled as [1, block_q])
+    ks = kvseg_ref[0, :]  # [block_k]
+    return qs[:, None] == ks[None, :]
 
 
 # =========================== forward =========================================
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
-                sm_scale, causal, block_q, block_k, nk):
+def _fwd_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nk,
+                has_bias, has_seg):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kvseg_ref = next(it) if has_seg else None
+    o_ref, lse_ref = next(it), next(it)
+    m_sc, l_sc, acc_sc = next(it), next(it), next(it)
+
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -68,23 +131,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
         l_sc[...] = jnp.zeros_like(l_sc[...])
         acc_sc[...] = jnp.zeros_like(acc_sc[...])
 
-    live = (i * block_k < (j + 1) * block_q) if causal else True
+    live = _causal_live(j, i, offset=offset, block_q=block_q,
+                        block_k=block_k) if causal else True
 
-    @pl.when(live)
-    def _compute():
+    def _compute(seg):
         q = q_ref[...]
         k = k_ref[...]
         v = v_ref[...]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * sm_scale  # [bq, bk] f32
-        if causal:
-            s = _causal_mask(s, j, i, block_q, block_k)
+        s = _masked_scores(q, k, bias_ref, seg, j, i, sm_scale=sm_scale,
+                           causal=causal, offset=offset, block_q=block_q,
+                           block_k=block_k)
         m_prev = m_sc[:, :1]  # [bq, 1] (lane-replicated storage)
         l_prev = l_sc[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
+        if seg is not None:
+            # rows with no live key in THIS tile would otherwise contribute
+            # p = exp(MASK - MASK) = 1 per column; zeroing them keeps l == 0
+            # for fully-masked rows so the finish-guard emits exact 0
+            p = jnp.where(jnp.any(seg, axis=-1, keepdims=True), p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
@@ -93,33 +159,101 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
         m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
 
+    @pl.when(live)
+    def _outer():
+        if has_seg:
+            seg = _segments(qseg_ref, kvseg_ref)
+
+            @pl.when(jnp.any(seg))
+            def _inner():
+                _compute(seg)
+        else:
+            _compute(None)
+
     @pl.when(i == nk - 1)
     def _finish():
         l = l_sc[:, :1]
-        o_ref[...] = (acc_sc[...] / l).astype(o_ref.dtype)
-        lse_ref[0, :] = m_sc[:, 0] + jnp.log(l_sc[:, 0])
+        # rows that saw no live tile (fully-masked padding rows): exact 0
+        # output and a sentinel lse of 0 so the backward's
+        # p = exp(MASK - lse) underflows to 0 — zero grads, no NaN
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l_sc[:, 0] == 0.0, 0.0,
+                        m_sc[:, 0] + jnp.log(l_safe[:, 0]))
+        lse_ref[0, :] = lse
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    bh, seq, d = q.shape
-    nq, nk = seq // block_q, seq // block_k
-    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                               block_q=block_q, block_k=block_k, nk=nk)
+def _build_specs(block_q, block_k, d, hq, hkv, bias_bh):
+    """Input block specs for the (bhq, nq, nk) grids (forward and dq); the
+    dkv kernel's (bhkv, nk, group*nq) grid builds its own maps in _bwd."""
+    group = hq // hkv
+
+    def kv_of(b):
+        return (b // hq) * hkv + (b % hq) // group
+
+    def batch_of(b):
+        return b // hq
+
+    specs = {
+        "q": pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, j, 0)),
+        "kv": pl.BlockSpec((None, block_k, d),
+                           lambda b, j, i: (kv_of(b), i, 0)),
+        "row_q": pl.BlockSpec((None, 1, block_q),
+                              lambda b, j, i: (b, 0, j)),
+        "qseg": pl.BlockSpec((None, 1, block_q),
+                             lambda b, j, i: (batch_of(b), 0, j)),
+        "kvseg": pl.BlockSpec((None, 1, block_k),
+                              lambda b, j, i: (batch_of(b), 0, i)),
+    }
+    if bias_bh is not None:
+        bb_n, hb_n, row_bcast = bias_bh
+
+        def bias_of(b):
+            bb = (b // hq) if bb_n > 1 else 0
+            hh = (b % hq) if hb_n > 1 else 0
+            return bb * hb_n + hh
+        if row_bcast:  # [.., 1, Sk] key-padding mask: one row per tile
+            specs["bias"] = pl.BlockSpec((None, 1, block_k),
+                                         lambda b, j, i: (bias_of(b), 0, i))
+        else:
+            specs["bias"] = pl.BlockSpec(
+                (None, block_q, block_k),
+                lambda b, j, i: (bias_of(b), j, i))
+    return specs
+
+
+def _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+         hq, hkv, bias_bh):
+    bhq, sq, d = q.shape
+    _, sk, _ = k.shape
+    nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq
+    has_bias = bias is not None
+    has_seg = q_seg is not None
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
+        block_q=block_q, block_k=block_k, nk=nk, has_bias=has_bias,
+        has_seg=has_seg)
+    sp = _build_specs(block_q, block_k, d, hq, hkv, bias_bh)
+    in_specs = [sp["q"], sp["kv"], sp["kv"]]
+    inputs = [q, k, v]
+    if has_bias:
+        in_specs.append(sp["bias"])
+        inputs.append(bias)
+    if has_seg:
+        in_specs += [sp["qseg"], sp["kvseg"]]
+        inputs += [q_seg, kv_seg]
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, i, 0)),
-        ],
+        grid=(bhq, nq, nk),
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda b, j, i: (b, 0, j)),
+            sp["row_q"],
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+            jax.ShapeDtypeStruct((bhq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bhq, 1, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -128,13 +262,22 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k):
         ],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     return o, lse
 
 
 # =========================== backward ========================================
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_sc, *, sm_scale, causal, block_q, block_k, nk):
+def _dq_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nk,
+               has_bias, has_seg):
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref = next(it), next(it), next(it), next(it)
+    lse_ref, delta_ref = next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kvseg_ref = next(it) if has_seg else None
+    dq_ref = next(it)
+    dq_sc = next(it)
+
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -142,21 +285,19 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc[...])
 
-    live = (i * block_k < (j + 1) * block_q) if causal else True
+    live = _causal_live(j, i, offset=offset, block_q=block_q,
+                        block_k=block_k) if causal else True
 
-    @pl.when(live)
-    def _compute():
+    def _compute(seg):
         q = q_ref[...]
         k = k_ref[...]
         v = v_ref[...]
         do = do_ref[...]
         lse = lse_ref[0, :]
         delta = delta_ref[0, :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * sm_scale
-        if causal:
-            s = _causal_mask(s, j, i, block_q, block_k)
+        s = _masked_scores(q, k, bias_ref, seg, j, i, sm_scale=sm_scale,
+                           causal=causal, offset=offset, block_q=block_q,
+                           block_k=block_k)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -165,37 +306,56 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    @pl.when(live)
+    def _outer():
+        if has_seg:
+            seg = _segments(qseg_ref, kvseg_ref)
+
+            @pl.when(jnp.any(seg))
+            def _inner():
+                _compute(seg)
+        else:
+            _compute(None)
+
     @pl.when(i == nk - 1)
     def _finish():
         dq_ref[...] = dq_sc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_sc, dv_sc, *, sm_scale, causal, block_q, block_k,
-                nq):
-    i = pl.program_id(1)  # k block
-    j = pl.program_id(2)  # q block
+def _dkv_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nq,
+                group, has_bias, has_seg):
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref = next(it), next(it), next(it), next(it)
+    lse_ref, delta_ref = next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kvseg_ref = next(it) if has_seg else None
+    dk_ref, dv_ref = next(it), next(it)
+    dk_sc, dv_sc = next(it), next(it)
 
-    @pl.when(j == 0)
+    i = pl.program_id(1)   # k block
+    t = pl.program_id(2)   # fused (query head in group, q block)
+    j = t % nq
+    gnq = group * nq
+
+    @pl.when(t == 0)
     def _init():
         dk_sc[...] = jnp.zeros_like(dk_sc[...])
         dv_sc[...] = jnp.zeros_like(dv_sc[...])
 
-    live = ((j + 1) * block_q > i * block_k) if causal else True
+    live = _causal_live(j, i, offset=offset, block_q=block_q,
+                        block_k=block_k) if causal else True
 
-    @pl.when(live)
-    def _compute():
+    def _compute(seg):
         q = q_ref[...]
         k = k_ref[...]
         v = v_ref[...]
         do = do_ref[...]
         lse = lse_ref[0, :]
         delta = delta_ref[0, :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = s * sm_scale
-        if causal:
-            s = _causal_mask(s, j, i, block_q, block_k)
+        s = _masked_scores(q, k, bias_ref, seg, j, i, sm_scale=sm_scale,
+                           causal=causal, offset=offset, block_q=block_q,
+                           block_k=block_k)
         p = jnp.exp(s - lse[:, None])  # [bq, bk] f32
         dv_sc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -207,61 +367,121 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == nq - 1)
+    @pl.when(live)
+    def _outer():
+        if has_seg:
+            seg = _segments(qseg_ref, kvseg_ref)
+
+            @pl.when(jnp.any(seg))
+            def _inner():
+                _compute(seg)
+        else:
+            _compute(None)
+
+    @pl.when(t == gnq - 1)
     def _finish():
         dk_ref[...] = dk_sc[...].astype(dk_ref.dtype)
         dv_ref[...] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
-    bh, seq, d = q.shape
-    nq, nk = seq // block_q, seq // block_k
+def _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal, sm_scale,
+         block_q, block_k, hq, hkv, bias_bh):
+    bhq, sq, d = q.shape
+    bhkv, sk, _ = k.shape
+    group = hq // hkv
+    nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq
+    has_bias = bias is not None
+    has_seg = q_seg is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)[:, None, :]  # [bh, 1, seq]
+                    axis=-1)[:, None, :]  # [bhq, 1, sq]
 
-    dq_kernel = functools.partial(_dq_kernel, sm_scale=sm_scale,
-                                  causal=causal, block_q=block_q,
-                                  block_k=block_k, nk=nk)
+    sp = _build_specs(block_q, block_k, d, hq, hkv, bias_bh)
+    dq_kernel = functools.partial(
+        _dq_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
+        block_q=block_q, block_k=block_k, nk=nk, has_bias=has_bias,
+        has_seg=has_seg)
+    in_specs = [sp["q"], sp["kv"], sp["kv"], sp["q"], sp["row_q"],
+                sp["row_q"]]
+    inputs = [q, k, v, do, lse, delta]
+    if has_bias:
+        in_specs.append(sp["bias"])
+        inputs.append(bias)
+    if has_seg:
+        in_specs += [sp["qseg"], sp["kvseg"]]
+        inputs += [q_seg, kv_seg]
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, block_q, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda b, j, i: (b, 0, j)),
-            pl.BlockSpec((None, 1, block_q), lambda b, j, i: (b, 0, j)),
-        ],
+        grid=(bhq, nq, nk),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, block_q, d),
                                lambda b, j, i: (b, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bhq, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*inputs)
 
-    dkv_kernel = functools.partial(_dkv_kernel, sm_scale=sm_scale,
-                                   causal=causal, block_q=block_q,
-                                   block_k=block_k, nq=nq)
+    # dk/dv at KV-head resolution: grid (B*Hkv, nk, group*nq) — the inner
+    # fused dimension walks every (query head in the group, q block) pair,
+    # accumulating into one [block_k, d] scratch. GQA head reduction happens
+    # in-kernel; dk/dv never inflate to Hq.
+    def qflat(b, t):
+        return (b // hkv) * hq + (b % hkv) * group + t // nq
+
+    dkv_in_specs = [
+        pl.BlockSpec((None, block_q, d),
+                     lambda b, i, t: (qflat(b, t), t % nq, 0)),       # q
+        pl.BlockSpec((None, block_k, d), lambda b, i, t: (b, i, 0)),  # k
+        pl.BlockSpec((None, block_k, d), lambda b, i, t: (b, i, 0)),  # v
+        pl.BlockSpec((None, block_q, d),
+                     lambda b, i, t: (qflat(b, t), t % nq, 0)),       # do
+        pl.BlockSpec((None, 1, block_q),
+                     lambda b, i, t: (qflat(b, t), 0, t % nq)),       # lse
+        pl.BlockSpec((None, 1, block_q),
+                     lambda b, i, t: (qflat(b, t), 0, t % nq)),       # delta
+    ]
+    dkv_inputs = [q, k, v, do, lse, delta]
+    if has_bias:
+        bb_n, hb_n, row_bcast = bias_bh
+
+        def bias_of(b, t):
+            bb = (b // hkv) if bb_n > 1 else 0
+            hh = ((b % hkv) * group + t // nq) if hb_n > 1 else 0
+            return bb * hb_n + hh
+        if row_bcast:
+            dkv_in_specs.append(pl.BlockSpec(
+                (None, 1, block_k),
+                lambda b, i, t: (bias_of(b, t), 0, i)))
+        else:
+            dkv_in_specs.append(pl.BlockSpec(
+                (None, block_q, block_k),
+                lambda b, i, t: (bias_of(b, t), t % nq, i)))
+        dkv_inputs.append(bias)
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((None, 1, block_q),
+                         lambda b, i, t: (b // hkv, 0, t % nq)),
+            pl.BlockSpec((None, 1, block_k),
+                         lambda b, i, t: (b // hkv, 0, i)),
+        ]
+        dkv_inputs += [q_seg, kv_seg]
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
+        block_q=block_q, block_k=block_k, nq=nq, group=group,
+        has_bias=has_bias, has_seg=has_seg)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, 1, block_q), lambda b, i, j: (b, 0, j)),
-            pl.BlockSpec((None, 1, block_q), lambda b, i, j: (b, 0, j)),
-        ],
+        grid=(bhkv, nk, group * nq),
+        in_specs=dkv_in_specs,
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, t: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, t: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, seq, d), v.dtype),
+            jax.ShapeDtypeStruct((bhkv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bhkv, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -269,27 +489,38 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
         ],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dkv_inputs)
     return dq, dk, dv
 
 
 # =========================== custom-vjp wrapper ==============================
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+def _flash(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
+           hq, hkv, bias_bh):
+    o, _ = _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q,
+                block_k, hq, hkv, bias_bh)
     return o
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q,
+               block_k, hq, hkv, bias_bh):
+    o, lse = _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q,
+                  block_k, hq, hkv, bias_bh)
+    return o, (q, k, v, bias, q_seg, kv_seg, o, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
-    q, k, v, o, lse = res
-    dq, dk, dv = _bwd(q, k, v, o, lse, do, causal, sm_scale, block_q,
-                      block_k)
-    return dq, dk, dv
+def _flash_bwd(causal, sm_scale, block_q, block_k, hq, hkv, bias_bh, res,
+               do):
+    q, k, v, bias, q_seg, kv_seg, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal,
+                      sm_scale, block_q, block_k, hq, hkv, bias_bh)
+    # bias is an attention mask: constant by contract (zero grad); segment
+    # ids are carried as f32 so integer-cotangent (float0) plumbing never
+    # enters the picture
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dqs = None if q_seg is None else jnp.zeros_like(q_seg)
+    dks = None if kv_seg is None else jnp.zeros_like(kv_seg)
+    return dq, dk, dv, dbias, dqs, dks
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -297,67 +528,167 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # module-level jit so EAGER calls hit the compile cache: without this,
 # every eager flash_attention re-traces and re-compiles the pallas_call
 # (~1s/call on chip vs ~1ms steady-state — measured). Under an outer
-# jit/TrainStep trace this inlines and changes nothing.
-_flash_cached = functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))(
-    _flash)
+# jit/TrainStep trace this inlines and changes nothing. None-valued
+# optional inputs are empty pytrees — one jitted callable serves every
+# bias/segment combination.
+_flash_cached = functools.partial(
+    jax.jit, static_argnums=(6, 7, 8, 9, 10, 11, 12))(_flash)
 
 
-def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None,
-                         block_q=_DEF_BLOCK_Q, block_k=_DEF_BLOCK_K):
-    """Flash attention on arrays in [B, H, S, D] (or [BH, S, D]) layout."""
-    if k.shape != q.shape or v.shape != q.shape:
+def _pick_block(requested, seq):
+    """Largest lane-multiple block <= requested that divides seq, else the
+    smallest lane-multiple divisor above it, else the whole sequence (always
+    a legal tile). The (1, block) rows (lse, segment ids) must satisfy the
+    TPU tile rule: last dim a multiple of 128 or equal to the array dim.
+    Interpret mode (CPU tests) keeps the raw clamp so indivisible shapes
+    still surface as ValueError."""
+    block = min(requested, seq)
+    if _interpret():
+        return block
+    if seq % block == 0 and (block % _LANES == 0 or block == seq):
+        return block
+    cands = [b for b in range(_LANES, block + 1, _LANES) if seq % b == 0]
+    if cands:
+        return cands[-1]
+    bigger = [b for b in range(_LANES, seq, _LANES) if seq % b == 0]
+    return bigger[0] if bigger else seq
+
+
+def _norm_bias(bias, b, hq, sq, sk):
+    """Normalize bias to (flat [Bb*Hb, Sq|1, Sk], (Bb, Hb, row_bcast)) with
+    Bb in {1,B}, Hb in {1,Hq}. A size-1 q dim (the [B, 1, 1, Sk]
+    key-padding-mask shape) is served by a one-row BlockSpec — never
+    broadcast to Sq in HBM."""
+    bias = jnp.asarray(bias)
+    if bias.dtype == jnp.bool_:  # bool convention: True = attend
+        bias = jnp.where(bias, 0.0,
+                         jnp.float32(jnp.finfo(jnp.float32).min))
+    if bias.ndim == 2:
+        bias = bias[None, None]
+    elif bias.ndim == 3:  # [B|H ambiguous, Sq, Sk] — treat as per-head
+        bias = bias[None]
+    if bias.ndim != 4:
+        raise ValueError(f"bias must be 2/3/4-D, got shape {bias.shape}")
+    bb, hb = bias.shape[0], bias.shape[1]
+    if bb not in (1, b) or hb not in (1, hq):
         raise ValueError(
-            f"flash attention requires matching q/k/v shapes, got "
-            f"{q.shape}/{k.shape}/{v.shape}; cross-attention with a "
-            "different key length is not supported by this kernel yet")
-    squeeze = False
+            f"bias batch/head dims {bias.shape[:2]} must be 1 or match "
+            f"(batch={b}, heads={hq})")
+    rows = bias.shape[2]
+    if rows not in (1, sq) or bias.shape[3] != sk:
+        raise ValueError(
+            f"bias tail {bias.shape[2:]} must equal (q_len|1, kv_len)="
+            f"({sq}|1, {sk})")
+    return (bias.reshape(bb * hb, rows, sk), (bb, hb, rows == 1))
+
+
+def _norm_seg(seg, b, s, name):
+    seg = jnp.asarray(seg)
+    if seg.ndim == 1:
+        seg = seg[None]
+    if seg.shape != (b, s):
+        raise ValueError(f"{name} must have shape [batch={b}, {s}], got "
+                         f"{tuple(seg.shape)}")
+    # f32 carrier: exact for ids < 2^24 and sidesteps integer cotangents
+    return seg.astype(jnp.float32).reshape(b, 1, s)
+
+
+def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
+                         q_segment_ids=None, kv_segment_ids=None,
+                         block_q=_DEF_BLOCK_Q, block_k=_DEF_BLOCK_K):
+    """Flash attention on arrays in [B, H, S, D] (or [BH, S, D]) layout.
+
+    GQA: 4-D ``k``/``v`` may carry fewer heads than ``q`` (``Hq % Hkv == 0``)
+    — the kernel maps each query head onto its shared KV head; nothing is
+    replicated. Cross attention: ``kv_len`` may differ from ``q_len``; with
+    ``causal=True`` query i attends keys <= i + (kv_len - q_len) (the
+    chunked-prefill/decode convention). ``bias`` is an additive mask
+    broadcastable to [B, Hq, Sq, Sk]. Segment ids ([B, Sq]/[B, Sk] ints)
+    restrict attention to equal ids; for 3-D inputs their batch dim is BH.
+    """
+    squeeze = None
     if q.ndim == 4:
-        b, h, s, d = q.shape
-        q = q.reshape(b * h, s, d)
-        k = k.reshape(b * h, s, d)
-        v = v.reshape(b * h, s, d)
-        squeeze = (b, h)
-    bh, s, d = q.shape
+        b, hq, sq, d = q.shape
+        _, hkv, sk, _ = k.shape
+        if k.shape != (b, hkv, sk, d) or v.shape != (b, hkv, sk, d):
+            raise ValueError(f"k/v shapes {k.shape}/{v.shape} inconsistent")
+        if hq % hkv:
+            raise ValueError(
+                f"q heads {hq} must be a multiple of kv heads {hkv}")
+        q = q.reshape(b * hq, sq, d)
+        k = k.reshape(b * hkv, sk, d)
+        v = v.reshape(b * hkv, sk, d)
+        squeeze = (b, hq)
+    else:
+        b, hq, hkv = q.shape[0], 1, 1
+        if (k.shape[0] != b or k.shape[2] != q.shape[2]
+                or v.shape != k.shape):
+            raise ValueError(
+                f"3-D flash attention requires matching batch*heads and "
+                f"head_dim (and v matching k), got "
+                f"{q.shape}/{k.shape}/{v.shape}")
+        sq, sk, d = q.shape[1], k.shape[1], q.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if not _interpret() and block_q % _LANES and block_q != s:
-        # the lse output block (1, block_q) must satisfy the TPU tile rule:
-        # last dim a multiple of 128 or equal to the array dim — pick the
-        # largest lane-multiple that still divides the sequence
-        cands = [b for b in range(_LANES, min(block_q, s) + 1, _LANES)
-                 if s % b == 0]
-        if cands:
-            block_q = cands[-1]  # largest lane-multiple <= requested
-        else:
-            # requested block too small to tile: smallest valid block above
-            # it, falling back to the whole sequence (always a legal tile)
-            bigger = [b for b in range(_LANES, s, _LANES) if s % b == 0]
-            block_q = bigger[0] if bigger else s
-    if s % block_q or s % block_k:
+    req_q, req_k = block_q, block_k
+    block_q = _pick_block(block_q, sq)
+    block_k = _pick_block(block_k, sk)
+    if sq % block_q or sk % block_k:
         raise ValueError(
-            f"flash attention requires seq {s} divisible by block sizes "
-            f"({block_q}, {block_k}); pad the sequence")
-    out = _flash_cached(q, k, v, causal, float(sm_scale), block_q, block_k)
+            f"flash attention requires q_len {sq} / kv_len {sk} divisible "
+            f"by block sizes ({block_q}, {block_k}); pad the sequence")
+    if (block_q > max(req_q, _MAX_BLOCK)
+            or block_k > max(req_k, _MAX_BLOCK)):
+        # seq has no lane-multiple divisor (odd lengths) and is too long to
+        # stream as one tile — cheap early error, no Mosaic compile attempt
+        raise ValueError(
+            f"no VMEM-safe block tiling for q_len {sq} / kv_len {sk} "
+            f"(forced blocks ({block_q}, {block_k}) exceed {_MAX_BLOCK}); "
+            "pad the sequence to a multiple of 128")
+
+    bias_bh = None
+    if bias is not None:
+        bias, bias_bh = _norm_bias(bias, b, hq, sq, sk)
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("segment ids must be given for both q and kv")
+    q_seg = kv_seg = None
+    if q_segment_ids is not None:
+        q_seg = _norm_seg(q_segment_ids, b, sq, "q_segment_ids")
+        kv_seg = _norm_seg(kv_segment_ids, b, sk, "kv_segment_ids")
+
+    out = _flash_cached(q, k, v, bias, q_seg, kv_seg, causal,
+                        float(sm_scale), block_q, block_k, hq, hkv, bias_bh)
     if squeeze:
-        b, h = squeeze
-        out = out.reshape(b, h, s, d)
+        b, hq = squeeze
+        out = out.reshape(b, hq, sq, d)
     return out
 
 
 def flash_attention_bshd(query, key, value, causal=False, sm_scale=None,
+                         bias=None, q_segment_ids=None, kv_segment_ids=None,
                          block_q=_DEF_BLOCK_Q, block_k=_DEF_BLOCK_K):
     """Flash attention with paddle's [batch, seq, heads, head_dim] layout,
-    Tensor-in/Tensor-out, recorded on the autograd tape."""
+    Tensor-in/Tensor-out, recorded on the autograd tape. ``key``/``value``
+    may carry fewer heads (GQA) and a different sequence length (cross
+    attention) than ``query``. ``bias``/segment ids are mask constants —
+    closed over, not taped."""
     from paddle_tpu.core.autograd import apply_op
+
+    def _raw(x):
+        return x.data if hasattr(x, "data") else jnp.asarray(x)
+
+    bias_arr = None if bias is None else _raw(bias)
+    qseg_arr = None if q_segment_ids is None else _raw(q_segment_ids)
+    kvseg_arr = None if kv_segment_ids is None else _raw(kv_segment_ids)
 
     def f(q, k, v):
         qt = jnp.swapaxes(q, 1, 2)
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
         o = flash_attention_bhsd(qt, kt, vt, causal=causal,
-                                 sm_scale=sm_scale, block_q=block_q,
-                                 block_k=block_k)
+                                 sm_scale=sm_scale, bias=bias_arr,
+                                 q_segment_ids=qseg_arr,
+                                 kv_segment_ids=kvseg_arr,
+                                 block_q=block_q, block_k=block_k)
         return jnp.swapaxes(o, 1, 2)
     return apply_op(f, query, key, value, op_name="flash_attention")

@@ -1,6 +1,8 @@
 """Flash-attention kernel tests (Pallas interpret mode on the CPU mesh) —
-numeric parity vs the naive composite, forward and backward, causal and not,
-plus tape integration through the Tensor API."""
+numeric parity vs the naive composite, forward and backward, across the
+kernel's full capability matrix: causal (with kv/q length offset), cross
+attention, native GQA, segment ids (varlen/padding), streamed additive
+bias, and tape integration through the Tensor API."""
 import numpy as np
 import pytest
 
@@ -11,14 +13,37 @@ import paddle_tpu as pt
 from paddle_tpu.ops.pallas.flash_attention import (flash_attention_bhsd,
                                                    flash_attention_bshd)
 
+_NEG = -0.7 * float(np.finfo(np.float32).max)
 
-def naive(q, k, v, causal):
-    s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(q.shape[-1])
+
+def naive(q, k, v, causal=False, bias=None, qseg=None, kseg=None):
+    """Oracle for [B, Hq, Sq, D] q with [B, Hkv, Sk, D] kv (GQA broadcast),
+    mirroring the kernel's fully-masked-row → 0 convention."""
+    if q.ndim == 3:
+        q, k, v = q[:, None], k[:, None], v[:, None]
+        squeeze = True
+    else:
+        squeeze = False
+    B, Hq, Sq, D = q.shape
+    Sk = k.shape[2]
+    rep = Hq // k.shape[1]
+    kf = jnp.repeat(k, rep, axis=1)
+    vf = jnp.repeat(v, rep, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kf) / np.sqrt(D)
+    if bias is not None:
+        s = s + bias
+    live = jnp.ones((B, 1, Sq, Sk), bool)
+    if qseg is not None:
+        live = live & (qseg[:, None, :, None] == kseg[:, None, None, :])
     if causal:
-        m = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
-        s = jnp.where(m, s, -jnp.inf)
+        qi = jnp.arange(Sq)[:, None] + (Sk - Sq)
+        live = live & (qi >= jnp.arange(Sk)[None, :])[None, None]
+    s = jnp.where(live, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkd->bqd", p, v)
+    if qseg is not None:
+        p = jnp.where(live.any(-1, keepdims=True), p, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+    return out[:, 0] if squeeze else out
 
 
 @pytest.fixture()
@@ -27,6 +52,10 @@ def qkv():
     BH, S, D = 3, 256, 64
     mk = lambda: jnp.asarray(rng.randn(BH, S, D), jnp.float32)
     return mk(), mk(), mk()
+
+
+def rand4(rng, *shape):
+    return jnp.asarray(rng.randn(*shape), jnp.float32)
 
 
 class TestForward:
@@ -60,24 +89,147 @@ class TestForward:
         with pytest.raises(ValueError):
             flash_attention_bhsd(q, q, q, block_q=64, block_k=64)
 
-    def test_mismatched_kv_seq_raises(self):
-        q = jnp.zeros((1, 128, 64))
-        k = jnp.zeros((1, 256, 64))
-        with pytest.raises(ValueError):
-            flash_attention_bhsd(q, k, k, block_q=64, block_k=64)
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_cross_attention(self, causal):
+        # kv_len != q_len, reference flash_attn with differing seqlen_k
+        rng = np.random.RandomState(3)
+        q = rand4(rng, 2, 2, 128, 32)
+        k = rand4(rng, 2, 2, 320, 32)
+        v = rand4(rng, 2, 2, 320, 32)
+        out = flash_attention_bhsd(q, k, v, causal=causal, block_q=64,
+                                   block_k=64)
+        ref = naive(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
 
-    def test_sdpa_pallas_route_requires_maskless(self, monkeypatch):
-        # the sdpa router must NOT take the pallas path when a mask or
-        # active dropout is present (kernel implements neither); simulate a
-        # TPU backend and record whether the kernel gets invoked
-        import paddle_tpu as pt
+    def test_decode_single_query(self):
+        # Sq=1 against a long KV (the decode step shape)
+        rng = np.random.RandomState(4)
+        q = rand4(rng, 2, 4, 1, 32)
+        k = rand4(rng, 2, 4, 256, 32)
+        v = rand4(rng, 2, 4, 256, 32)
+        out = flash_attention_bhsd(q, k, v, causal=True)
+        ref = naive(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    @pytest.mark.parametrize("hkv", [1, 2])
+    def test_gqa(self, hkv):
+        # KV heads < Q heads served by index maps, not replication
+        rng = np.random.RandomState(5)
+        q = rand4(rng, 2, 4, 128, 32)
+        k = rand4(rng, 2, hkv, 128, 32)
+        v = rand4(rng, 2, hkv, 128, 32)
+        out = flash_attention_bhsd(q, k, v, causal=True, block_q=64,
+                                   block_k=64)
+        ref = naive(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_gqa_indivisible_heads_raises(self):
+        q = jnp.zeros((1, 3, 64, 32))
+        k = jnp.zeros((1, 2, 64, 32))
+        with pytest.raises(ValueError):
+            flash_attention_bhsd(q, k, k)
+
+    def test_segment_ids(self):
+        # two documents packed per row + padding tail (id 0 vs real ids)
+        rng = np.random.RandomState(6)
+        B, H, S, D = 2, 2, 256, 32
+        q = rand4(rng, B, H, S, D)
+        k = rand4(rng, B, H, S, D)
+        v = rand4(rng, B, H, S, D)
+        ids = np.where(np.arange(S) < 96, 1, np.where(np.arange(S) < 192,
+                                                      2, 0))
+        seg = jnp.asarray(np.stack([ids, ids]), jnp.int32)
+        out = flash_attention_bhsd(q, k, v, q_segment_ids=seg,
+                                   kv_segment_ids=seg, block_q=64,
+                                   block_k=64)
+        ref = naive(q, k, v, qseg=seg, kseg=seg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_segment_fully_masked_rows_zero(self):
+        # a query token whose id matches no kv token gets exactly 0 output
+        rng = np.random.RandomState(7)
+        q = rand4(rng, 1, 1, 128, 32)
+        k = rand4(rng, 1, 1, 128, 32)
+        v = rand4(rng, 1, 1, 128, 32)
+        # boundary deliberately NOT tile-aligned (70 with block_q=64): dead
+        # rows sharing tile j=1 with live rows must still emit exact 0
+        qseg = jnp.asarray(np.where(np.arange(128) < 70, 1, 9)[None],
+                           jnp.int32)
+        kseg = jnp.asarray(np.ones((1, 128)), jnp.int32)
+        out = np.asarray(flash_attention_bhsd(
+            q, k, v, q_segment_ids=qseg, kv_segment_ids=kseg, block_q=64,
+            block_k=64))
+        assert np.all(out[0, 0, 70:] == 0.0)
+        assert np.all(np.isfinite(out))
+        # and their gradients are exactly 0 too
+        def loss(a):
+            o = flash_attention_bhsd(a, k, v, q_segment_ids=qseg,
+                                     kv_segment_ids=kseg, block_q=64,
+                                     block_k=64)
+            return jnp.sum(o.astype(jnp.float32))
+        dq = np.asarray(jax.grad(loss)(q))
+        assert np.all(dq[0, 0, 70:] == 0.0) and np.all(np.isfinite(dq))
+
+    @pytest.mark.parametrize("bshape", [(256, 256), (2, 1, 256, 256),
+                                        (1, 2, 256, 256), (2, 2, 256, 256)])
+    def test_bias_broadcast_shapes(self, bshape):
+        rng = np.random.RandomState(8)
+        q = rand4(rng, 2, 2, 256, 32)
+        k = rand4(rng, 2, 2, 256, 32)
+        v = rand4(rng, 2, 2, 256, 32)
+        bias = jnp.asarray(rng.randn(*bshape) * 2, jnp.float32)
+        out = flash_attention_bhsd(q, k, v, bias=bias, block_q=64,
+                                   block_k=64)
+        bias4 = bias if bias.ndim == 4 else bias[None, None]
+        ref = naive(q, k, v, bias=bias4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_bias_key_padding_row_broadcast(self):
+        # [B, 1, 1, Sk] key-padding mask: streamed via a one-row BlockSpec,
+        # never broadcast to Sq in HBM
+        rng = np.random.RandomState(10)
+        q = rand4(rng, 2, 2, 128, 32)
+        k = rand4(rng, 2, 2, 128, 32)
+        v = rand4(rng, 2, 2, 128, 32)
+        pad = np.zeros((2, 1, 1, 128), np.float32)
+        pad[:, :, :, 96:] = np.finfo(np.float32).min
+        bias = jnp.asarray(pad)
+        out = flash_attention_bhsd(q, k, v, bias=bias, block_q=64,
+                                   block_k=64)
+        ref = naive(q, k, v, bias=bias)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_bias_as_additive_causal_mask(self):
+        # an explicit -inf-style additive mask matches the causal flag
+        rng = np.random.RandomState(9)
+        q = rand4(rng, 1, 2, 128, 32)
+        k = rand4(rng, 1, 2, 128, 32)
+        v = rand4(rng, 1, 2, 128, 32)
+        mask = jnp.where(jnp.tril(jnp.ones((128, 128), bool)), 0.0,
+                         jnp.finfo(jnp.float32).min)
+        out = flash_attention_bhsd(q, k, v, bias=mask, block_q=64,
+                                   block_k=64)
+        ref = flash_attention_bhsd(q, k, v, causal=True, block_q=64,
+                                   block_k=64)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_sdpa_router(self, monkeypatch):
+        # masked and GQA cases now ROUTE to the kernel (bias streaming);
+        # active dropout still must not (kernel has no dropout)
         import paddle_tpu.nn.functional as F
         import paddle_tpu.ops.pallas.flash_attention as fa_mod
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
         calls = []
 
-        def fake_bshd(*a, **k):
-            calls.append(1)
+        def fake_bshd(*a, **kw):
+            calls.append(kw)
             raise RuntimeError("recorded")  # router falls back on error
         monkeypatch.setattr(fa_mod, "flash_attention_bshd", fake_bshd)
 
@@ -86,12 +238,25 @@ class TestForward:
         q = pt.to_tensor(rng.randn(B, S, H, D).astype(np.float32))
         mask = pt.to_tensor(np.zeros((B, H, S, S), np.float32))
         F.scaled_dot_product_attention(q, q, q, attn_mask=mask)
-        assert not calls  # masked: composite path, kernel never touched
+        assert len(calls) == 1 and calls[0]["bias"] is not None
         F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
                                        training=True)
-        assert not calls  # active dropout: composite path
+        assert len(calls) == 1  # active dropout: composite path
         F.scaled_dot_product_attention(q, q, q, is_causal=True)
-        assert calls  # eligible case reaches the kernel
+        assert len(calls) == 2  # plain causal reaches the kernel
+
+        # generate_square_subsequent_mask is recognized: kernel sees
+        # causal=True and NO bias (S×S mask never streamed)
+        from paddle_tpu.nn.layer.transformer import Transformer
+        cm = Transformer.generate_square_subsequent_mask(S)
+        F.scaled_dot_product_attention(q, q, q, attn_mask=cm)
+        assert calls[-1].get("bias") is None
+        # composite fallback with the same tagged mask matches causal
+        monkeypatch.undo()
+        got = F.scaled_dot_product_attention(q, q, q, attn_mask=cm)
+        want = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=2e-4,
+                                   atol=2e-5)
 
 
 class TestBackward:
@@ -111,6 +276,52 @@ class TestBackward:
         for ga, ra in zip(got, ref):
             np.testing.assert_allclose(np.asarray(ga), np.asarray(ra),
                                        rtol=2e-3, atol=2e-4)
+
+    def test_grads_gqa_cross_causal(self):
+        rng = np.random.RandomState(11)
+        q = rand4(rng, 2, 4, 128, 32)
+        k = rand4(rng, 2, 2, 256, 32)
+        v = rand4(rng, 2, 2, 256, 32)
+
+        def f(a, b, c):
+            return jnp.sum(jnp.sin(flash_attention_bhsd(
+                a, b, c, causal=True, block_q=64, block_k=64)))
+
+        def g(a, b, c):
+            return jnp.sum(jnp.sin(naive(a, b, c, causal=True)))
+
+        got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        ref = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        assert got[1].shape == k.shape  # dk at KV-head resolution
+        for ga, ra in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(ga), np.asarray(ra),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_grads_segments_bias(self):
+        rng = np.random.RandomState(12)
+        B, H, S, D = 2, 2, 128, 32
+        q = rand4(rng, B, H, S, D)
+        k = rand4(rng, B, H, S, D)
+        v = rand4(rng, B, H, S, D)
+        bias = jnp.asarray(rng.randn(1, H, S, S), jnp.float32)
+        ids = np.where(np.arange(S) < 96, 1, 0)
+        seg = jnp.asarray(np.stack([ids, ids]), jnp.int32)
+
+        def f(a, b, c):
+            return jnp.sum(jnp.sin(flash_attention_bhsd(
+                a, b, c, bias=bias, q_segment_ids=seg, kv_segment_ids=seg,
+                block_q=64, block_k=64)))
+
+        def g(a, b, c):
+            return jnp.sum(jnp.sin(naive(a, b, c, bias=bias, qseg=seg,
+                                         kseg=seg)))
+
+        got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        ref = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        for ga, ra in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(ga), np.asarray(ra),
+                                       rtol=2e-3, atol=2e-4)
+            assert np.all(np.isfinite(np.asarray(ga)))
 
 
 class TestTapeIntegration:
@@ -135,3 +346,40 @@ class TestTapeIntegration:
         ref = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-4,
                                    atol=2e-5)
+
+    def test_gqa_functional_flash(self):
+        # F.flash_attention accepts GQA-shaped kv in paddle layout
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(2)
+        B, S, H, Hkv, D = 2, 128, 4, 2, 32
+        q = pt.to_tensor(rng.randn(B, S, H, D).astype(np.float32),
+                         stop_gradient=False)
+        k = pt.to_tensor(rng.randn(B, S, Hkv, D).astype(np.float32),
+                         stop_gradient=False)
+        v = pt.to_tensor(rng.randn(B, S, Hkv, D).astype(np.float32),
+                         stop_gradient=False)
+        out = F.flash_attention(q, k, v, causal=True)
+        ref = naive(jnp.swapaxes(q.data, 1, 2), jnp.swapaxes(k.data, 1, 2),
+                    jnp.swapaxes(v.data, 1, 2), causal=True)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.asarray(jnp.swapaxes(ref, 1, 2)),
+                                   rtol=2e-4, atol=2e-5)
+        out.mean().backward()
+        assert k.grad.shape == [B, S, Hkv, D]
+
+    def test_segment_ids_through_functional(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(3)
+        B, S, H, D = 2, 128, 2, 32
+        q = pt.to_tensor(rng.randn(B, S, H, D).astype(np.float32))
+        seg = pt.to_tensor(
+            np.where(np.arange(S) < 64, 1, 0)[None].repeat(B, 0)
+            .astype(np.int32))
+        out = F.flash_attention(q, q, q, q_segment_ids=seg,
+                                kv_segment_ids=seg)
+        ref = naive(jnp.swapaxes(q.data, 1, 2), jnp.swapaxes(q.data, 1, 2),
+                    jnp.swapaxes(q.data, 1, 2), qseg=seg.data,
+                    kseg=seg.data)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.asarray(jnp.swapaxes(ref, 1, 2)),
+                                   rtol=2e-4, atol=2e-5)
