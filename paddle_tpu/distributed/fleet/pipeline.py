@@ -1,20 +1,40 @@
-"""Pipeline parallelism: PipelineLayer + host-driven 1F1B schedule.
+"""Pipeline parallelism: PipelineLayer + chunk-granular 1F1B schedule with
+virtual-pipeline interleave.
 
 Parity with the reference's PP stack
 (``fleet/meta_parallel/parallel_layers/pp_layers.py``: ``LayerDesc:57``,
 ``SharedLayerDesc:77``, ``PipelineLayer:209`` segmenting a layer list into
-stages; ``fleet/meta_parallel/pipeline_parallel.py``:
-``forward_backward_pipeline:117`` 1F1B, ``train_batch:228``).
+stages — including ``num_virtual_pipeline_stages``; and
+``fleet/meta_parallel/pipeline_parallel.py``:
+``forward_backward_pipeline:117`` 1F1B, ``train_batch:228``,
+``PipelineParallelWithInterleave:461`` virtual-pipeline interleave).
 
 TPU-native redesign (SURVEY.md §7: "PP stays host-orchestrated — the one
 piece of FleetExecutor worth rebuilding"): each stage's parameters live on
-that stage's devices; the 1F1B loop issues per-stage forward/backward
+that stage's devices; the schedule issues per-chunk forward/backward
 programs from the single controller and moves micro-batch activations
-between stages with ``jax.device_put`` (which compiles to ICI transfers —
-the send_v2/recv_v2 of the reference's ``_p2p_helper``). Because jax
-dispatch is async, issuing in 1F1B order overlaps stage compute exactly the
-way the reference's NCCL-stream schedule does, while bounding the number of
-in-flight activation sets to the pipeline depth.
+between stages with ``jax.device_put`` (compiling to ICI transfers — the
+send_v2/recv_v2 of the reference's ``_p2p_helper``). Because jax dispatch is
+async, issuing work in schedule order overlaps stage compute the way the
+reference's NCCL-stream schedule does, while the scheduler bounds in-flight
+activations exactly like 1F1B.
+
+Interleave: with ``num_virtual_pipeline_stages = v`` each physical stage
+holds ``v`` model chunks assigned round-robin (chunk c lives on stage
+``c % S`` — the reference/Megatron placement), and scheduling happens at
+chunk granularity. The warmup ramp then costs chunk-units of ``1/v`` of a
+stage's work, shrinking the pipeline-fill bubble by ~``v`` — the
+interleave's entire point. The scheduler is a deterministic list scheduler:
+every slot, each free stage takes its oldest ready unit, preferring
+backward (classic 1F1B memory policy); it also records per-stage busy/idle
+slots, exposed as ``last_schedule_stats`` so the bubble is *measured*, not
+asserted.
+
+``recompute_interval = k`` wraps every run of ``k`` consecutive layers
+inside a chunk in activation recompute (``fleet.utils.recompute`` — the
+tape-level ``jax.checkpoint``), trading one extra forward for dropping
+intra-chunk residuals; only chunk-boundary activations stay live (the
+reference's ``_recompute_interval`` semantics in pp_layers.py).
 """
 from __future__ import annotations
 
@@ -66,18 +86,38 @@ class SharedLayerDesc(LayerDesc):
         return registry[self.key]
 
 
+class _RecomputeGroup(Layer):
+    """Wraps a run of existing layers (sharing their Parameter objects) so
+    ``fleet.utils.recompute`` threads the parameters through the
+    rematerialized region."""
+
+    def __init__(self, layers):
+        super().__init__()
+        from paddle_tpu.nn.containers import LayerList
+        self.seq = LayerList(layers)
+
+    def forward(self, x):
+        for l in self.seq:
+            x = l(x)
+        return x
+
+
 class PipelineLayer(Layer):
     """Segment a layer sequence into pipeline stages
     (reference: pp_layers.py:209).
 
     ``layers`` is a list of Layers / LayerDescs / callables. Segmentation is
-    uniform by count (reference's default "uniform" seg_method); each
-    stage's parameters are committed to that stage's devices.
+    uniform by count (reference's default "uniform" seg_method) over
+    ``num_stages * num_virtual_pipeline_stages`` chunks; chunk ``c`` is
+    placed on physical stage ``c % num_stages`` (round-robin, the
+    Megatron/reference interleave placement). Each chunk's parameters are
+    committed to its stage's devices.
     """
 
     def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
                  loss_fn: Optional[Callable] = None, topology=None,
                  seg_method: str = "uniform", recompute_interval: int = 0,
+                 num_virtual_pipeline_stages: int = 1,
                  mesh=None, devices: Optional[List] = None):
         super().__init__()
         import jax
@@ -106,8 +146,13 @@ class PipelineLayer(Layer):
             self._stage_devices = [flat[i * per:(i + 1) * per]
                                    for i in range(self.num_stages)]
         self._loss_fn = loss_fn
+        if num_virtual_pipeline_stages < 1:
+            raise ValueError("num_virtual_pipeline_stages must be >= 1")
+        self.num_virtual_stages = num_virtual_pipeline_stages
+        self.num_chunks = self.num_stages * self.num_virtual_stages
+        self.recompute_interval = recompute_interval
 
-        # materialize layers and segment uniformly
+        # materialize layers and segment uniformly over chunks
         built: List[Layer] = []
         shared_registry: dict = {}
         for item in layers:
@@ -117,16 +162,31 @@ class PipelineLayer(Layer):
                 built.append(item)
             else:
                 raise TypeError(f"unsupported pipeline item {item!r}")
-        bounds = self._segment(len(built), self.num_stages, seg_method)
-        self._stage_layers: List[List[Layer]] = []
+        if len(built) < self.num_chunks:
+            raise ValueError(
+                f"{len(built)} layers cannot fill {self.num_chunks} chunks "
+                f"({self.num_stages} stages x {self.num_virtual_stages} "
+                "virtual)")
+        bounds = self._segment(len(built), self.num_chunks, seg_method)
+        self._chunk_layers: List[List[Layer]] = []
         from paddle_tpu.nn.containers import LayerList
         all_list = LayerList()
-        for s in range(self.num_stages):
-            seg = built[bounds[s]:bounds[s + 1]]
-            self._stage_layers.append(seg)
+        for c in range(self.num_chunks):
+            seg = built[bounds[c]:bounds[c + 1]]
+            self._chunk_layers.append(seg)
             for l in seg:
                 all_list.append(l)
         self.layers = all_list
+        # recompute groups are Layer wrappers (fleet.utils.recompute only
+        # threads parameters through Layers/bound methods, not closures);
+        # kept OUT of the sublayer registry so parameters() stays exact
+        if recompute_interval > 0:
+            k = recompute_interval
+            groups = []
+            for seg in self._chunk_layers:
+                groups.append([_RecomputeGroup(seg[i:i + k])
+                               for i in range(0, len(seg), k)])
+            self.__dict__["_recompute_groups"] = groups
         self._place_params()
 
     @staticmethod
@@ -140,11 +200,18 @@ class PipelineLayer(Layer):
             bounds.append(bounds[-1] + base + (1 if s < rem else 0))
         return bounds
 
+    # chunk c lives on stage c % S (round-robin interleave placement)
+    def chunk_stage(self, c: int) -> int:
+        return c % self.num_stages
+
+    def chunk_device(self, c: int):
+        return self._stage_devices[self.chunk_stage(c)][0]
+
     def _place_params(self):
-        """Commit each stage's params to its first device (ICI neighbors)."""
+        """Commit each chunk's params to its stage's first device."""
         import jax
-        for s, seg in enumerate(self._stage_layers):
-            dev = self._stage_devices[s][0]
+        for c, seg in enumerate(self._chunk_layers):
+            dev = self.chunk_device(c)
             for layer in seg:
                 for p in layer.parameters():
                     p._data = jax.device_put(p.data, dev)
@@ -155,29 +222,54 @@ class PipelineLayer(Layer):
     def stage_device(self, s: int):
         return self._stage_devices[s][0]
 
+    # --- legacy single-virtual-stage accessors (v=1: chunk == stage) ----
+    @property
+    def _stage_layers(self):
+        if self.num_virtual_stages != 1:
+            raise AttributeError(
+                "_stage_layers is undefined under interleave; use "
+                "_chunk_layers")
+        return self._chunk_layers
+
     def stage_forward(self, s: int, x):
-        for layer in self._stage_layers[s]:
-            x = layer(x)
+        return self.chunk_forward(s, x)
+
+    def chunk_forward(self, c: int, x):
+        """Run chunk ``c`` on input ``x``, honoring recompute_interval:
+        every run of k consecutive layers executes under activation
+        recompute, so only the run boundaries stay live on the tape."""
+        if self.recompute_interval <= 0 or not self.training:
+            for layer in self._chunk_layers[c]:
+                x = layer(x)
+            return x
+        from .utils import recompute
+        for group in self.__dict__["_recompute_groups"][c]:
+            x = recompute(group, x)
         return x
 
     def forward(self, x):
         """Non-pipelined sequential run (debug/eval parity path)."""
         import jax
-        for s in range(self.num_stages):
+        for c in range(self.num_chunks):
             if isinstance(x, Tensor):
-                x = Tensor(jax.device_put(x.data, self.stage_device(s)),
+                x = Tensor(jax.device_put(x.data, self.chunk_device(c)),
                            stop_gradient=x.stop_gradient)
-            x = self.stage_forward(s, x)
+            x = self.chunk_forward(c, x)
         return x
 
 
 class PipelineParallel(Layer):
-    """1F1B micro-batch engine (reference: pipeline_parallel.py:117).
+    """Chunk-granular 1F1B micro-batch engine
+    (reference: pipeline_parallel.py:117 ``forward_backward_pipeline``,
+    :461 ``PipelineParallelWithInterleave``).
 
     ``train_batch(data, optimizer)`` splits the batch into micro-batches,
-    runs the 1F1B schedule (warmup fwd, steady fwd/bwd pairs, cooldown bwd),
-    accumulates gradients, steps the optimizer, and returns the mean loss —
-    the reference's ``train_batch:228`` contract.
+    runs the 1F1B list schedule over (micro, chunk) units, accumulates
+    gradients, steps the optimizer, and returns the mean loss — the
+    reference's ``train_batch:228`` contract. After each call,
+    ``last_schedule_stats`` holds the measured schedule: per-stage busy and
+    idle slots, the bubble fraction, and the peak number of in-flight
+    activation sets.
     """
 
     def __init__(self, layers: PipelineLayer, hcg=None, strategy=None,
@@ -186,6 +278,8 @@ class PipelineParallel(Layer):
         self._layers = layers
         self.accumulate_steps = accumulate_steps or layers.num_stages
         self._loss_fn = layers._loss_fn
+        self.last_schedule_stats: dict = {}
+        self._schedule_cache: dict = {}
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)
@@ -196,71 +290,256 @@ class PipelineParallel(Layer):
     def forward(self, x):
         return self._layers(x)
 
+    # ------------------------------------------------------------------
+    # deterministic 1F1B list schedule over (micro, chunk) units
+    # ------------------------------------------------------------------
+    def _build_schedule(self, n_micro: int):
+        """Return (issue order [("f"|"b", micro, chunk), ...], stats).
+
+        v == 1: greedy 1F1B list schedule (backward-first, oldest-ready) —
+        it reproduces the textbook ramp and the exact
+        (S-1)/(n_micro + S - 1) bubble. v > 1: the reference/Megatron
+        interleaved order (``PipelineParallelWithInterleave``), which is
+        NOT greedy-optimal slot packing but the specific sequence whose
+        warmup steps cost 1/v of a stage — that's where the bubble shrinks
+        to ~(S-1)/(v * n_micro). Both are simulated on S workers (bwd
+        costs 2 fwd units) to produce real busy/idle accounting in
+        ``stats``.
+        """
+        if self._layers.num_virtual_stages > 1:
+            return self._interleave_schedule(n_micro)
+        return self._greedy_schedule(n_micro)
+
+    def _greedy_schedule(self, n_micro: int):
+        S = self._layers.num_stages
+        C = self._layers.num_chunks
+        v = self._layers.num_virtual_stages
+        done_f = set()
+        done_b = set()
+        live = {s: 0 for s in range(S)}  # fwd activation sets held
+        cap = {s: (S - s) + (v - 1) * S for s in range(S)}
+        order = []
+        # simulated clock per stage, in fwd-unit slots (bwd = 2 slots)
+        clock = {s: 0.0 for s in range(S)}
+        busy = {s: 0.0 for s in range(S)}
+        finish_f = {}  # (m, c) -> sim completion time
+        finish_b = {}
+
+        def ready_f(m, c):
+            return (m, c) not in done_f and (
+                c == 0 or (m, c - 1) in done_f)
+
+        def ready_b(m, c):
+            return (m, c) not in done_b and (m, c) in done_f and (
+                c == C - 1 or (m, c + 1) in done_b)
+
+        total_units = 2 * n_micro * C
+        while len(done_f) + len(done_b) < total_units:
+            progressed = False
+            for s in range(S):
+                chunks = [c for c in range(C)
+                          if self._layers.chunk_stage(c) == s]
+                # 1F1B: drain the oldest ready backward first
+                cand_b = sorted((m, c) for c in chunks
+                                for m in range(n_micro) if ready_b(m, c))
+                cand_f = sorted((m, c) for c in chunks
+                                for m in range(n_micro) if ready_f(m, c))
+                unit = None
+                if cand_b:
+                    unit = ("b",) + cand_b[0]
+                elif cand_f and live[s] < cap[s]:
+                    unit = ("f",) + cand_f[0]
+                elif cand_f and not cand_b:
+                    unit = ("f",) + cand_f[0]  # cap reached but nothing
+                    # to drain yet (deep warmup): must progress
+                if unit is None:
+                    continue
+                kind, m, c = unit
+                # simulated start: worker free AND dependency finished
+                if kind == "f":
+                    dep = finish_f.get((m, c - 1), 0.0) if c else 0.0
+                    t0 = max(clock[s], dep)
+                    clock[s] = t0 + 1.0
+                    busy[s] += 1.0
+                    finish_f[(m, c)] = clock[s]
+                    done_f.add((m, c))
+                    live[s] += 1
+                else:
+                    dep = (finish_b.get((m, c + 1), 0.0)
+                           if c < C - 1 else finish_f.get((m, c), 0.0))
+                    t0 = max(clock[s], dep)
+                    clock[s] = t0 + 2.0
+                    busy[s] += 2.0
+                    finish_b[(m, c)] = clock[s]
+                    done_b.add((m, c))
+                    live[s] -= 1
+                order.append(unit)
+                progressed = True
+            if not progressed:  # defensive: cannot happen with valid deps
+                raise RuntimeError("pipeline schedule deadlocked")
+        span = max(clock.values())
+        stats = {
+            "slots_span": span,
+            "busy": dict(busy),
+            "bubble_fraction": round(
+                1.0 - sum(busy.values()) / (span * S), 4) if span else 0.0,
+        }
+        return order, stats
+
+    def _interleave_schedule(self, n_micro: int):
+        """Reference/Megatron interleaved 1F1B
+        (``pipeline_parallel.py:461``; Megatron ``schedules.py``
+        ``forward_backward_pipelining_with_interleaving``): rank r warms up
+        ``2*(S-r-1) + (v-1)*S`` chunk-forwards, then strictly alternates
+        1F1B; micro-batches advance in groups of S per chunk, forward
+        chunks ascending, backward chunks descending. Requires
+        ``n_micro % S == 0`` (the reference's constraint too)."""
+        S = self._layers.num_stages
+        v = self._layers.num_virtual_stages
+        C = self._layers.num_chunks
+        if n_micro % S:
+            raise ValueError(
+                f"interleaved pipeline needs accumulate_steps divisible by "
+                f"num_stages (got {n_micro} micro-batches, {S} stages)")
+        mv = n_micro * v
+        pv = S * v
+
+        def unit(r, k, forward):
+            group, ing = divmod(k, pv)
+            local_chunk = ing // S
+            if not forward:
+                local_chunk = v - 1 - local_chunk
+            micro = group * S + ing % S
+            return micro, local_chunk * S + r
+
+        # local (in-order) sequence per rank
+        local = {}
+        for r in range(S):
+            w = min(2 * (S - r - 1) + (v - 1) * S, mv)
+            seq = [("f", k) for k in range(w)]
+            fi, bi = w, 0
+            while fi < mv:  # steady state: one forward, then one backward
+                seq.append(("f", fi))
+                fi += 1
+                seq.append(("b", bi))
+                bi += 1
+            while bi < mv:
+                seq.append(("b", bi))
+                bi += 1
+            local[r] = seq
+
+        # simulate: each rank executes its sequence strictly in order,
+        # starting a unit once its cross-rank dependency has finished
+        f_dur, b_dur = 1.0 / v, 2.0 / v
+        pos = {r: 0 for r in range(S)}
+        clock = {r: 0.0 for r in range(S)}
+        busy = {r: 0.0 for r in range(S)}
+        finish_f, finish_b = {}, {}
+        events = []
+        remaining = sum(len(s) for s in local.values())
+        while remaining:
+            progressed = False
+            for r in range(S):
+                while pos[r] < len(local[r]):
+                    kind, k = local[r][pos[r]]
+                    m, c = unit(r, k, kind == "f")
+                    if kind == "f":
+                        if c > 0 and (m, c - 1) not in finish_f:
+                            break
+                        dep = finish_f.get((m, c - 1), 0.0)
+                        dur = f_dur
+                    else:
+                        if (m, c) not in finish_f:
+                            break
+                        if c < C - 1 and (m, c + 1) not in finish_b:
+                            break
+                        dep = (finish_b.get((m, c + 1), 0.0)
+                               if c < C - 1 else finish_f[(m, c)])
+                        dur = b_dur
+                    start = max(clock[r], dep)
+                    clock[r] = start + dur
+                    busy[r] += dur
+                    (finish_f if kind == "f" else finish_b)[(m, c)] = \
+                        clock[r]
+                    events.append((start, r, kind, m, c))
+                    pos[r] += 1
+                    remaining -= 1
+                    progressed = True
+            if not progressed:
+                raise RuntimeError("interleaved schedule deadlocked")
+        events.sort(key=lambda e: (e[0], e[1]))
+        order = [(kind, m, c) for _, _, kind, m, c in events]
+        span = max(clock.values())
+        stats = {
+            "slots_span": span,
+            "busy": dict(busy),
+            "bubble_fraction": round(
+                1.0 - sum(busy.values()) / (span * S), 4) if span else 0.0,
+        }
+        return order, stats
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         import jax
         from paddle_tpu import ops
+        from paddle_tpu.profiler import RecordEvent
 
         inputs, labels = data
         n_micro = self.accumulate_steps
-        S = self._layers.num_stages
+        L = self._layers
+        C = L.num_chunks
         micro_x = ops.split(inputs, n_micro, axis=0)
         micro_y = ops.split(labels, n_micro, axis=0)
 
-        # tape-per-microbatch: saved (per stage) forward closures to drive
-        # backward in 1F1B order; activations hop stages via device_put
-        fwd_out = {}  # (micro, stage) -> (output Tensor, input Tensor)
+        # saved per-(micro, chunk) forward results to drive backward in
+        # schedule order; activations hop stages via device_put
+        fwd_out = {}  # (m, c) -> (output Tensor, input Tensor)
         losses = []
-        grads_ready = {}  # micro -> cotangent Tensor flowing backward
+        grads_ready = {}  # m -> cotangent flowing into chunk c during bwd
+        peak_in_flight = [0]
 
-        def run_fwd(m, s):
-            x = fwd_out[(m, s - 1)][0] if s > 0 else micro_x[m]
-            x = Tensor(jax.device_put(x.data,
-                                      self._layers.stage_device(s)),
+        def run_fwd(m, c):
+            x = fwd_out[(m, c - 1)][0] if c > 0 else micro_x[m]
+            x = Tensor(jax.device_put(x.data, L.chunk_device(c)),
                        stop_gradient=False)
-            out = self._layers.stage_forward(s, x)
-            fwd_out[(m, s)] = (out, x)
-            if s == S - 1:
-                y = Tensor(jax.device_put(
-                    micro_y[m].data, self._layers.stage_device(s)),
-                    stop_gradient=True)
-                loss = self._loss_fn(out, y)
+            with RecordEvent(f"pp_fwd_m{m}_c{c}"):
+                out = L.chunk_forward(c, x)
+            fwd_out[(m, c)] = (out, x)
+            peak_in_flight[0] = max(peak_in_flight[0], len(fwd_out))
+            if c == C - 1:
+                y = Tensor(jax.device_put(micro_y[m].data,
+                                          L.chunk_device(c)),
+                           stop_gradient=True)
+                with RecordEvent(f"pp_loss_m{m}"):
+                    loss = self._loss_fn(out, y)
                 losses.append(loss)
-                fwd_out[(m, s)] = (loss, x)
+                fwd_out[(m, c)] = (loss, x)
 
-        def run_bwd(m, s):
-            out, x_in = fwd_out.pop((m, s))
-            if s == S - 1:
-                # scale for mean over micro-batches
-                out.backward(Tensor(np.float32(1.0 / n_micro)))
-            else:
-                out.backward(grads_ready.pop(m))
-            if s > 0:
+        def run_bwd(m, c):
+            out, x_in = fwd_out.pop((m, c))
+            with RecordEvent(f"pp_bwd_m{m}_c{c}"):
+                if c == C - 1:
+                    # scale for mean over micro-batches
+                    out.backward(Tensor(np.float32(1.0 / n_micro)))
+                else:
+                    out.backward(grads_ready.pop(m))
+            if c > 0:
                 g = x_in.grad
-                grads_ready[m] = Tensor(jax.device_put(
-                    g.data, self._layers.stage_device(s - 1)),
+                grads_ready[m] = Tensor(
+                    jax.device_put(g.data, L.chunk_device(c - 1)),
                     stop_gradient=True)
             # x_in is a non-leaf boundary tensor: drop its grad storage
             x_in.grad = None
 
-        # --- 1F1B schedule, issued stage-major so async dispatch overlaps:
-        # classic single-controller ordering — all fwds for a micro-batch
-        # ripple down; backward starts as soon as the last stage finishes a
-        # micro-batch; memory in flight bounded by S micro-batches.
-        warmup = min(S, n_micro)
-        fwd_m = 0
-        bwd_m = 0
-        for m in range(warmup):
-            for s in range(S):
-                run_fwd(m, s)
-            fwd_m += 1
-        while bwd_m < n_micro:
-            for s in reversed(range(S)):
-                run_bwd(bwd_m, s)
-            bwd_m += 1
-            if fwd_m < n_micro:
-                for s in range(S):
-                    run_fwd(fwd_m, s)
-                fwd_m += 1
+        if n_micro not in self._schedule_cache:
+            self._schedule_cache[n_micro] = self._build_schedule(n_micro)
+        order, stats = self._schedule_cache[n_micro]
+        stats = dict(stats)
+        for kind, m, c in order:
+            (run_fwd if kind == "f" else run_bwd)(m, c)
+        stats["peak_in_flight_activations"] = peak_in_flight[0]
+        stats["n_micro"] = n_micro
+        stats["n_chunks"] = C
+        self.last_schedule_stats = stats
 
         if scaler is not None:
             scaler.step(optimizer)

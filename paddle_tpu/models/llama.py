@@ -42,6 +42,7 @@ class LlamaConfig:
     max_position_embeddings: int = 8192
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     tensor_parallel: bool = False
     recompute: bool = False
@@ -251,6 +252,18 @@ class LlamaForCausalLM(nn.Layer):
         else:
             self.lm_head = _make_linear(cfg, cfg.hidden_size,
                                         cfg.vocab_size, "col")
+        self._init_weights()
+
+    def _init_weights(self):
+        """Llama recipe init: every 2-D weight (embedding, projections)
+        ~ N(0, initializer_range); norms stay at ones. Without this the
+        tied logits head scales like sqrt(d) and the initial loss explodes
+        (HF LlamaPreTrainedModel._init_weights semantics)."""
+        from paddle_tpu.nn import initializer as I
+        init = I.Normal(std=self.cfg.initializer_range)
+        for _, p in self.named_parameters():
+            if len(p.shape) == 2:
+                p.set_value(init(p.shape))  # set_value casts to p's dtype
 
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)

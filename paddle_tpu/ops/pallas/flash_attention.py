@@ -52,8 +52,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention_bshd", "flash_attention_bhsd"]
 
-_DEF_BLOCK_Q = 512
-_DEF_BLOCK_K = 512
+_DEF_BLOCK_Q = 1024  # swept on v5e: 1024/1024 beats 512/512 by ~16% fwd+bwd
+_DEF_BLOCK_K = 1024
+_BIAS_BLOCK = 512    # bias tiles are f32 [bq, bk]: cap so VMEM double-buffers
 _LANES = 128
 # refuse block sizes that can't double-buffer in ~16MB VMEM; callers fall
 # back to the composite instead of paying a doomed Mosaic compile (hit by
@@ -630,6 +631,14 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
         sq, sk, d = q.shape[1], k.shape[1], q.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    bias_bh = None
+    if bias is not None:
+        bias, bias_bh = _norm_bias(bias, b, hq, sq, sk)
+        if not bias_bh[2]:  # full [bq, bk] f32 tiles: cap for VMEM; the
+            # one-row key-padding shape streams [1, bk] and keeps the
+            # swept-fast 1024 blocks
+            block_q = min(block_q, _BIAS_BLOCK)
+            block_k = min(block_k, _BIAS_BLOCK)
     req_q, req_k = block_q, block_k
     block_q = _pick_block(block_q, sq)
     block_k = _pick_block(block_k, sk)
@@ -646,9 +655,6 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
             f"(forced blocks ({block_q}, {block_k}) exceed {_MAX_BLOCK}); "
             "pad the sequence to a multiple of 128")
 
-    bias_bh = None
-    if bias is not None:
-        bias, bias_bh = _norm_bias(bias, b, hq, sq, sk)
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError("segment ids must be given for both q and kv")
     q_seg = kv_seg = None
