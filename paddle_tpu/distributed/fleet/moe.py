@@ -6,13 +6,26 @@ gates, ``MoEScatter``/``MoEGather`` PyLayers over the ``global_scatter/
 global_gather`` all-to-all ops, and the cutlass grouped GEMM
 ``phi/kernels/fusion/cutlass/moe/moe_kernel.cu``).
 
-TPU-native redesign: dispatch is the GShard dense-einsum formulation —
-one-hot capacity dispatch/combine tensors contracted against the tokens —
-and experts are *stacked* weight tensors ``[E, d_model, d_hidden]`` sharded
-on the ``ep`` mesh axis, so a single einsum is the grouped GEMM and GSPMD
-lowers the dispatch contraction to the all-to-all the reference launches
-explicitly. Over-capacity tokens drop (contribute zero), matching
-``global_scatter`` semantics.
+TPU-native redesign: experts are *stacked* weight tensors
+``[E, d_model, d_hidden]`` sharded on the ``ep`` mesh axis, so one einsum is
+the grouped GEMM and GSPMD lowers the token redistribution to the
+all-to-all the reference launches explicitly. Over-capacity tokens drop
+(contribute zero), matching ``global_scatter`` semantics.
+
+Two dispatch formulations behind the same API (``dispatch_mode``):
+
+* ``"ragged"`` (default) — index routing, the ``global_scatter/
+  global_gather`` shape: each of the T*K (token, expert) assignments gets a
+  capacity slot ``e*C + position`` (position = running count within the
+  expert, the same order-dependent rule as the dense path, so drops are
+  bit-identical); tokens scatter-add into an ``[E*C, M]`` buffer, the
+  grouped GEMM runs, and combine gathers rows back per assignment. Peak
+  intermediate is O(E*C*M + T*E) — no ``[T, E, C]`` tensor ever exists,
+  which at DeepSeekMoE scale (E=64, T=16K) is the difference between ~2 MB
+  of routing state and a multi-GB one-hot wall.
+* ``"dense"`` — the original GShard one-hot einsum formulation
+  ([T, E, C] dispatch/combine contractions); kept as the differential
+  -testing oracle and for tiny shapes.
 """
 from __future__ import annotations
 
@@ -73,12 +86,17 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
                  top_k=None, capacity_factor=1.25, activation="gelu",
-                 mesh=None, axis: Optional[str] = "ep", name=None):
+                 dispatch_mode="ragged", mesh=None,
+                 axis: Optional[str] = "ep", name=None):
         super().__init__()
+        if dispatch_mode not in ("ragged", "dense"):
+            raise ValueError(f"dispatch_mode {dispatch_mode!r} must be "
+                             "'ragged' or 'dense'")
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
+        self.dispatch_mode = dispatch_mode
         self._activation = activation
         if isinstance(gate, str):
             cls = {"naive": NaiveGate, "switch": SwitchGate,
@@ -119,54 +137,87 @@ class MoELayer(Layer):
         act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
                "silu": jax.nn.silu}[self._activation]
 
+        ragged = self.dispatch_mode == "ragged"
+
         def f(xa, gw, w1, b1, w2, b2):
             lead = xa.shape[:-1]
             xt = xa.reshape(-1, xa.shape[-1])  # [T, M]
-            T = xt.shape[0]
+            T, M = xt.shape
             C = max(int(cap_f * T * K / E), 1)
 
             logits = xt @ gw  # [T, E]
             probs = jax.nn.softmax(logits, axis=-1)
 
             # top-k selection, sequential GShard style: pick expert k,
-            # mask it out, pick the next
+            # mask it out, pick the next. Positions (running count within
+            # each expert, accumulated across picks) define the capacity
+            # drop rule — shared verbatim by both dispatch formulations.
             remaining = probs
-            combine = jnp.zeros((T, E, C), xt.dtype)
-            dispatch = jnp.zeros((T, E, C), bool)
-            # position counters per expert accumulate across the k picks
             position_base = jnp.zeros((E,), jnp.int32)
             me = probs.mean(axis=0)  # mean gate prob per expert
             ce_acc = jnp.zeros((E,), probs.dtype)
+            picks = []  # (expert idx [T], gate_val [T], pos [T], keep [T])
             for _ in range(K):
                 idx = jnp.argmax(remaining, axis=-1)  # [T]
                 onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)  # [T, E]
                 ce_acc = ce_acc + onehot.mean(axis=0).astype(probs.dtype)
-                # position of each token within its expert's capacity
                 pos = jnp.cumsum(onehot, axis=0) - 1 + position_base[None, :]
                 position_base = position_base + onehot.sum(axis=0)
                 pos_t = (pos * onehot).sum(axis=-1)  # [T]
                 keep = pos_t < C
                 gate_val = (probs * onehot).sum(axis=-1)  # [T]
-                pos_oh = jax.nn.one_hot(jnp.where(keep, pos_t, C), C + 1,
-                                        dtype=xt.dtype)[:, :C]  # [T, C]
-                combine = combine + gate_val[:, None, None] * \
-                    onehot.astype(xt.dtype)[:, :, None] * pos_oh[:, None, :]
-                dispatch = dispatch | (
-                    (onehot[:, :, None] * pos_oh[:, None, :].astype(
-                        jnp.int32)) > 0)
+                picks.append((idx, gate_val, pos_t, keep))
                 remaining = remaining * (1 - onehot.astype(probs.dtype))
 
-            # renormalize combine weights over the selected experts
-            denom = combine.sum(axis=(1, 2), keepdims=True)
-            combine = combine / jnp.maximum(denom, 1e-9)
+            # renormalize gates over the KEPT assignments (dense path
+            # normalized the combine tensor — same entries)
+            denom = sum(gv * kp.astype(gv.dtype)
+                        for _, gv, _, kp in picks)
+            denom = jnp.maximum(denom, 1e-9)  # [T]
 
-            # dispatch -> [E, C, M] (GSPMD: all-to-all onto the ep axis)
-            expert_in = jnp.einsum("tec,tm->ecm",
-                                   dispatch.astype(xt.dtype), xt)
+            if ragged:
+                # ---- index routing (global_scatter/global_gather shape):
+                # slot = e*C + position; dropped assignments land on a
+                # sentinel row that is sliced off. Every slot receives at
+                # most one token (positions are unique per expert), so the
+                # scatter-add is conflict-free.
+                buf = jnp.zeros((E * C + 1, M), xt.dtype)
+                for idx, gv, pos_t, keep in picks:
+                    slots = jnp.where(keep, idx * C + pos_t, E * C)
+                    buf = buf.at[slots].add(xt)
+                expert_in = buf[:E * C].reshape(E, C, M)
+            else:
+                # ---- dense GShard one-hot contraction ([T, E, C] lives).
+                # dispatch and combine share one per-pick [T,E]x[T,C]
+                # outer product so the drop encoding exists exactly once
+                dispatch = jnp.zeros((T, E, C), xt.dtype)
+                combine = jnp.zeros((T, E, C), xt.dtype)
+                for idx, gv, pos_t, keep in picks:
+                    onehot = jax.nn.one_hot(idx, E, dtype=xt.dtype)
+                    pos_oh = jax.nn.one_hot(
+                        jnp.where(keep, pos_t, C), C + 1,
+                        dtype=xt.dtype)[:, :C]
+                    cell = onehot[:, :, None] * pos_oh[:, None, :]
+                    dispatch = dispatch + cell
+                    combine = combine + \
+                        (gv / denom).astype(xt.dtype)[:, None, None] * cell
+                expert_in = jnp.einsum("tec,tm->ecm", dispatch, xt)
+
+            # grouped GEMM over stacked experts (ep-sharded on the mesh)
             h = act(jnp.einsum("ecm,emh->ech", expert_in, w1) +
                     b1[:, None, :])
             expert_out = jnp.einsum("ech,ehm->ecm", h, w2) + b2[:, None, :]
-            out = jnp.einsum("tec,ecm->tm", combine, expert_out)
+
+            if ragged:
+                flat = expert_out.reshape(E * C, M)
+                out = jnp.zeros_like(xt)
+                for idx, gv, pos_t, keep in picks:
+                    slots = jnp.where(keep, idx * C + pos_t, 0)
+                    w = (gv * keep.astype(gv.dtype) / denom).astype(
+                        xt.dtype)
+                    out = out + flat[slots] * w[:, None]
+            else:
+                out = jnp.einsum("tec,ecm->tm", combine, expert_out)
 
             if aux_kind == "switch":
                 aux = (me * ce_acc).sum() * E
