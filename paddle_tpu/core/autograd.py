@@ -115,6 +115,10 @@ class GradNode:
 
 def _check_nan_inf(name, arrays):
     for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            # inside a jit/lax trace the value is symbolic — the debug
+            # check only applies to concrete eager outputs
+            continue
         if jnp.issubdtype(a.dtype, jnp.floating):
             bad = bool(jnp.any(~jnp.isfinite(a)))
             if bad:

@@ -399,7 +399,19 @@ class Tensor:
         return id(self)
 
     def __bool__(self):
-        return bool(self._data)
+        try:
+            return bool(self._data)
+        except Exception as e:  # jax TracerBoolConversionError
+            if "Tracer" in type(e).__name__ or "racer" in str(e):
+                raise TypeError(
+                    "a Tensor's truth value was read during trace capture "
+                    "(to_static / TrainStep / Executor): data-dependent "
+                    "Python `if`/`while` cannot be compiled. Use "
+                    "paddle_tpu.static.nn.cond(pred, true_fn, false_fn) "
+                    "or paddle_tpu.static.nn.while_loop(cond, body, "
+                    "loop_vars) — XLA-native control flow that stays "
+                    "inside the compiled program.") from e
+            raise
 
     def __float__(self):
         return float(self._data)

@@ -57,3 +57,142 @@ def batch_norm(input, momentum: float = 0.9, epsilon: float = 1e-5,
     out = layer(input)
     out._static_layer = layer
     return out
+
+
+# ======================= control flow ======================================
+# Reference: python/paddle/static/nn/control_flow.py (cond:?, While/
+# while_loop, case, switch_case). The reference lowers these to
+# conditional_block / while ops in the ProgramDesc; here they lower to
+# lax.cond / lax.while_loop — XLA's native control flow — recorded as ONE
+# tape op so both the eager tape (Executor replay) and jit traces
+# (to_static / TrainStep) capture data-dependent branching.
+
+def _unwrap_tree(x):
+    from paddle_tpu.core.tensor import Tensor
+    import jax
+    return jax.tree_util.tree_map(
+        lambda v: v.data if isinstance(v, Tensor) else v, x,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, operands=()):
+    """Data-dependent branch (reference: control_flow.py ``cond``).
+
+    Both branches are traced (XLA ``lax.cond`` executes one on device).
+    With no ``operands`` the branch closures may capture surrounding
+    tensors (paddle's calling convention); gradients then flow through the
+    captured values only under an enclosing jit trace (to_static /
+    TrainStep). Passing explicit ``operands`` tapes the whole branch as
+    one op, so eager backward and Executor replay differentiate/replay it
+    too — prefer it for training code.
+    """
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.autograd import apply_op, no_grad
+    from paddle_tpu.core.tensor import Tensor
+
+    p = pred.data if isinstance(pred, Tensor) else pred
+
+    if not isinstance(p, jax.core.Tracer) and not operands:
+        # concrete pred, closure style: dygraph semantics — just run the
+        # taken branch (ops record on the tape normally). A None branch is
+        # a no-op (paddle parity).
+        if bool(p):
+            return true_fn()
+        return None if false_fn is None else false_fn()
+
+    if false_fn is None:
+        raise ValueError(
+            "cond under trace (or with operands) needs BOTH branches with "
+            "matching output structures — XLA compiles both; pass a "
+            "false_fn that returns the same structure as true_fn")
+
+    # branch outputs may be any pytree: flatten inside the traced branch
+    # (lax.cond requires matching structures), unflatten the Tensors after
+    struct = {}
+
+    def f(p_arr, *ops):
+        def branch(fn):
+            def run(op_arrays):
+                wrapped = [Tensor(a) for a in op_arrays]
+                with no_grad():  # inner ops must not tape: the whole
+                    out = fn(*wrapped)  # cond is ONE tape node
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    _unwrap_tree(out))
+                struct["treedef"] = treedef
+                return tuple(leaves)
+            return run
+        return jax.lax.cond(jnp.reshape(p_arr, ()).astype(bool),
+                            branch(true_fn), branch(false_fn), list(ops))
+
+    out = apply_op(f, pred, *operands, op_name="cond")
+    leaves = list(out) if isinstance(out, (tuple, list)) else [out]
+    return jax.tree_util.tree_unflatten(struct["treedef"], leaves)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Data-dependent loop (reference: control_flow.py ``while_loop``).
+
+    Lowers to ``lax.while_loop`` recorded as one tape op. FORWARD-ONLY:
+    XLA cannot reverse-differentiate an unbounded while (the reference
+    builds explicit backward blocks instead); if any loop var requires
+    grad this raises — use ``lax.scan``-style bounded loops (e.g.
+    ``lax.scan``-based RNN layers) for trainable recurrences.
+    """
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.autograd import apply_op, no_grad, is_grad_enabled
+    from paddle_tpu.core.tensor import Tensor
+
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    tensors = [v for v in loop_vars if isinstance(v, Tensor)]
+    if is_grad_enabled() and any(not t.stop_gradient for t in tensors):
+        raise ValueError(
+            "static.nn.while_loop is forward-only (XLA while has no "
+            "reverse-mode); detach the loop vars or wrap the call in "
+            "no_grad(), and use a bounded scan for trainable loops")
+
+    def f(*vars_):
+        def c(vs):
+            out = cond_fn(*[Tensor(v) for v in vs])
+            out = out.data if isinstance(out, Tensor) else out
+            return jnp.reshape(out, ()).astype(bool)
+
+        def b(vs):
+            with no_grad():
+                out = body_fn(*[Tensor(v) for v in vs])
+            if not isinstance(out, (list, tuple)):
+                out = (out,)
+            return [o.data if isinstance(o, Tensor) else jnp.asarray(o)
+                    for o in out]
+        return tuple(jax.lax.while_loop(c, b, list(vars_)))
+
+    with no_grad():
+        out = apply_op(f, *loop_vars, op_name="while_loop")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference: control_flow.py ``case`` — first true pred wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if not rest:
+        if default is None:
+            return cond(pred, fn, fn)
+        return cond(pred, fn, default)
+    return cond(pred, fn, lambda: case(rest, default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Reference: control_flow.py ``switch_case``."""
+    from paddle_tpu import ops
+    pairs = sorted(branch_fns.items() if isinstance(branch_fns, dict)
+                   else branch_fns)
+    preds = [(ops.equal(branch_index, i), fn) for i, fn in pairs]
+    return case(preds, default=default)
+
+
+__all__ += ["cond", "while_loop", "case", "switch_case"]

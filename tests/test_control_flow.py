@@ -1,0 +1,203 @@
+"""static.nn control flow: cond / while_loop / case / switch_case lowering
+to lax.cond / lax.while_loop, eager + compiled capture parity, and the
+actionable trace-time error for Python `if tensor:` (reference:
+python/paddle/static/nn/control_flow.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu.static import nn as snn
+
+
+def t(x, sg=True):
+    return pt.to_tensor(np.asarray(x, np.float32), stop_gradient=sg)
+
+
+class TestCond:
+    def test_eager_concrete_pred_runs_taken_branch(self):
+        x = t([1.0, 2.0])
+        out = snn.cond(t(np.float32(1.0)) > 0,
+                       lambda: x * 2, lambda: x * 3)
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+        out = snn.cond(t(np.float32(-1.0)) > 0,
+                       lambda: x * 2, lambda: x * 3)
+        np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+
+    def test_operands_style_tapes_and_differentiates(self):
+        x = t([1.0, 2.0], sg=False)
+        pred = t(np.float32(1.0)) > 0
+        out = snn.cond(pred, lambda a: a * 2, lambda a: a * 3,
+                       operands=(x,))
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+        x.clear_grad()
+        out = snn.cond(t(np.float32(-1.0)) > 0, lambda a: a * 2,
+                       lambda a: a * 3, operands=(x,))
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    def test_compiled_data_dependent_branch(self):
+        @pt.jit.to_static
+        def f(x):
+            return snn.cond(x.sum() > 0, lambda a: a * 2,
+                            lambda a: a - 1, operands=(x,))
+
+        np.testing.assert_allclose(f(t([1.0, 2.0])).numpy(), [2.0, 4.0])
+        # SAME compiled program, other branch — data-dependent at runtime
+        np.testing.assert_allclose(f(t([-1.0, -2.0])).numpy(),
+                                   [-2.0, -3.0])
+
+    def test_closure_style_under_trace(self):
+        @pt.jit.to_static
+        def f(x):
+            return snn.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+        np.testing.assert_allclose(f(t([2.0])).numpy(), [4.0])
+        np.testing.assert_allclose(f(t([-2.0])).numpy(), [-3.0])
+
+
+class TestWhileLoop:
+    def test_newton_sqrt_eager(self):
+        # loop-until-converged: Newton iteration for sqrt(2)
+        def cond_fn(i, y):
+            err = pt.ops.abs(y * y - 2.0)
+            return pt.ops.logical_and(err > 1e-6, i < 50)
+
+        def body_fn(i, y):
+            return i + 1, (y + 2.0 / y) / 2.0
+
+        i0 = pt.to_tensor(np.int32(0))
+        y0 = t(np.float32(1.0))
+        i, y = snn.while_loop(cond_fn, body_fn, [i0, y0])
+        assert abs(float(y.numpy()) - np.sqrt(2.0)) < 1e-5
+        assert int(i.numpy()) < 50
+
+    def test_newton_sqrt_compiled_matches_eager(self):
+        def run(v):
+            def cond_fn(y):
+                return pt.ops.abs(y * y - v) > 1e-6
+
+            def body_fn(y):
+                return (y + v / y) / 2.0
+            return snn.while_loop(cond_fn, body_fn, [t(np.float32(1.0))])[0]
+
+        eager = float(run(3.0).numpy())
+
+        @pt.jit.to_static
+        def compiled(x):
+            def cond_fn(y):
+                return pt.ops.abs(y * y - x) > 1e-6
+
+            def body_fn(y):
+                return (y + x / y) / 2.0
+            return snn.while_loop(cond_fn, body_fn,
+                                  [pt.ops.ones_like(x)])[0]
+
+        got = float(compiled(t(np.float32(3.0))).numpy())
+        np.testing.assert_allclose(got, eager, rtol=1e-6)
+        np.testing.assert_allclose(got, np.sqrt(3.0), rtol=1e-5)
+
+    def test_requires_grad_raises(self):
+        y0 = t(np.float32(1.0), sg=False)
+        with pytest.raises(ValueError, match="forward-only"):
+            snn.while_loop(lambda y: y < 10, lambda y: y * 2, [y0])
+
+    def test_loop_until_converged_model_compiles(self):
+        """VERDICT item 6 'done' bar: a model with a data-dependent inner
+        loop compiles under to_static and matches eager."""
+        pt.seed(0)
+
+        class IterNorm(nn.Layer):
+            """Normalizes by iterating x /= 2 until max|x| <= 1."""
+
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+
+                def cond_fn(v):
+                    return pt.ops.max(pt.ops.abs(v)) > 1.0
+
+                def body_fn(v):
+                    return v / 2.0
+                h = snn.while_loop(cond_fn, body_fn, [h.detach()])[0]
+                return h
+
+        m = IterNorm()
+        m.eval()
+        x = t(np.array([[8.0, 1.0, -16.0, 0.5]] * 2))
+        eager = m(x).numpy()
+        compiled = pt.jit.to_static(m)(x).numpy()
+        np.testing.assert_allclose(compiled, eager, rtol=1e-6)
+        assert np.abs(eager).max() <= 1.0
+
+
+class TestCaseSwitch:
+    def test_case_first_true_wins(self):
+        x = t([1.0])
+        out = snn.case([(t(np.float32(0.0)) > 0, lambda: x * 10),
+                        (t(np.float32(1.0)) > 0, lambda: x * 20)],
+                       default=lambda: x * 30)
+        np.testing.assert_allclose(out.numpy(), [20.0])
+
+    def test_switch_case(self):
+        x = t([1.0])
+        idx = pt.to_tensor(np.int32(2))
+        out = snn.switch_case(idx, {1: lambda: x * 10, 2: lambda: x * 20},
+                              default=lambda: x * 30)
+        np.testing.assert_allclose(out.numpy(), [20.0])
+
+
+class TestActionableTraceError:
+    def test_python_if_on_tensor_names_cond(self):
+        @pt.jit.to_static
+        def f(x):
+            if x.sum() > 0:  # data-dependent Python branch: uncapturable
+                return x * 2
+            return x * 3
+
+        with pytest.raises(TypeError, match="static.nn.cond"):
+            f(t([1.0, 2.0]))
+
+    def test_eager_bool_still_works(self):
+        assert bool(t(np.float32(1.0)) > 0)
+
+
+class TestReviewRegressions:
+    def test_false_fn_none_is_noop(self):
+        x = t([1.0, 2.0])
+        ran = []
+        out = snn.cond(t(np.float32(-1.0)) > 0,
+                       lambda: ran.append(1) or x * 2)
+        assert out is None and not ran  # False + no false_fn: nothing runs
+
+    def test_traced_cond_requires_both_branches(self):
+        with pytest.raises(ValueError, match="BOTH branches"):
+            snn.cond(t(np.float32(1.0)) > 0, lambda a: a,
+                     operands=(t([1.0]),))
+
+    def test_dict_branch_outputs(self):
+        x = t([1.0, 2.0], sg=False)
+        out = snn.cond(t(np.float32(1.0)) > 0,
+                       lambda a: {"y": a * 2, "z": (a + 1, a - 1)},
+                       lambda a: {"y": a * 3, "z": (a, a)},
+                       operands=(x,))
+        np.testing.assert_allclose(out["y"].numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(out["z"][0].numpy(), [2.0, 3.0])
+        out["y"].sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_while_loop_with_check_nan_inf_flag(self):
+        from paddle_tpu.core import flags
+        old = flags.flag("check_nan_inf")
+        flags.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            y, = snn.while_loop(lambda y: y < 10.0,
+                                lambda y: y * 2.0,
+                                [t(np.float32(1.0))])
+            assert float(y.numpy()) == 16.0
+        finally:
+            flags.set_flags({"FLAGS_check_nan_inf": old})
