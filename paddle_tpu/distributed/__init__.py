@@ -12,9 +12,9 @@ from .env import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv,
 )
 from .collective import (  # noqa: F401
-    ReduceOp, Group, new_group, get_group, all_reduce, all_gather, reduce,
-    reduce_scatter, broadcast, all_to_all, scatter, send, recv, barrier,
-    p2p_shift, spmd, shard_map, P,
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, reduce, reduce_scatter, broadcast, all_to_all,
+    scatter, send, recv, barrier, p2p_shift, spmd, shard_map, P,
 )
 from .sharding_api import (  # noqa: F401
     Shard, Replicate, Partial, shard_tensor, reshard, named_sharding,

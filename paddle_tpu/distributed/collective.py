@@ -185,17 +185,80 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
     return result
 
 
+_obj_seq = {"ag": 0, "bc": 0, "sc": 0}
+
+
+def _multi_host_world():
+    """(rank, world) of HOST PROCESSES — launcher env when present, else
+    the PJRT process view. Deliberately not get_world_size(): that falls
+    back to the device count, and object collectives move host objects
+    between processes, not chips. The jax fallback is only touched when
+    the env vars are absent (calling it would initialize the backend)."""
+    import os
+    rank = os.environ.get("PADDLE_TRAINER_ID")
+    world = os.environ.get("PADDLE_TRAINERS_NUM")
+    if rank is not None and world is not None:
+        return int(rank), int(world)
+    import jax
+    return (int(rank) if rank is not None else jax.process_index(),
+            int(world) if world is not None else jax.process_count())
+
+
+def _check_default_group(group, what: str):
+    """The store-backed object collectives address ranks by global host
+    rank; a subgroup would wait forever on non-member slots."""
+    if group is not None and getattr(group, "nranks", None) not in (
+            None, _multi_host_world()[1]):
+        raise NotImplementedError(
+            f"multi-process {what} supports only the default (world) "
+            "group; subgroup object collectives are not implemented")
+
+
+def _reaped_barrier(store, name: str, world: int):
+    """barrier_via_store + key reaping: the LAST process to leave deletes
+    the barrier namespace (counter/done/left keys), so per-call barriers
+    don't grow the store without bound."""
+    import os
+    from .tcp_store import barrier_via_store
+    barrier_via_store(store, name, world)
+    epoch = os.environ.get("PADDLE_RESTART_EPOCH", "0")
+    if store.add(f"__barrier/{epoch}/{name}/left", 1) == world:
+        store.delete_prefix(f"__barrier/{epoch}/{name}")
+
+
+def _obj_key(kind: str) -> str:
+    """Unique per-call store namespace. All processes issue collectives in
+    the same program order, so a per-process counter is consistent; the
+    elastic restart epoch prevents reuse across relaunches."""
+    import os
+    epoch = os.environ.get("PADDLE_RESTART_EPOCH", "0")
+    seq = _obj_seq[kind]
+    _obj_seq[kind] += 1
+    return f"__objcol/{epoch}/{kind}{seq}"
+
+
 def all_gather_object(object_list, obj, group=None):
     """Host-object gather (reference: communication/all_gather.py
-    all_gather_object). Single-controller SPMD has one host process per
-    slice; cross-process object gather goes through jax's host callback
-    mesh — for now the single-process case (tests, one-host jobs)."""
-    import jax
-    if jax.process_count() == 1:
+    all_gather_object). Single process: trivial. Multi-process (DCN): each
+    rank publishes its pickled object to the job's TCPStore and reads the
+    others — the store-backed control plane the reference implements over
+    its gloo/TCP store."""
+    import pickle
+    rank, world = _multi_host_world()
+    if world <= 1:
         object_list.append(obj)
         return None
-    raise NotImplementedError(
-        "multi-host all_gather_object requires the DCN store (planned)")
+    _check_default_group(group, "all_gather_object")
+    from .tcp_store import job_store
+    store = job_store()
+    key = _obj_key("ag")
+    store.set(f"{key}/{rank}", pickle.dumps(obj))
+    for r in range(world):
+        object_list.append(pickle.loads(store.wait(f"{key}/{r}")))
+    # everyone has read everything: safe to drop our slot
+    _reaped_barrier(store, key, world)
+    store.delete_key(f"{key}/{rank}")
+    return None
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):

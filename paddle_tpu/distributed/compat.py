@@ -107,24 +107,39 @@ def batch_isend_irecv(p2p_op_list: List[P2POp]) -> list:
 
 
 def _single_process() -> bool:
-    import jax
-    return jax.process_count() == 1
+    from .collective import _multi_host_world
+    return _multi_host_world()[1] <= 1
 
 
 def broadcast_object_list(object_list: list, src: int = 0, group=None):
     """Reference: communication/broadcast.py broadcast_object_list.
-    Single-controller: the src host's objects already are everyone's
-    objects; multi-host goes through the job store (planned with the
-    DCN bring-up, like all_gather_object)."""
+    Single process: the src host's objects already are everyone's objects.
+    Multi-process (DCN): src publishes the pickled list to the job's
+    TCPStore, everyone else replaces their list contents in place."""
     if _single_process():
         return None
-    raise NotImplementedError(
-        "multi-host broadcast_object_list requires the DCN store")
+    import pickle
+    from .collective import (_check_default_group, _multi_host_world,
+                             _obj_key, _reaped_barrier)
+    from .tcp_store import job_store
+    _check_default_group(group, "broadcast_object_list")
+    rank, world = _multi_host_world()
+    store = job_store()
+    key = _obj_key("bc")
+    if rank == src:
+        store.set(key, pickle.dumps(list(object_list)))
+    object_list[:] = pickle.loads(store.wait(key))
+    _reaped_barrier(store, key + "/done", world)
+    if rank == src:
+        store.delete_key(key)
+    return None
 
 
 def scatter_object_list(out_object_list: list, in_object_list=None,
                         src: int = 0, group=None):
-    """Reference: communication/scatter.py scatter_object_list."""
+    """Reference: communication/scatter.py scatter_object_list. Src
+    publishes one store entry per destination rank; each rank reads only
+    its own."""
     if _single_process():
         rank = get_rank(group)
         out_object_list.clear()
@@ -132,8 +147,27 @@ def scatter_object_list(out_object_list: list, in_object_list=None,
             out_object_list.append(in_object_list[rank
                                                   % len(in_object_list)])
         return None
-    raise NotImplementedError(
-        "multi-host scatter_object_list requires the DCN store")
+    import pickle
+    from .collective import (_check_default_group, _multi_host_world,
+                             _obj_key, _reaped_barrier)
+    from .tcp_store import job_store
+    _check_default_group(group, "scatter_object_list")
+    rank, world = _multi_host_world()
+    store = job_store()
+    key = _obj_key("sc")
+    if rank == src:
+        if not in_object_list or len(in_object_list) != world:
+            raise ValueError(
+                f"scatter_object_list needs one object per rank "
+                f"({world}), got "
+                f"{0 if not in_object_list else len(in_object_list)}")
+        for r in range(world):
+            store.set(f"{key}/{r}", pickle.dumps(in_object_list[r]))
+    out_object_list.clear()
+    out_object_list.append(pickle.loads(store.wait(f"{key}/{rank}")))
+    _reaped_barrier(store, key + "/done", world)
+    store.delete_key(f"{key}/{rank}")
+    return None
 
 
 def split(x, size, operation: str = "linear", axis: int = 0, num_partitions=1,

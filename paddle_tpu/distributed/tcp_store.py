@@ -247,3 +247,30 @@ def barrier_via_store(store: TCPStore, name: str, world_size: int) -> None:
     if arrived == world_size:
         store.set(f"__barrier/{epoch}/{name}/done", b"1")
     store.wait(f"__barrier/{epoch}/{name}/done")
+
+
+_job_store_cache: dict = {}
+
+
+def job_store(timeout: float = 300.0) -> TCPStore:
+    """Cached client connection to the JOB's TCPStore — the one the
+    launcher started and advertised via PADDLE_MASTER/PADDLE_STORE_PORT
+    (fallback: MASTER_ADDR/MASTER_PORT). This is the DCN-side control
+    plane the object collectives and elastic manager ride."""
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "MASTER_ADDR")
+    if not master:
+        raise RuntimeError(
+            "no job store advertised: start workers via "
+            "`python -m paddle_tpu.distributed.launch` (sets "
+            "PADDLE_MASTER/PADDLE_STORE_PORT) or export MASTER_ADDR")
+    host = master.split(":")[0]
+    port = os.environ.get("PADDLE_STORE_PORT")
+    if not port:
+        port = (master.split(":")[1] if ":" in master
+                else os.environ.get("MASTER_PORT", "8476"))
+    key = (host, int(port))
+    if key not in _job_store_cache:
+        _job_store_cache[key] = TCPStore(host, int(port), is_master=False,
+                                         timeout=timeout)
+    return _job_store_cache[key]

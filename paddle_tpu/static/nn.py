@@ -75,6 +75,29 @@ def _unwrap_tree(x):
         is_leaf=lambda v: isinstance(v, Tensor))
 
 
+def _closure_requires_grad(fn) -> bool:
+    """Best-effort scan of ``fn``'s closure cells and bound self for
+    tensors/layers that require grad (globals are out of scope — the
+    docstrings state the forward-only contract)."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.layer_base import Layer
+
+    def needs(obj):
+        if isinstance(obj, Tensor):
+            return not obj.stop_gradient
+        if isinstance(obj, Layer):
+            return any(not p.stop_gradient for p in obj.parameters())
+        return False
+
+    seen = [getattr(fn, "__self__", None)]
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            seen.append(cell.cell_contents)
+        except ValueError:
+            pass
+    return any(needs(o) for o in seen if o is not None)
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None, operands=()):
     """Data-dependent branch (reference: control_flow.py ``cond``).
 
@@ -108,26 +131,32 @@ def cond(pred, true_fn=None, false_fn=None, name=None, operands=()):
             "false_fn that returns the same structure as true_fn")
 
     # branch outputs may be any pytree: flatten inside the traced branch
-    # (lax.cond requires matching structures), unflatten the Tensors after
+    # (lax.cond checks leaf shapes but NOT our python structure — capture
+    # each branch's treedef and require they match), unflatten after
     struct = {}
 
     def f(p_arr, *ops):
-        def branch(fn):
+        def branch(fn, tag):
             def run(op_arrays):
                 wrapped = [Tensor(a) for a in op_arrays]
                 with no_grad():  # inner ops must not tape: the whole
                     out = fn(*wrapped)  # cond is ONE tape node
                 leaves, treedef = jax.tree_util.tree_flatten(
                     _unwrap_tree(out))
-                struct["treedef"] = treedef
+                struct[tag] = treedef
                 return tuple(leaves)
             return run
         return jax.lax.cond(jnp.reshape(p_arr, ()).astype(bool),
-                            branch(true_fn), branch(false_fn), list(ops))
+                            branch(true_fn, "t"), branch(false_fn, "f"),
+                            list(ops))
 
     out = apply_op(f, pred, *operands, op_name="cond")
+    if struct["t"] != struct["f"]:
+        raise ValueError(
+            f"cond branches returned different structures: true branch "
+            f"{struct['t']}, false branch {struct['f']}")
     leaves = list(out) if isinstance(out, (tuple, list)) else [out]
-    return jax.tree_util.tree_unflatten(struct["treedef"], leaves)
+    return jax.tree_util.tree_unflatten(struct["t"], leaves)
 
 
 def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
@@ -147,11 +176,16 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
         raise ValueError("loop_vars must be a non-empty list/tuple")
     tensors = [v for v in loop_vars if isinstance(v, Tensor)]
-    if is_grad_enabled() and any(not t.stop_gradient for t in tensors):
+    if is_grad_enabled() and (
+            any(not t.stop_gradient for t in tensors)
+            or _closure_requires_grad(cond_fn)
+            or _closure_requires_grad(body_fn)):
         raise ValueError(
             "static.nn.while_loop is forward-only (XLA while has no "
-            "reverse-mode); detach the loop vars or wrap the call in "
-            "no_grad(), and use a bounded scan for trainable loops")
+            "reverse-mode) and a loop var or a tensor/layer captured by "
+            "cond_fn/body_fn requires grad — its gradient would silently "
+            "be zero. Detach the inputs or wrap the call in no_grad(), "
+            "and use a bounded scan for trainable loops")
 
     def f(*vars_):
         def c(vs):

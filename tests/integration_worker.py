@@ -1,0 +1,97 @@
+"""Two-process DCN integration worker (run via
+`python -m paddle_tpu.distributed.launch --nproc_per_node 2` — NOT a
+pytest file). Exercises the full host-protocol stack end to end:
+launcher env -> TCPStore rendezvous -> ElasticManager heartbeats ->
+rpc -> parameter-server pull/push -> store-backed object collectives.
+Mirrors the reference's test_dist_base.py subprocess-cluster pattern."""
+import os
+import socket
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import rpc  # noqa: E402
+from paddle_tpu.distributed.launch import ElasticManager  # noqa: E402
+from paddle_tpu.distributed.tcp_store import (barrier_via_store,  # noqa: E402
+                                              job_store)
+
+
+def remote_add(a, b):
+    return a + b
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert world == 2, f"expected 2 trainers, got {world}"
+    assert dist.get_rank() == rank and dist.get_world_size() == world
+
+    # 1. rendezvous against the launcher's TCPStore
+    store = job_store()
+    barrier_via_store(store, "itest/boot", world)
+
+    # 2. elastic heartbeats: both ranks beat, both see everyone alive
+    em = ElasticManager(store, rank, world, heartbeat_interval=0.2,
+                        heartbeat_timeout=5.0).start()
+    deadline = time.monotonic() + 10
+    while not em.all_alive() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert em.all_alive(), f"rank {rank} sees dead peers: {em.dead_ranks()}"
+
+    # 3. rpc mesh on its own store (endpoint negotiated via the job store)
+    if rank == 0:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        store.set("itest/rpc_ep", str(port).encode())
+    port = int(store.wait("itest/rpc_ep"))
+    rpc.init_rpc(f"w{rank}", rank=rank, world_size=world,
+                 master_endpoint=f"127.0.0.1:{port}")
+    got = rpc.rpc_sync(f"w{(rank + 1) % world}", remote_add, args=(3, 4))
+    assert got == 7, got
+
+    # 4. parameter server hosted on w0, client pulls/pushes from w1
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    if rank == 0:
+        srv = PSServer()
+        srv.add_sparse_table("emb", dim=4, lr=0.5, seed=7)
+    barrier_via_store(store, "itest/ps_up", world)
+    if rank == 1:
+        client = PSClient("w0")
+        before = client.pull_sparse("emb", [3])[0].copy()
+        client.push_sparse_grad("emb", [3],
+                                np.ones((1, 4), np.float32))
+        after = client.pull_sparse("emb", [3])[0]
+        np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
+    barrier_via_store(store, "itest/ps_done", world)
+
+    # 5. store-backed object collectives across the two processes
+    gathered = []
+    dist.all_gather_object(gathered, {"rank": rank, "msg": f"hello-{rank}"})
+    assert [g["rank"] for g in gathered] == [0, 1], gathered
+    assert gathered[1 - rank]["msg"] == f"hello-{1 - rank}"
+
+    objs = [{"cfg": 123, "src": 0}] if rank == 0 else [None]
+    dist.broadcast_object_list(objs, src=0)
+    assert objs[0] == {"cfg": 123, "src": 0}, objs
+
+    outs = []
+    dist.scatter_object_list(outs, [f"part{r}" for r in range(world)],
+                             src=0)
+    assert outs == [f"part{rank}"], outs
+
+    em.stop()
+    rpc.shutdown()
+    print(f"INTEGRATION OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -201,3 +201,15 @@ class TestReviewRegressions:
             assert float(y.numpy()) == 16.0
         finally:
             flags.set_flags({"FLAGS_check_nan_inf": old})
+
+    def test_mismatched_branch_structures_raise(self):
+        x = t([1.0], sg=False)
+        with pytest.raises(ValueError, match="different structures"):
+            snn.cond(t(np.float32(1.0)) > 0, lambda a: {"x": a * 2},
+                     lambda a: {"y": a * 3}, operands=(x,))
+
+    def test_while_loop_closure_captured_layer_raises(self):
+        fc = nn.Linear(2, 2)  # trainable params captured by body closure
+        y0 = t([1.0, 1.0])  # loop var itself detached
+        with pytest.raises(ValueError, match="forward-only"):
+            snn.while_loop(lambda y: y.sum() < 10, lambda y: fc(y), [y0])

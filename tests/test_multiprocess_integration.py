@@ -1,0 +1,28 @@
+"""Spawn a REAL 2-process cluster (the reference's test_dist_base.py
+subprocess pattern): launcher CLI -> TCPStore rendezvous -> heartbeats ->
+rpc -> PS -> store-backed object collectives. This is the DCN host
+-protocol half of multi-host; device-mesh collectives stay on the
+virtual-mesh tests."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_cluster():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # workers need no virtual mesh
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2",
+         os.path.join(REPO, "tests", "integration_worker.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=280)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"cluster failed:\n{out[-4000:]}"
+    assert "INTEGRATION OK rank=0" in out, out[-4000:]
+    assert "INTEGRATION OK rank=1" in out, out[-4000:]
