@@ -74,10 +74,26 @@ class ElasticManager:
         return not self.dead_ranks()
 
 
+def parse_np(np_arg: Optional[str]):
+    """``--np`` elastic bounds: "N" (fixed) or "min:max" (reference:
+    fleet/elastic/manager.py — np range enables scale-in/out)."""
+    if np_arg is None:
+        return None
+    if ":" in np_arg:
+        lo, hi = np_arg.split(":", 1)
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(np_arg)
+    if not (1 <= lo <= hi):
+        raise ValueError(f"--np must satisfy 1 <= min <= max, got {np_arg}")
+    return lo, hi
+
+
 def launch(script: str, script_args: Optional[List[str]] = None,
            nproc_per_node: int = 1, master: Optional[str] = None,
            max_restarts: int = 0, log_dir: Optional[str] = None,
-           node_rank: int = 0, nnodes: int = 1) -> int:
+           node_rank: int = 0, nnodes: int = 1,
+           np_range: Optional[tuple] = None) -> int:
     """Spawn ``nproc_per_node`` trainer processes with reference-compatible
     env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER) and
     restart-on-failure up to ``max_restarts`` (elastic relaunch).
@@ -95,8 +111,39 @@ def launch(script: str, script_args: Optional[List[str]] = None,
     moves. Rendezvous keys (store barriers) are namespaced by the epoch
     (PADDLE_RESTART_EPOCH), so an attempt can never consume a previous
     attempt's stale keys — no cross-node key deletion is needed.
+
+    ``np_range = (min, max)`` turns on SCALE-IN/OUT (reference:
+    fleet/elastic/manager.py np-range decision logic, single-node scope
+    here): a dead trainer no longer costs a same-size full restart — the
+    launcher recomputes the world as the surviving count (>= min) and
+    pushes it to the trainers through rewritten env (PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM / PADDLE_RESTART_EPOCH), relaunching at the
+    smaller size without failing the job. When capacity
+    returns, bumping the ``__scale_out`` store counter (a replacement
+    worker announcing itself — or an operator) triggers one more
+    membership change back up to max. Below min the job fails. Scale
+    events do not consume the ``max_restarts`` crash budget.
     """
     script_args = script_args or []
+    np_min, np_max = np_range if np_range else (None, None)
+    if np_range and np_min == np_max:
+        # fixed --np N: plain process count, works everywhere
+        if nproc_per_node not in (1, np_max):
+            raise ValueError(
+                f"--np {np_max} conflicts with --nproc_per_node "
+                f"{nproc_per_node}")
+        nproc_per_node = np_max
+        np_range = None
+    elif np_range is not None:
+        if nnodes != 1:
+            raise NotImplementedError(
+                "--np elastic scale-in/out is single-node scoped (process "
+                "granularity); multi-node jobs keep fixed-size restart")
+        if nproc_per_node != 1:
+            raise ValueError(
+                "--np min:max and --nproc_per_node are mutually "
+                "exclusive: the elastic range sets the process count")
+        nproc_per_node = np_max
     world_size = nnodes * nproc_per_node
     if master is None:
         store = TCPStore(is_master=True, world_size=world_size)
@@ -125,15 +172,18 @@ def launch(script: str, script_args: Optional[List[str]] = None,
 
     epoch = int(store.add("__restart_epoch", 0))
     attempts = 0  # local relaunch budget (epoch can over-bump on races)
+    cur_np = nproc_per_node  # this epoch's local trainer count (elastic)
+    scale_seen = int(store.add("__scale_out", 0))
     while True:
+        cur_world = nnodes * cur_np
         procs = []
         logs = []
-        for local in range(nproc_per_node):
-            rank = node_rank * nproc_per_node + local
+        for local in range(cur_np):
+            rank = node_rank * cur_np + local
             env = dict(os.environ)
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_TRAINERS_NUM": str(world_size),
+                "PADDLE_TRAINERS_NUM": str(cur_world),
                 "PADDLE_LOCAL_RANK": str(local),
                 "PADDLE_NODE_RANK": str(node_rank),
                 "PADDLE_MASTER": master_addr,
@@ -151,12 +201,21 @@ def launch(script: str, script_args: Optional[List[str]] = None,
                 [sys.executable, script, *script_args], env=env,
                 stdout=out, stderr=subprocess.STDOUT if out else None))
 
-        # supervise: watch local procs AND the cluster restart epoch
+        # supervise: watch local procs, the cluster restart epoch, and
+        # (elastic) the scale-out request counter
         fail_code = None
+        scale_event = None  # "in" | "out"
         while True:
             codes = [p.poll() for p in procs]
             if any(c not in (None, 0) for c in codes):
                 fail_code = next(c for c in codes if c not in (None, 0))
+                if np_range:
+                    survivors = sum(1 for c in codes if c is None)
+                    if survivors >= np_min:
+                        # scale-in: continue smaller instead of failing
+                        scale_event = "in"
+                        cur_np = survivors
+                        fail_code = None
                 # signal the whole cluster (idempotent-enough: concurrent
                 # failers over-bump, launchers re-read the counter below)
                 if int(store.add("__restart_epoch", 0)) == epoch:
@@ -166,6 +225,20 @@ def launch(script: str, script_args: Optional[List[str]] = None,
                 break
             if int(store.add("__restart_epoch", 0)) > epoch:
                 break  # another node requested a restart
+            if np_range:
+                bumped = int(store.add("__scale_out", 0))
+                if bumped > scale_seen:
+                    # absorb the announcement even at full size — a stale
+                    # bump must not fire a spurious scale-out after the
+                    # next scale-in
+                    scale_seen = bumped
+                    if cur_np < np_max:
+                        # replacement capacity announced: grow to max
+                        scale_event = "out"
+                        cur_np = np_max
+                        if int(store.add("__restart_epoch", 0)) == epoch:
+                            store.add("__restart_epoch", 1)
+                        break
             time.sleep(0.2)
 
         for p in procs:
@@ -177,6 +250,16 @@ def launch(script: str, script_args: Optional[List[str]] = None,
             lf.close()
 
         new_epoch = int(store.add("__restart_epoch", 0))
+        if scale_event is not None:
+            # membership change, not a crash: rewrite env and relaunch the
+            # survivors at the new size without consuming max_restarts.
+            # The epoch ALWAYS advances through the store counter, so
+            # epoch-namespaced rendezvous keys can never be reused.
+            if new_epoch == epoch:
+                store.add("__restart_epoch", 1)
+                new_epoch = int(store.add("__restart_epoch", 0))
+            epoch = new_epoch
+            continue
         if fail_code is None and new_epoch == epoch:
             # clean local exit — but a peer may still fail and request a
             # restart; leaving now would also tear down the master store
@@ -207,12 +290,16 @@ def main(argv=None):
     parser.add_argument("--log_dir", type=str, default=None)
     parser.add_argument("--node_rank", type=int, default=0)
     parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--np", type=str, default=None, dest="np_arg",
+                        help="elastic trainer-count bounds: N or min:max "
+                             "(reference fleet/elastic --np)")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.script, args.script_args, args.nproc_per_node,
                   args.master, args.max_restarts, args.log_dir,
-                  args.node_rank, args.nnodes)
+                  args.node_rank, args.nnodes,
+                  np_range=parse_np(args.np_arg))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,30 @@
+"""Elastic scale-in/out worker (run via the launcher with --np min:max —
+NOT a pytest file). Each epoch it records (epoch, rank, world, pid) into
+RUN_DIR, then idles until the store's finish flag — letting the test kill
+a worker (scale-in), announce a replacement (scale-out), and finally end
+the job cleanly."""
+import os
+import sys
+import time
+
+from paddle_tpu.distributed.tcp_store import job_store
+
+
+def main():
+    run_dir = os.environ["ELASTIC_TEST_DIR"]
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    world = os.environ["PADDLE_TRAINERS_NUM"]
+    epoch = os.environ["PADDLE_RESTART_EPOCH"]
+    store = job_store()
+    with open(os.path.join(run_dir,
+                           f"epoch{epoch}.rank{rank}.world{world}.pid"),
+              "w") as f:
+        f.write(str(os.getpid()))
+    while store.get("elastic_test/finish") is None:
+        time.sleep(0.1)
+    print(f"worker rank={rank} world={world} epoch={epoch} done",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Elastic scale-in/out (reference: fleet/elastic/manager.py --np range):
+kill a worker -> the job continues at the surviving size with rewritten
+ranks/world; announce a replacement -> it scales back out to max; the
+crash budget is not consumed by scale events."""
+import glob
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait_for(pattern, run_dir, n, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        found = glob.glob(os.path.join(run_dir, pattern))
+        if len(found) >= n:
+            return found
+        time.sleep(0.1)
+    raise AssertionError(
+        f"timed out waiting for {n} x {pattern}; have "
+        f"{os.listdir(run_dir)}")
+
+
+def test_kill_and_replace_worker(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ELASTIC_TEST_DIR"] = str(tmp_path)
+    env.pop("XLA_FLAGS", None)
+    launcher = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--np", "1:2", "--max_restarts", "0",
+         os.path.join(REPO, "tests", "elastic_worker.py")],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        # epoch 0: two workers up (world 2)
+        files = _wait_for("epoch0.rank*.world2.pid", str(tmp_path), 2)
+
+        # connect to the job store like a peer would (port from env file
+        # is not written; recover it from the worker's PADDLE_STORE_PORT
+        # via /proc)  -- simpler: workers share it through the run dir
+        pids = {f: int(open(f).read()) for f in files}
+        store_port = None
+        for pid in pids.values():
+            environ = open(f"/proc/{pid}/environ", "rb").read().decode(
+                errors="ignore")
+            for kv in environ.split("\0"):
+                if kv.startswith("PADDLE_STORE_PORT="):
+                    store_port = int(kv.split("=", 1)[1])
+        assert store_port, "could not recover store port"
+
+        # SCALE-IN: kill rank 1; job must continue at world 1, re-ranked
+        victim = [p for f, p in pids.items() if ".rank1." in f][0]
+        os.kill(victim, signal.SIGKILL)
+        _wait_for("epoch*.rank0.world1.pid", str(tmp_path), 1)
+
+        # SCALE-OUT: a replacement announces itself via the store counter
+        from paddle_tpu.distributed.tcp_store import TCPStore
+        store = TCPStore("127.0.0.1", store_port, is_master=False)
+        store.add("__scale_out", 1)
+        later = _wait_for("epoch*.rank*.world2.pid", str(tmp_path), 4,
+                          timeout=60)
+        # the scale-out epoch is a NEW epoch (not the original files)
+        new_epochs = {os.path.basename(f).split(".")[0] for f in later
+                      if "epoch0." not in os.path.basename(f)}
+        assert new_epochs, later
+
+        # clean finish: max_restarts=0 yet the job survived both scale
+        # events — they must not consume the crash budget
+        store.set("elastic_test/finish", b"1")
+        rc = launcher.wait(timeout=60)
+        out = launcher.stdout.read()
+        assert rc == 0, out[-3000:]
+    finally:
+        if launcher.poll() is None:
+            launcher.kill()
