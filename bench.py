@@ -76,7 +76,10 @@ def bench_full_model(on_tpu):
             num_hidden_layers=8, num_attention_heads=16,
             num_key_value_heads=4, max_position_embeddings=4096,
             tie_word_embeddings=True)
-        B, S = 2, 2048
+        # B=4 fits (and beats B=2 by ~6 MFU points) since the fused
+        # chunked CE removed the [T, V] logits from HBM; B=8 measured
+        # slightly worse (59.8%)
+        B, S = 4, 2048
         steps, warmup = 10, 2
     else:  # smoke config so the bench is runnable anywhere
         cfg = LlamaConfig(
@@ -223,6 +226,10 @@ def bench_layer(on_tpu):
 def main():
     import jax
 
+    if "--suite" in sys.argv or os.environ.get("BENCH_SUITE"):
+        print(json.dumps({"suite": bench_suite()}))
+        return
+
     on_tpu = jax.default_backend() == "tpu"
     dev = jax.devices()[0]
     peak = peak_flops(dev)
@@ -248,6 +255,207 @@ def main():
     print(json.dumps(result))
     print(json.dumps(extras), file=sys.stderr)
 
+
+
+
+# ===================== BASELINE config suite (--suite) ======================
+# Every BASELINE.json family gets a measured number on the real chip:
+# ERNIE pretraining, DeepSeekMoE/Qwen2-MoE-style MoE LM (ragged dispatch),
+# DiT (SD-3-family diffusion transformer), PP-OCRv4 conv recognizer, and a
+# Llama-3-70B-geometry decoder layer (the full 70B cannot fit one chip —
+# BENCHMARKS.md records the reasoning). Shapes are scaled to a single
+# v5e's HBM; FLOPs come from XLA's own cost analysis of the compiled
+# fwd+bwd program (no hand formulas), so MFU is consistent across
+# matmul- and conv-dominated models.
+
+def _measure_pure(build, steps=10, warmup=2):
+    import jax
+    import jax.numpy as jnp
+
+    fn, state, batch, per_step = build()
+    # commit the batch to the device ONCE: numpy args would re-transfer
+    # host->device on every timed call (through the sandbox tunnel that
+    # costs seconds per call and silently dominated conv benches)
+    batch = tuple(jnp.asarray(b) for b in batch)
+    # AOT-compile once; the same executable serves cost analysis AND the
+    # timing loop (jit would re-trace/re-compile a second copy)
+    compiled = jax.jit(jax.value_and_grad(fn)).lower(
+        state, *batch).compile()
+    flops = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost["flops"])
+    except Exception:
+        pass
+    dt = _time_steps(lambda: compiled(state, *batch), steps, warmup,
+                     lambda out: np.asarray(out[0]))
+    return {"step_ms": round(dt * 1e3, 2),
+            "throughput": round(per_step / dt, 1),
+            "measured_gflops_per_step": (round(flops / 1e9, 1)
+                                         if flops else None),
+            "achieved_tflops": (round(flops / dt / 1e12, 2)
+                                if flops else None),
+            "_flops_per_sec": (flops / dt) if flops else None}
+
+
+def _functional(model, loss):
+    """(pure_fn, state) for a Layer: loss(model_out...) as a jax scalar."""
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.jit.functional import functional_state, swap_state
+
+    model.bfloat16()
+    train, frozen, buffers = functional_state(model)
+    state = {**train, **frozen, **buffers}
+
+    def fn(st, *batch):
+        wrapped = [pt.Tensor(b.astype(jnp.bfloat16)
+                             if jnp.issubdtype(b.dtype, jnp.floating)
+                             else b) for b in batch]
+        with swap_state(model, st, collect_buffers=False):
+            out = loss(*wrapped)
+        return out.data.astype(jnp.float32)
+    return fn, state
+
+
+def _suite_ernie():
+    import paddle_tpu as pt
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieForPretraining
+
+    pt.seed(0)
+    cfg = ErnieConfig(hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    model = ErnieForPretraining(cfg)
+    B, S = 16, 512
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (B, S))
+    mlm = rng.randint(0, cfg.vocab_size, (B, S))
+    sop = rng.randint(0, 2, (B,))
+
+    def loss(ids_t, mlm_t, sop_t):
+        return model(ids_t, masked_lm_labels=mlm_t, sop_labels=sop_t)[-1]
+
+    fn, state = _functional(model, loss)
+    return fn, state, (ids, mlm, sop.astype(np.int64)), B * S
+
+
+def _suite_moe_lm():
+    import paddle_tpu as pt
+    from paddle_tpu.models.moe import MoeConfig, MoeForCausalLM
+
+    pt.seed(0)
+    cfg = MoeConfig(vocab_size=32000, hidden_size=1024,
+                    intermediate_size=2816, moe_intermediate_size=704,
+                    num_hidden_layers=6, num_attention_heads=8,
+                    num_key_value_heads=8, num_experts=16,
+                    num_experts_per_tok=4)
+    model = MoeForCausalLM(cfg)
+    B, S = 4, 1024
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (B, S))
+
+    def loss(ids_t):
+        out = model(ids_t, labels=ids_t)
+        return out[1] if isinstance(out, tuple) else out
+
+    fn, state = _functional(model, loss)
+    return fn, state, (ids,), B * S
+
+
+def _suite_dit():
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.models.dit import DiT, DiTConfig
+
+    pt.seed(0)
+    cfg = DiTConfig(depth=8)  # DiT-XL/2 width (1152/16 heads), depth/3.5
+    model = DiT(cfg)
+    B = 64
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, cfg.in_channels, cfg.input_size,
+                  cfg.input_size).astype(np.float32)
+    t = rng.randint(0, 1000, (B,)).astype(np.int64)
+    y = rng.randint(0, cfg.num_classes, (B,)).astype(np.int64)
+    target = rng.randn(B, cfg.in_channels * 2, cfg.input_size,
+                       cfg.input_size).astype(np.float32)
+    mse = nn.MSELoss()
+
+    def loss(x_t, t_t, y_t, tgt):
+        return mse(model(x_t, t_t, y_t), tgt)
+
+    fn, state = _functional(model, loss)
+    return fn, state, (x, t, y, target), B
+
+
+def _suite_ppocr():
+    import paddle_tpu as pt
+    from paddle_tpu.models.ppocr import PPOCRRecConfig, PPOCRRecModel
+
+    pt.seed(0)
+    cfg = PPOCRRecConfig()
+    model = PPOCRRecModel(cfg)
+    B, W = 64, 320
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(B, 3, cfg.img_height, W).astype(np.float32)
+    labels = rng.randint(1, cfg.num_classes, (B, 16)).astype(np.int64)
+    lens = np.full((B,), 16, np.int64)
+
+    def loss(im, lab, ln):
+        return model.loss(model(im), lab, ln)
+
+    fn, state = _functional(model, loss)
+    return fn, state, (imgs, labels, lens), B
+
+
+def _suite_llama70b_layer():
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    pt.seed(0)
+    # one decoder layer at exact 70B geometry (full model: 140GB of bf16
+    # weights alone — cannot fit a 16GB chip; see BENCHMARKS.md)
+    cfg = LlamaConfig(vocab_size=512, hidden_size=8192,
+                      intermediate_size=28672, num_hidden_layers=1,
+                      num_attention_heads=64, num_key_value_heads=8,
+                      max_position_embeddings=4096,
+                      tie_word_embeddings=True)
+    model = LlamaForCausalLM(cfg)
+    B, S = 1, 2048
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (B, S))
+
+    def loss(ids_t):
+        out = model(ids_t, labels=ids_t)
+        return out[1] if isinstance(out, tuple) else out
+
+    fn, state = _functional(model, loss)
+    return fn, state, (ids,), B * S
+
+
+_SUITE = {
+    "ernie_base_pretrain": (_suite_ernie, "tokens/sec"),
+    "moe_lm_deepseek_style": (_suite_moe_lm, "tokens/sec"),
+    "dit_xl_width_d8": (_suite_dit, "images/sec"),
+    "ppocr_v4_rec_conv": (_suite_ppocr, "images/sec"),
+    "llama3_70b_geometry_layer": (_suite_llama70b_layer, "tokens/sec"),
+}
+
+
+def bench_suite():
+    import jax
+
+    dev = jax.devices()[0]
+    peak = peak_flops(dev)
+    results = {}
+    for name, (builder, unit) in _SUITE.items():
+        r = _measure_pure(lambda b=builder: b())
+        fps = r.pop("_flops_per_sec")
+        r["throughput_unit"] = unit
+        r["mfu_pct"] = round(fps / peak * 100, 2) if fps and peak else None
+        results[name] = r
+        print(json.dumps({name: r}), file=sys.stderr, flush=True)
+        gc.collect()
+    return results
 
 if __name__ == "__main__":
     main()

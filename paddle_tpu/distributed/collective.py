@@ -100,6 +100,16 @@ def get_group(gid: int) -> Optional[Group]:
     return Group._registry.get(gid)
 
 
+def _linear_rank(axes):
+    """Group-linear rank inside a mapped context (axes[0] major — the
+    same flattening order jax collectives use for axis tuples)."""
+    import jax
+    idx = jax.lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+    return idx
+
+
 def _axes(group) -> Tuple[str, ...]:
     if group is None:
         m = get_mesh()
@@ -287,18 +297,31 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """Replicate src's value across the group. On a mesh this is a
-    collective-select: every rank takes rank ``src``'s shard."""
+    psum of a rank-masked select (memory-lean collective-select)."""
     import jax
+    import jax.numpy as jnp
     axes = _axes(group)
     if not axes or not _in_mapped_context(axes):
         if group is None or Group(axes).nranks == 1:
             return tensor
         raise RuntimeError("broadcast outside a dist.spmd region")
+    n = Group(axes).nranks
+    if not 0 <= src < n:
+        # the masked-select psum would silently yield zeros for an absent
+        # src rank — keep the old all_gather+index failure mode
+        raise ValueError(f"broadcast src {src} out of range for group "
+                         f"of {n}")
 
     def f(x):
-        n = jax.lax.axis_size(axes[0] if len(axes) == 1 else axes)
-        g = jax.lax.all_gather(x, axes, axis=0)
-        return g[src]
+        # psum of a masked select: peak memory 2x the tensor, not the
+        # world-size x of an all_gather+index — this is how large params
+        # broadcast over the mesh
+        idx = _linear_rank(axes)
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        if jnp.issubdtype(x.dtype, jnp.bool_):
+            return jax.lax.psum(masked.astype(jnp.int8), axes).astype(
+                x.dtype)
+        return jax.lax.psum(masked, axes)
     return _collective(f, tensor, "broadcast")
 
 
@@ -345,12 +368,15 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         raise RuntimeError("scatter outside a dist.spmd region")
 
     def f(x):
+        # all_to_all then keep src's lane: src's slice i reaches rank i
+        # with peak memory 2x the tensor, not the world-size x of the old
+        # all_gather+index formulation
         axis = axes[0] if len(axes) == 1 else axes
         n = jax.lax.axis_size(axis)
-        g = jax.lax.all_gather(x, axes, axis=0)  # [n, *local]
-        i = jax.lax.axis_index(axis)
         chunk = x.shape[0] // n
-        return jax.lax.dynamic_slice_in_dim(g[src], i * chunk, chunk, 0)
+        recv = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        return jax.lax.dynamic_slice_in_dim(recv, src * chunk, chunk, 0)
     if tensor_list is not None:
         from paddle_tpu import ops
         tensor = ops.concat(list(tensor_list), axis=0)

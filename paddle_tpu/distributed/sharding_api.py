@@ -51,9 +51,12 @@ class Replicate(Placement):
 
 
 class Partial(Placement):
-    """Pending-reduction placement (reference: Partial status). XLA tracks
-    partial sums internally; at the annotation surface it behaves as
-    Replicate and exists for API parity."""
+    """Pending-reduction placement (reference: Partial status). GSPMD has
+    no user-visible partial-sum annotation — XLA tracks pending reductions
+    internally and inserts the reduce where the value is consumed — so a
+    user-placed Partial cannot be honored. Using it in ``placements``
+    raises rather than silently behaving as Replicate (which would skip
+    the reduction the caller asked for)."""
 
     def __init__(self, reduce_type="sum"):
         self.reduce_type = reduce_type
@@ -68,6 +71,12 @@ def _placements_to_spec(placements: Sequence, mesh, ndim: int):
     from jax.sharding import PartitionSpec
     dim_axes: List[Optional[object]] = [None] * ndim
     for axis_idx, pl in enumerate(placements):
+        if isinstance(pl, Partial):
+            raise NotImplementedError(
+                "Partial placement cannot be annotated at the GSPMD "
+                "surface (XLA owns pending-reduction state). Compute the "
+                "reduction explicitly (all_reduce / psum inside "
+                "dist.spmd) or use Replicate/Shard placements.")
         if isinstance(pl, Shard):
             name = mesh.axis_names[axis_idx]
             cur = dim_axes[pl.dim]

@@ -265,17 +265,38 @@ class LlamaForCausalLM(nn.Layer):
             if len(p.shape) == 2:
                 p.set_value(init(p.shape))  # set_value casts to p's dtype
 
+    # vocab size from which the fused chunked CE pays for itself (below
+    # it, the [T, V] logits are small and the plain path keeps `logits`
+    # available to callers)
+    _FUSED_CE_MIN_VOCAB = 32768
+
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
+        if labels is not None and labels.shape[1] < 2:
+            raise ValueError(
+                "causal-LM loss needs sequences of length >= 2 (the "
+                "internal shift leaves nothing to predict for length 1)")
+        if (labels is not None and self.lm_head is None
+                and self.cfg.vocab_size >= self._FUSED_CE_MIN_VOCAB):
+            # large tied vocab: fused chunked matmul-CE — the [T, V]
+            # logits never materialize (ops/fused_ce.py). Returns
+            # (None, loss): producing logits would rebuild the tensor the
+            # fusion exists to avoid.
+            from paddle_tpu.ops.fused_ce import matmul_cross_entropy
+            w = self.model.embed_tokens.weight
+
+            def f(ha, wa, lab):
+                per_tok = matmul_cross_entropy(
+                    ha[:, :-1, :].reshape(-1, ha.shape[-1]), wa,
+                    lab[:, 1:].reshape(-1))
+                return per_tok.mean()
+            loss = apply_op(f, h, w, labels, op_name="fused_causal_ce")
+            return None, loss
         logits = self._logits(h)
         if labels is None:
             return logits
         # HF-style contract: labels == input_ids; the shift happens HERE
         # (position t predicts token t+1) — do not pre-shift labels
-        if labels.shape[1] < 2:
-            raise ValueError(
-                "causal-LM loss needs sequences of length >= 2 (the "
-                "internal shift leaves nothing to predict for length 1)")
         loss = F.cross_entropy(
             ops.reshape(logits[:, :-1], [-1, logits.shape[-1]]),
             ops.reshape(labels[:, 1:], [-1]))

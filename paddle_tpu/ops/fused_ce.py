@@ -1,0 +1,133 @@
+"""Fused vocab-chunked cross entropy: loss(h @ Wᵀ, labels) without ever
+materializing the [T, V] logits.
+
+The vocab projection is the single biggest matmul in a causal-LM step
+(V=128K: logits are ~2 GB in f32 at bench shapes, written+read several
+times by a naive softmax-CE). This op streams W in vocab chunks with an
+online logsumexp (the flash-attention trick applied to CE) and recomputes
+each chunk's softmax in the backward — peak extra memory is one
+[T, V/chunks] block. The reference reaches the same goal with its fused
+``softmax_with_cross_entropy`` CUDA kernels
+(``paddle/phi/kernels/gpu/cross_entropy_kernel.cu``) and the
+c_softmax_with_cross_entropy op for the model-parallel case; here XLA gets
+MXU-shaped [T, d] x [d, Vc] matmuls it can pipeline, wrapped in a
+``jax.custom_vjp`` so autodiff cannot silently save every chunk.
+
+Returns PER-TOKEN losses [T] (callers reduce), matching
+``F.cross_entropy(..., reduction='none')`` semantics for hard labels.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["matmul_cross_entropy"]
+
+_DEF_CHUNKS = 8
+
+
+def _chunks(w_vd, n_chunks):
+    V, d = w_vd.shape
+    vc = V // n_chunks
+    return w_vd.reshape(n_chunks, vc, d), vc
+
+
+def _fwd(h, w_vd, labels, valid, n_chunks):
+    T = h.shape[0]
+    wc, vc = _chunks(w_vd, n_chunks)
+    starts = jnp.arange(n_chunks, dtype=jnp.int32) * vc
+
+    def body(carry, chunk):
+        m, s, lab = carry
+        w, start = chunk
+        logits = jax.lax.dot_general(
+            h, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [T, vc]
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=-1)
+        idx = jnp.clip(labels - start, 0, vc - 1)
+        ll = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        in_chunk = (labels >= start) & (labels < start + vc)
+        lab = jnp.where(in_chunk, ll, lab)
+        return (m_new, s, lab), None
+
+    init = (jnp.full((T,), -jnp.inf, jnp.float32),
+            jnp.zeros((T,), jnp.float32),
+            jnp.zeros((T,), jnp.float32))
+    (m, s, lab), _ = jax.lax.scan(body, init, (wc, starts))
+    lse = m + jnp.log(s)
+    # ignored tokens: zero loss (F.cross_entropy convention — the mean
+    # still divides by ALL tokens at the default ignore_index)
+    return jnp.where(valid, lse - lab, 0.0), lse
+
+
+def _bwd(h, w_vd, labels, valid, lse, dout, n_chunks):
+    wc, vc = _chunks(w_vd, n_chunks)
+    starts = jnp.arange(n_chunks, dtype=jnp.int32) * vc
+    dout = dout * valid.astype(dout.dtype)  # ignored tokens: zero grad
+
+    def body(dh, chunk):
+        w, start = chunk
+        logits = jax.lax.dot_general(
+            h, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])  # softmax chunk, recomputed
+        idx = labels - start
+        onehot = (idx[:, None] == jnp.arange(vc)[None, :])
+        g = (p - onehot.astype(p.dtype)) * dout[:, None]  # [T, vc] f32
+        dh = dh + jax.lax.dot_general(
+            g.astype(h.dtype), w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dw = jax.lax.dot_general(
+            g.astype(h.dtype), h, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [vc, d]
+        return dh, dw.astype(w_vd.dtype)
+
+    dh0 = jnp.zeros(h.shape, jnp.float32)
+    dh, dw = jax.lax.scan(body, dh0, (wc, starts))
+    return dh.astype(h.dtype), dw.reshape(w_vd.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _mce(h, w_vd, labels, valid, n_chunks):
+    loss, _ = _fwd(h, w_vd, labels, valid, n_chunks)
+    return loss
+
+
+def _mce_fwd(h, w_vd, labels, valid, n_chunks):
+    loss, lse = _fwd(h, w_vd, labels, valid, n_chunks)
+    return loss, (h, w_vd, labels, valid, lse)
+
+
+def _mce_bwd(n_chunks, res, dout):
+    h, w_vd, labels, valid, lse = res
+    dh, dw = _bwd(h, w_vd, labels, valid, lse, dout, n_chunks)
+    return dh, dw, None, None
+
+
+_mce.defvjp(_mce_fwd, _mce_bwd)
+
+
+def matmul_cross_entropy(h, w_vd, labels, ignore_index: int = -100,
+                         n_chunks: int = _DEF_CHUNKS):
+    """Per-token CE of ``h @ w_vdᵀ`` against int ``labels``.
+
+    ``h``: [T, d] (or [..., d], flattened), ``w_vd``: [V, d] (embedding
+    -layout weight, as tied LM heads store it), ``labels``: int [T].
+    Tokens whose label equals ``ignore_index`` contribute zero loss and
+    zero gradient (``F.cross_entropy`` semantics). ``n_chunks`` must
+    divide V; falls back to 1 chunk (still fused) when it doesn't.
+    """
+    lead = h.shape[:-1]
+    h2 = h.reshape(-1, h.shape[-1])
+    lab = labels.reshape(-1).astype(jnp.int32)
+    valid = lab != ignore_index
+    lab = jnp.where(valid, lab, 0)  # safe index for the chunk gather
+    V = w_vd.shape[0]
+    if V % n_chunks:
+        n_chunks = 1
+    loss = _mce(h2, w_vd, lab, valid, n_chunks)
+    return loss.reshape(lead)

@@ -30,6 +30,25 @@ __all__ = ["Program", "Executor", "program_guard", "data",
            "global_scope"]
 
 
+_token_counter = [0]
+
+
+def _cache_token(obj) -> int:
+    """Monotonic identity token, assigned on first use and pinned to the
+    object (unlike id(), never reused after GC). None -> 0."""
+    if obj is None:
+        return 0
+    tok = getattr(obj, "_exe_cache_token", None)
+    if tok is None:
+        _token_counter[0] += 1
+        tok = _token_counter[0]
+        try:
+            object.__setattr__(obj, "_exe_cache_token", tok)
+        except (AttributeError, TypeError):
+            return id(obj)  # slotted object: fall back (documented risk)
+    return tok
+
+
 class Program:
     """Holds the placeholders, fetch targets, and optimizer attached
     while this program was the default (reference Program surface)."""
@@ -141,10 +160,12 @@ class Executor:
                                 if opt is not None and not loss_in_fetch
                                 else [])
 
-        # id(opt) in the key: attaching an optimizer after an eval run
-        # must not reuse the eval closure (grads=None would skip training)
-        key = (id(program), id(opt),
-               tuple(t.name or id(t) for t in fetch_list),
+        # monotonic tokens, NOT id(): after GC, id() values get reused and
+        # could alias cache entries across different objects. The opt token
+        # also keys attaching an optimizer after an eval run (the eval
+        # closure, grads=None, must not be reused for training).
+        key = (_cache_token(program), _cache_token(opt),
+               tuple(t.name or _cache_token(t) for t in fetch_list),
                tuple(v.shape + (str(v.dtype),) for v in feed_vals))
         cached = program._replay_cache.get(key)
         if cached is None:

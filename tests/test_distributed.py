@@ -338,3 +338,22 @@ def _rank_worker(marker):
     import paddle_tpu.distributed as dist
     r, w = dist.get_rank(), dist.get_world_size()
     open(marker + os.environ["PADDLE_TRAINER_ID"], "w").write(f"{r}/{w}")
+
+
+def test_partial_placement_raises(mesh8):
+    import numpy as np
+    import pytest as _pytest
+    x = pt.to_tensor(np.zeros((8, 4), np.float32))
+    with _pytest.raises(NotImplementedError, match="Partial"):
+        dist.shard_tensor(x, placements=[dist.Partial()])
+
+
+def test_broadcast_src_out_of_range_raises(mesh8):
+    import numpy as np
+    import pytest as _pytest
+    x = pt.to_tensor(np.ones((8, 4), np.float32))
+    with _pytest.raises(ValueError, match="out of range"):
+        f = dist.spmd(
+            lambda t: dist.broadcast(t, src=8, group=dist.Group("dp")),
+            mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))
+        f(x)

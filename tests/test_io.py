@@ -300,3 +300,38 @@ class TestReviewRegressions:
         s2 = amp.GradScaler()
         s2.load_state_dict(s1.state_dict())
         assert s2._incr_ratio == 4.0 and s2._incr_every_n_steps == 500
+
+
+class TestLoaderThroughput:
+    def test_dataloader_keeps_up_with_train_step(self):
+        """Round-1 'done' criterion: the loader must not bottleneck the
+        bench loop. The bench's measured full-model step is ~170ms for a
+        (2, 2048)-token batch on chip; the thread-prefetch loader must
+        produce such batches far faster than it consumes them."""
+        import time
+        import paddle_tpu.io as io
+
+        class TokenDataset(io.Dataset):
+            def __len__(self):
+                return 512
+
+            def __getitem__(self, i):
+                # per-sample work modeled on tokenized text: numpy slice
+                # + copy (transforms are numpy-bound by design — that's
+                # why threads, not processes, are the right workers here)
+                rng = np.random.RandomState(i)
+                return rng.randint(0, 128256, (2048,)).astype(np.int64)
+
+        loader = io.DataLoader(TokenDataset(), batch_size=2, num_workers=2,
+                               shuffle=False)
+        it = iter(loader)
+        next(it)  # warm the prefetch pipeline
+        t0 = time.perf_counter()
+        n = 0
+        for _ in it:
+            n += 1
+        dt = (time.perf_counter() - t0) / max(n, 1)
+        # >= 8x headroom vs the 170ms chip step (i.e. < ~21ms/batch);
+        # generous enough to be robust on a loaded CI host
+        assert dt < 0.021, f"loader at {dt*1e3:.1f} ms/batch would " \
+                           f"bottleneck the 170 ms train step"
