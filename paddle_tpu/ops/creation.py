@@ -35,7 +35,15 @@ def _shape(shape):
         return tuple(int(s) for s in np.asarray(shape.data))
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
-    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+    def one(s):
+        if isinstance(s, Tensor):
+            return int(s.item())
+        try:
+            return int(s)
+        except Exception:
+            return s  # symbolic dim (jax.export shape polymorphism)
+    return tuple(one(s) for s in shape)
 
 
 def zeros(shape, dtype=None):

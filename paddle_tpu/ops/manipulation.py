@@ -19,6 +19,26 @@ def _ints(v):
     return [int(x.item()) if isinstance(x, Tensor) else int(x) for x in v]
 
 
+def _dims(v):
+    """Shape-list coercion that lets SYMBOLIC dims (jax.export shape
+    polymorphism) pass through untouched — int() on a _DimExpr raises and
+    would pin exported artifacts to static shapes."""
+    def one(s):
+        if isinstance(s, Tensor):
+            return int(s.item())
+        try:
+            return int(s)
+        except Exception:
+            return s  # symbolic dim
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return [one(s) for s in v]
+
+
+def _is_concrete(s) -> bool:
+    return isinstance(s, (int, np.integer))
+
+
 @op
 def cast(x, dtype):
     from paddle_tpu.core.dtype import convert_dtype
@@ -32,8 +52,11 @@ def assign(x):
 
 @op
 def reshape(x, shape):
-    shape = [int(s) for s in shape]
-    return jnp.reshape(x, shape)
+    dims = _dims(shape)
+    # paddle semantics: a 0 entry copies the input dim at that index
+    dims = [x.shape[i] if _is_concrete(s) and s == 0 else s
+            for i, s in enumerate(dims)]
+    return jnp.reshape(x, dims)
 
 
 @op
@@ -135,12 +158,13 @@ def tile(x, repeat_times):
 
 @op
 def expand(x, shape):
-    shape = [int(s) for s in shape]
-    # -1 entries keep the original dim (paddle semantics)
+    shape = _dims(shape)
+    # -1 entries keep the original dim (paddle semantics); the compare
+    # only applies to concrete entries (symbolic dims are never -1)
     full = []
     offset = len(shape) - x.ndim
     for i, s in enumerate(shape):
-        if s == -1:
+        if _is_concrete(s) and s == -1:
             full.append(x.shape[i - offset])
         else:
             full.append(s)
@@ -149,7 +173,7 @@ def expand(x, shape):
 
 @op
 def broadcast_to(x, shape):
-    return jnp.broadcast_to(x, [int(s) for s in shape])
+    return jnp.broadcast_to(x, _dims(shape))
 
 
 @op

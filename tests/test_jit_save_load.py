@@ -95,3 +95,89 @@ def test_llama_export_predictor_roundtrip(tmp_path):
     out = Predictor(Config(path)).run([ids])
     np.testing.assert_allclose(np.asarray(out[0]), want, rtol=1e-4,
                                atol=1e-5)
+
+
+class TestSymbolicBatchExport:
+    """Dynamic-batch export (reference: -1 dims in paddle's input_spec;
+    round-2 limitation 'static shapes only' removed — shape-polymorphic
+    StableHLO now serves any batch size through the attention path)."""
+
+    def test_llama_dynamic_batch(self, tmp_path):
+        import paddle_tpu as pt
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.static import InputSpec
+
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          max_position_embeddings=64,
+                          tie_word_embeddings=True)
+        pt.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        pt.jit.save(m, str(tmp_path / "m"),
+                    input_spec=[InputSpec([None, 16], "int64", "ids")])
+        back = pt.jit.load(str(tmp_path / "m"))
+        for B in (1, 3, 5):
+            ids = pt.to_tensor(np.random.RandomState(B).randint(
+                0, 64, (B, 16)).astype(np.int64))
+            np.testing.assert_allclose(back(ids).numpy(), m(ids).numpy(),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_transformer_encoder_dynamic_batch(self, tmp_path):
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+        from paddle_tpu.static import InputSpec
+
+        pt.seed(1)
+        enc = nn.TransformerEncoderLayer(d_model=32, nhead=4,
+                                         dim_feedforward=64, dropout=0.0)
+        enc.eval()
+        pt.jit.save(enc, str(tmp_path / "enc"),
+                    input_spec=[InputSpec([None, 12, 32], "float32", "x")])
+        back = pt.jit.load(str(tmp_path / "enc"))
+        for B in (2, 7):
+            x = pt.to_tensor(np.random.RandomState(B).randn(
+                B, 12, 32).astype(np.float32))
+            np.testing.assert_allclose(back(x).numpy(), enc(x).numpy(),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_dynamic_batch_through_expand_and_zeros(self, tmp_path):
+        """expand/broadcast_to/zeros with a batch-derived dim must survive
+        symbolic export (reshape alone is not enough)."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+        from paddle_tpu import ops
+        from paddle_tpu.static import InputSpec
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, x):
+                B = x.shape[0]
+                bias = ops.expand(ops.zeros([1, 8]), [B, 8])
+                mask = ops.broadcast_to(ops.ones([1, 8]), [B, 8])
+                return self.fc(x) + bias + mask
+
+        pt.seed(2)
+        m = M()
+        m.eval()
+        pt.jit.save(m, str(tmp_path / "m"),
+                    input_spec=[InputSpec([None, 8], "float32", "x")])
+        back = pt.jit.load(str(tmp_path / "m"))
+        for B in (1, 4):
+            x = pt.to_tensor(np.random.RandomState(B).randn(
+                B, 8).astype(np.float32))
+            np.testing.assert_allclose(back(x).numpy(), m(x).numpy(),
+                                       rtol=2e-4, atol=2e-5)
+
+
+def test_reshape_zero_copies_input_dim():
+    """paddle semantics: 0 in a reshape target copies the input dim."""
+    import paddle_tpu as pt
+    from paddle_tpu import ops
+    x = pt.to_tensor(np.arange(24, dtype=np.float32).reshape(4, 6))
+    assert ops.reshape(x, [0, -1]).shape == [4, 6]
+    assert ops.reshape(x, [0, 2, 3]).shape == [4, 2, 3]
