@@ -21,6 +21,18 @@ __all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv"]
 _initialized = {"done": False}
 
 
+def _jax_distributed_active() -> bool:
+    """Whether jax.distributed.initialize already ran. NOTE: probing via
+    jax.process_count() would INITIALIZE the backend — exactly what must
+    not happen before initialize — so peek at the (private) client state
+    and fail open if jax reorganizes it."""
+    try:
+        from jax._src import distributed as _jd
+        return getattr(_jd.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
 def init_parallel_env(mesh_shape: Optional[dict] = None):
     """Bootstrap distributed state and the default mesh.
 
@@ -35,12 +47,30 @@ def init_parallel_env(mesh_shape: Optional[dict] = None):
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
     n_proc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     proc_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    if coord and n_proc > 1 and jax.process_count() == 1:
-        port = os.environ.get("MASTER_PORT", "8476")
-        jax.distributed.initialize(
-            coordinator_address=f"{coord}:{port}" if ":" not in coord
-            else coord,
-            num_processes=n_proc, process_id=proc_id)
+    if coord and n_proc > 1 and not _jax_distributed_active():
+        explicit = os.environ.get("PADDLE_JAX_COORDINATOR")
+        if explicit:
+            addr = explicit
+        elif os.environ.get("PADDLE_STORE_PORT"):
+            # under the launcher PADDLE_MASTER is the TCPStore endpoint —
+            # a DIFFERENT protocol than jax's gRPC coordinator. Negotiate
+            # a separate coordinator port through the store, namespaced by
+            # the elastic restart epoch (a relaunched attempt must never
+            # read a dead coordinator's address).
+            from .tcp_store import free_port, job_store
+            store = job_store()
+            host = coord.split(":")[0]
+            epoch = os.environ.get("PADDLE_RESTART_EPOCH", "0")
+            key = f"__jax_coordinator/{epoch}"
+            if proc_id == 0:
+                store.set(key, f"{host}:{free_port(host)}".encode())
+            addr = store.wait(key).decode()
+        else:
+            port = os.environ.get("MASTER_PORT", "8476")
+            addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=n_proc,
+                                   process_id=proc_id)
     if get_mesh() is None:
         init_mesh(mesh_shape)
     _initialized["done"] = True

@@ -274,3 +274,14 @@ def job_store(timeout: float = 300.0) -> TCPStore:
         _job_store_cache[key] = TCPStore(host, int(port), is_master=False,
                                          timeout=timeout)
     return _job_store_cache[key]
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """Pick an ephemeral port on ``host`` (bind :0, read, close). Shared by
+    coordinator/endpoint negotiation; the close-then-rebind window is
+    accepted (same pattern as the rpc endpoint exchange)."""
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -37,7 +37,24 @@ def main():
     store = job_store()
     barrier_via_store(store, "itest/boot", world)
 
-    # 2. elastic heartbeats: both ranks beat, both see everyone alive
+    # 2. cross-process device collective FIRST (jax.distributed must
+    # initialize before anything touches the XLA backend): coordinator
+    # negotiated through the store, global mesh over both processes' CPU
+    # devices — the DCN device-mesh half, not just the host protocol
+    dist.init_parallel_env()
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    assert jax.process_count() == world, jax.process_count()
+    assert jax.device_count() == world, jax.device_count()
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, PartitionSpec("dp")),
+        np.full((1, 4), float(rank + 1), np.float32), (world, 4))
+    total = float(jax.jit(lambda a: a.sum())(arr))
+    want = sum(range(1, world + 1)) * 4.0
+    assert total == want, (total, want)
+
+    # 3. elastic heartbeats: both ranks beat, both see everyone alive
     em = ElasticManager(store, rank, world, heartbeat_interval=0.2,
                         heartbeat_timeout=5.0).start()
     deadline = time.monotonic() + 10
@@ -45,20 +62,17 @@ def main():
         time.sleep(0.1)
     assert em.all_alive(), f"rank {rank} sees dead peers: {em.dead_ranks()}"
 
-    # 3. rpc mesh on its own store (endpoint negotiated via the job store)
+    # 4. rpc mesh on its own store (endpoint negotiated via the job store)
     if rank == 0:
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
-        store.set("itest/rpc_ep", str(port).encode())
+        from paddle_tpu.distributed.tcp_store import free_port
+        store.set("itest/rpc_ep", str(free_port()).encode())
     port = int(store.wait("itest/rpc_ep"))
     rpc.init_rpc(f"w{rank}", rank=rank, world_size=world,
                  master_endpoint=f"127.0.0.1:{port}")
     got = rpc.rpc_sync(f"w{(rank + 1) % world}", remote_add, args=(3, 4))
     assert got == 7, got
 
-    # 4. parameter server hosted on w0, client pulls/pushes from w1
+    # 5. parameter server hosted on w0, client pulls/pushes from w1
     from paddle_tpu.distributed.ps import PSClient, PSServer
     if rank == 0:
         srv = PSServer()
@@ -73,7 +87,7 @@ def main():
         np.testing.assert_allclose(after, before - 0.5, rtol=1e-6)
     barrier_via_store(store, "itest/ps_done", world)
 
-    # 5. store-backed object collectives across the two processes
+    # 6. store-backed object collectives across the two processes
     gathered = []
     dist.all_gather_object(gathered, {"rank": rank, "msg": f"hello-{rank}"})
     assert [g["rank"] for g in gathered] == [0, 1], gathered
