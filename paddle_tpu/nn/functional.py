@@ -926,32 +926,41 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             "no gradient will flow to it. For a trainable additive bias, "
             "add it to the logits of a composite attention instead.",
             stacklevel=2)
-    # dropout is the one feature the Pallas kernel does not implement —
-    # active dropout must take the composite path rather than silently
-    # dropping the argument
-    pallas_eligible = dropout_p == 0.0 or not training
     s_q, s_k = query.shape[1], key.shape[1]
     causal_tagged = (
         attn_mask is not None
         and getattr(attn_mask, "_causal_diag", False)
         and s_q == s_k and tuple(attn_mask.shape)[-2:] == (s_q, s_k))
-    if use_pallas and pallas_eligible:
+    if use_pallas:
         try:
             import jax as _j
             if _j.default_backend() == "tpu":
                 from paddle_tpu.ops.pallas.flash_attention import (
                     flash_attention_bshd)
+                drop = float(dropout_p) if training else 0.0
+                seed = None
+                if drop > 0.0:
+                    # in-kernel position-hashed dropout; fresh seed per
+                    # call from the generator stream (a DIFFERENT pattern
+                    # than the composite's bernoulli — dropout RNG is
+                    # backend-specific by contract)
+                    import jax.random as _jr
+                    seed = _jr.randint(_gen.next_key(), (1,),
+                                       minval=-2**31, maxval=2**31 - 1,
+                                       dtype=jnp.int32)
                 if attn_mask is None or causal_tagged:
                     return flash_attention_bshd(
                         query, key, value,
                         causal=is_causal or causal_tagged,
                         q_segment_ids=q_segment_ids,
-                        kv_segment_ids=kv_segment_ids)
+                        kv_segment_ids=kv_segment_ids,
+                        dropout_p=drop, dropout_seed=seed)
                 bias = _additive_mask(attn_mask)
                 return flash_attention_bshd(
                     query, key, value, causal=is_causal, bias=bias,
                     q_segment_ids=q_segment_ids,
-                    kv_segment_ids=kv_segment_ids)
+                    kv_segment_ids=kv_segment_ids,
+                    dropout_p=drop, dropout_seed=seed)
         except Exception:
             pass
 

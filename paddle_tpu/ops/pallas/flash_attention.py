@@ -25,6 +25,12 @@ Supported generality (all combinations compose):
     ([B|1, H|1, Sq, Sk] broadcasting): O(S) VMEM still holds, and the
     backward is the fused flash backward. Bias is treated as a constant
     (zero gradient) — it serves attention *masks*, which never train.
+  * post-softmax dropout, in-kernel: a murmur-style position hash of
+    (head, q_pos, k_pos, seed) generates the keep mask — pure integer
+    jnp ops (works in interpret mode, unlike pltpu.prng) and identical
+    by construction across the forward and both backward kernels
+    whatever their grid layouts. ``l`` keeps the raw softmax
+    denominator; only value contributions drop (standard semantics).
 
 Kernel shape: q flattens to [B*Hq, Sq, D], kv to [B*Hkv, Sk, D]; every
 kernel walks a (flat heads, outer blocks, inner blocks) grid with the inner
@@ -98,6 +104,41 @@ def _masked_scores(q, k, bias_ref, seg, j, i, *, sm_scale, causal, offset,
     return jnp.maximum(s, _MASK_VALUE)
 
 
+def _threshold(dropout_p: float) -> int:
+    """uint32 drop threshold: bits below it drop (P = dropout_p)."""
+    return min(int(dropout_p * 2**32), 2**32 - 1)
+
+
+def _dropout_keep(seed_ref, bh, j, i, *, block_q, block_k, threshold):
+    """Deterministic keep-mask for one tile from GLOBAL (head, q, k)
+    positions — murmur3-style integer hash, pure jnp ops (portable to
+    interpret mode, identical in forward and both backward kernels
+    regardless of their different grid layouts)."""
+    qi = j * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    ki = i * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    # fold q and k positions separately — a qi*sk+ki linearization would
+    # alias rows once sq*sk exceeds 2^32 at extreme context lengths
+    x = qi.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    x = x ^ (ki.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    x = x ^ (bh.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    x = x ^ seed_ref[0].astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x >= jnp.uint32(threshold)
+
+
+def _qflat(b, t, *, hq, hkv, group, nq):
+    """Flat (batch, Q head) index for the dkv grid's (b over B*Hkv, t over
+    group*nq) coordinates. The dropout mask AND the q/do/lse BlockSpecs
+    must use this SAME mapping — one definition, used by both."""
+    return (b // hkv) * hq + (b % hkv) * group + t // nq
+
+
 def _causal_live(j, i, *, offset, block_q, block_k):
     """Static tile-liveness: any (q row, k col) in tile satisfies
     q_abs >= k_abs, where q_abs = q + offset (offset = Sk - Sq)."""
@@ -114,15 +155,17 @@ def _segments(qseg_ref, kvseg_ref):
 
 # =========================== forward =========================================
 def _fwd_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nk,
-                has_bias, has_seg):
+                has_bias, has_seg, dropout_p, sk, threshold):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     bias_ref = next(it) if has_bias else None
     qseg_ref = next(it) if has_seg else None
     kvseg_ref = next(it) if has_seg else None
+    seed_ref = next(it) if dropout_p > 0 else None
     o_ref, lse_ref = next(it), next(it)
     m_sc, l_sc, acc_sc = next(it), next(it), next(it)
 
+    bh = pl.program_id(0)
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -154,8 +197,15 @@ def _fwd_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nk,
             p = jnp.where(jnp.any(seg, axis=-1, keepdims=True), p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        p_acc = p
+        if dropout_p > 0:
+            # l keeps the RAW softmax denominator; only the value
+            # contributions drop (standard post-softmax dropout)
+            keep = _dropout_keep(seed_ref, bh, j, i, block_q=block_q,
+                                 block_k=block_k, threshold=threshold)
+            p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
         acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
@@ -223,8 +273,8 @@ def _build_specs(block_q, block_k, d, hq, hkv, bias_bh):
     return specs
 
 
-def _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
-         hq, hkv, bias_bh):
+def _fwd(q, k, v, bias, q_seg, kv_seg, seed, causal, sm_scale, block_q,
+         block_k, hq, hkv, bias_bh, dropout_p):
     bhq, sq, d = q.shape
     _, sk, _ = k.shape
     nq, nk = sq // block_q, sk // block_k
@@ -234,7 +284,8 @@ def _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
         block_q=block_q, block_k=block_k, nk=nk, has_bias=has_bias,
-        has_seg=has_seg)
+        has_seg=has_seg, dropout_p=dropout_p, sk=sk,
+        threshold=_threshold(dropout_p))
     sp = _build_specs(block_q, block_k, d, hq, hkv, bias_bh)
     in_specs = [sp["q"], sp["kv"], sp["kv"]]
     inputs = [q, k, v]
@@ -244,6 +295,9 @@ def _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
     if has_seg:
         in_specs += [sp["qseg"], sp["kvseg"]]
         inputs += [q_seg, kv_seg]
+    if dropout_p > 0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(seed)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bhq, nq, nk),
@@ -269,16 +323,18 @@ def _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
 
 # =========================== backward ========================================
 def _dq_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nk,
-               has_bias, has_seg):
+               has_bias, has_seg, dropout_p, sk, threshold):
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref = next(it), next(it), next(it), next(it)
     lse_ref, delta_ref = next(it), next(it)
     bias_ref = next(it) if has_bias else None
     qseg_ref = next(it) if has_seg else None
     kvseg_ref = next(it) if has_seg else None
+    seed_ref = next(it) if dropout_p > 0 else None
     dq_ref = next(it)
     dq_sc = next(it)
 
+    bh = pl.program_id(0)
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -302,6 +358,10 @@ def _dq_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nk,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0:
+            keep = _dropout_keep(seed_ref, bh, j, i, block_q=block_q,
+                                 block_k=block_k, threshold=threshold)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_p))
         ds = (p * (dp - delta[:, None]) * sm_scale).astype(k.dtype)
         dq_sc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -324,20 +384,25 @@ def _dq_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nk,
 
 
 def _dkv_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nq,
-                group, has_bias, has_seg):
+                group, has_bias, has_seg, dropout_p, sk, threshold, hq,
+                hkv):
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref = next(it), next(it), next(it), next(it)
     lse_ref, delta_ref = next(it), next(it)
     bias_ref = next(it) if has_bias else None
     qseg_ref = next(it) if has_seg else None
     kvseg_ref = next(it) if has_seg else None
+    seed_ref = next(it) if dropout_p > 0 else None
     dk_ref, dv_ref = next(it), next(it)
     dk_sc, dv_sc = next(it), next(it)
 
+    b = pl.program_id(0)   # flat (batch, kv head)
     i = pl.program_id(1)   # k block
     t = pl.program_id(2)   # fused (query head in group, q block)
     j = t % nq
     gnq = group * nq
+    # flat (batch, Q head) index — the dropout mask is defined per q-head
+    bh_q = _qflat(b, t, hq=hq, hkv=hkv, group=group, nq=nq)
 
     @pl.when(t == 0)
     def _init():
@@ -358,11 +423,18 @@ def _dkv_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nq,
                            causal=causal, offset=offset, block_q=block_q,
                            block_k=block_k)
         p = jnp.exp(s - lse[:, None])  # [bq, bk] f32
+        p_v = p
+        if dropout_p > 0:
+            keep = _dropout_keep(seed_ref, bh_q, j, i, block_q=block_q,
+                                 block_k=block_k, threshold=threshold)
+            p_v = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
         dv_sc[...] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_p > 0:
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_p))
         ds = (p * (dp - delta[:, None]) * sm_scale).astype(q.dtype)
         dk_sc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
@@ -385,8 +457,8 @@ def _dkv_kernel(*refs, sm_scale, causal, offset, block_q, block_k, nq,
         dv_ref[...] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal, sm_scale,
-         block_q, block_k, hq, hkv, bias_bh):
+def _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, seed, causal, sm_scale,
+         block_q, block_k, hq, hkv, bias_bh, dropout_p):
     bhq, sq, d = q.shape
     bhkv, sk, _ = k.shape
     group = hq // hkv
@@ -401,7 +473,8 @@ def _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal, sm_scale,
     dq_kernel = functools.partial(
         _dq_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
         block_q=block_q, block_k=block_k, nk=nk, has_bias=has_bias,
-        has_seg=has_seg)
+        has_seg=has_seg, dropout_p=dropout_p, sk=sk,
+        threshold=_threshold(dropout_p))
     in_specs = [sp["q"], sp["kv"], sp["kv"], sp["q"], sp["row_q"],
                 sp["row_q"]]
     inputs = [q, k, v, do, lse, delta]
@@ -411,6 +484,9 @@ def _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal, sm_scale,
     if has_seg:
         in_specs += [sp["qseg"], sp["kvseg"]]
         inputs += [q_seg, kv_seg]
+    if dropout_p > 0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        inputs.append(seed)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bhq, nq, nk),
@@ -428,7 +504,7 @@ def _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal, sm_scale,
     # accumulating into one [block_k, d] scratch. GQA head reduction happens
     # in-kernel; dk/dv never inflate to Hq.
     def qflat(b, t):
-        return (b // hkv) * hq + (b % hkv) * group + t // nq
+        return _qflat(b, t, hq=hq, hkv=hkv, group=group, nq=nq)
 
     dkv_in_specs = [
         pl.BlockSpec((None, block_q, d),
@@ -467,11 +543,15 @@ def _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal, sm_scale,
                          lambda b, i, t: (b // hkv, 0, i)),
         ]
         dkv_inputs += [q_seg, kv_seg]
+    if dropout_p > 0:
+        dkv_in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        dkv_inputs.append(seed)
 
     dkv_kernel = functools.partial(
         _dkv_kernel, sm_scale=sm_scale, causal=causal, offset=offset,
         block_q=block_q, block_k=block_k, nq=nq, group=group,
-        has_bias=has_bias, has_seg=has_seg)
+        has_bias=has_bias, has_seg=has_seg, dropout_p=dropout_p, sk=sk,
+        threshold=_threshold(dropout_p), hq=hq, hkv=hkv)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bhkv, nk, group * nq),
@@ -495,33 +575,35 @@ def _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal, sm_scale,
 
 
 # =========================== custom-vjp wrapper ==============================
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
-def _flash(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q, block_k,
-           hq, hkv, bias_bh):
-    o, _ = _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q,
-                block_k, hq, hkv, bias_bh)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
+def _flash(q, k, v, bias, q_seg, kv_seg, seed, causal, sm_scale, block_q,
+           block_k, hq, hkv, bias_bh, dropout_p):
+    o, _ = _fwd(q, k, v, bias, q_seg, kv_seg, seed, causal, sm_scale,
+                block_q, block_k, hq, hkv, bias_bh, dropout_p)
     return o
 
 
-def _flash_fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q,
-               block_k, hq, hkv, bias_bh):
-    o, lse = _fwd(q, k, v, bias, q_seg, kv_seg, causal, sm_scale, block_q,
-                  block_k, hq, hkv, bias_bh)
-    return o, (q, k, v, bias, q_seg, kv_seg, o, lse)
+def _flash_fwd(q, k, v, bias, q_seg, kv_seg, seed, causal, sm_scale,
+               block_q, block_k, hq, hkv, bias_bh, dropout_p):
+    o, lse = _fwd(q, k, v, bias, q_seg, kv_seg, seed, causal, sm_scale,
+                  block_q, block_k, hq, hkv, bias_bh, dropout_p)
+    return o, (q, k, v, bias, q_seg, kv_seg, seed, o, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, hq, hkv, bias_bh, res,
-               do):
-    q, k, v, bias, q_seg, kv_seg, o, lse = res
-    dq, dk, dv = _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, causal,
-                      sm_scale, block_q, block_k, hq, hkv, bias_bh)
+def _flash_bwd(causal, sm_scale, block_q, block_k, hq, hkv, bias_bh,
+               dropout_p, res, do):
+    q, k, v, bias, q_seg, kv_seg, seed, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, bias, q_seg, kv_seg, seed,
+                      causal, sm_scale, block_q, block_k, hq, hkv, bias_bh,
+                      dropout_p)
     # bias is an attention mask: constant by contract (zero grad); segment
     # ids are carried as f32 so integer-cotangent (float0) plumbing never
-    # enters the picture
+    # enters the picture; the seed is integer state (no grad)
     dbias = None if bias is None else jnp.zeros_like(bias)
     dqs = None if q_seg is None else jnp.zeros_like(q_seg)
     dks = None if kv_seg is None else jnp.zeros_like(kv_seg)
-    return dq, dk, dv, dbias, dqs, dks
+    return dq, dk, dv, dbias, dqs, dks, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -533,7 +615,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # optional inputs are empty pytrees — one jitted callable serves every
 # bias/segment combination.
 _flash_cached = functools.partial(
-    jax.jit, static_argnums=(6, 7, 8, 9, 10, 11, 12))(_flash)
+    jax.jit, static_argnums=(7, 8, 9, 10, 11, 12, 13, 14))(_flash)
 
 
 def _pick_block(requested, seq):
@@ -596,6 +678,7 @@ def _norm_seg(seg, b, s, name):
 
 def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
                          q_segment_ids=None, kv_segment_ids=None,
+                         dropout_p=0.0, dropout_seed=None,
                          block_q=_DEF_BLOCK_Q, block_k=_DEF_BLOCK_K):
     """Flash attention on arrays in [B, H, S, D] (or [BH, S, D]) layout.
 
@@ -661,9 +744,21 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
     if q_segment_ids is not None:
         q_seg = _norm_seg(q_segment_ids, b, sq, "q_segment_ids")
         kv_seg = _norm_seg(kv_segment_ids, b, sk, "kv_segment_ids")
+    dropout_p = float(dropout_p)
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    seed = None
+    if dropout_p > 0.0:
+        if dropout_seed is None:
+            raise ValueError(
+                "dropout_p > 0 requires dropout_seed (an int or int32 "
+                "array) so forward and recompute-backward agree")
+        seed = jnp.atleast_1d(jnp.asarray(dropout_seed)).astype(
+            jnp.int32)[:1]
 
-    out = _flash_cached(q, k, v, bias, q_seg, kv_seg, causal,
-                        float(sm_scale), block_q, block_k, hq, hkv, bias_bh)
+    out = _flash_cached(q, k, v, bias, q_seg, kv_seg, seed, causal,
+                        float(sm_scale), block_q, block_k, hq, hkv,
+                        bias_bh, dropout_p)
     if squeeze:
         b, hq = squeeze
         out = out.reshape(b, hq, sq, d)
@@ -672,6 +767,7 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
 
 def flash_attention_bshd(query, key, value, causal=False, sm_scale=None,
                          bias=None, q_segment_ids=None, kv_segment_ids=None,
+                         dropout_p=0.0, dropout_seed=None,
                          block_q=_DEF_BLOCK_Q, block_k=_DEF_BLOCK_K):
     """Flash attention with paddle's [batch, seq, heads, head_dim] layout,
     Tensor-in/Tensor-out, recorded on the autograd tape. ``key``/``value``
@@ -695,6 +791,8 @@ def flash_attention_bshd(query, key, value, causal=False, sm_scale=None,
                                  sm_scale=sm_scale, bias=bias_arr,
                                  q_segment_ids=qseg_arr,
                                  kv_segment_ids=kvseg_arr,
+                                 dropout_p=dropout_p,
+                                 dropout_seed=dropout_seed,
                                  block_q=block_q, block_k=block_k)
         return jnp.swapaxes(o, 1, 2)
     return apply_op(f, query, key, value, op_name="flash_attention")

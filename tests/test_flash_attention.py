@@ -221,8 +221,7 @@ class TestForward:
                                    rtol=2e-4, atol=2e-5)
 
     def test_sdpa_router(self, monkeypatch):
-        # masked and GQA cases now ROUTE to the kernel (bias streaming);
-        # active dropout still must not (kernel has no dropout)
+        # masked, GQA and DROPOUT cases all ROUTE to the kernel now
         import paddle_tpu.nn.functional as F
         import paddle_tpu.ops.pallas.flash_attention as fa_mod
         monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
@@ -241,9 +240,14 @@ class TestForward:
         assert len(calls) == 1 and calls[0]["bias"] is not None
         F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
                                        training=True)
-        assert len(calls) == 1  # active dropout: composite path
+        # active dropout reaches the kernel WITH p and a seed
+        assert len(calls) == 2 and calls[1]["dropout_p"] == 0.5
+        assert calls[1]["dropout_seed"] is not None
+        F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                       training=False)
+        assert calls[2]["dropout_p"] == 0.0  # eval: dropout off
         F.scaled_dot_product_attention(q, q, q, is_causal=True)
-        assert len(calls) == 2  # plain causal reaches the kernel
+        assert len(calls) == 4  # plain causal reaches the kernel
 
         # generate_square_subsequent_mask is recognized: kernel sees
         # causal=True and NO bias (S×S mask never streamed)
@@ -383,3 +387,147 @@ class TestTapeIntegration:
         np.testing.assert_allclose(out.numpy(),
                                    np.asarray(jnp.swapaxes(ref, 1, 2)),
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestDropout:
+    """In-kernel attention dropout: position-hashed keep mask (identical
+    in fwd and both bwd kernels), l keeps the raw softmax denominator —
+    standard post-softmax dropout semantics."""
+
+    @staticmethod
+    def np_keep(seed, bh, Sq, Sk, p):
+        """numpy reimplementation of the kernel's murmur-style hash
+        (int64 arithmetic masked to 32 bits: identical wrap semantics,
+        no numpy scalar-overflow warnings)."""
+        M = 0xFFFFFFFF
+        qi, ki = np.meshgrid(np.arange(Sq, dtype=np.int64),
+                             np.arange(Sk, dtype=np.int64), indexing="ij")
+        x = (qi * 0x9E3779B9) & M
+        x ^= (ki * 0xC2B2AE35) & M
+        x ^= (int(bh) * 0x85EBCA6B) & M
+        x ^= np.int64(np.uint32(np.int32(seed)))
+        x ^= x >> 16
+        x = (x * 0x85EBCA6B) & M
+        x ^= x >> 13
+        x = (x * 0xC2B2AE35) & M
+        x ^= x >> 16
+        thr = min(int(p * 2**32), 2**32 - 1)
+        return x >= thr
+
+    def oracle_dropout(self, q, k, v, p, seed):
+        """Standard attention with the kernel's exact mask."""
+        BH, S, D = q.shape
+        s = np.einsum("bqd,bkd->bqk", np.asarray(q), np.asarray(k)) / \
+            np.sqrt(D)
+        w = np.exp(s - s.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        out = np.zeros_like(np.asarray(q))
+        for bh in range(BH):
+            keep = self.np_keep(seed, bh, S, S, p)
+            wd = np.where(keep, w[bh], 0.0) / (1.0 - p)
+            out[bh] = wd @ np.asarray(v[bh])
+        return out
+
+    def test_p0_matches_plain(self, qkv):
+        q, k, v = qkv
+        a = flash_attention_bhsd(q, k, v, block_q=64, block_k=64)
+        b = flash_attention_bhsd(q, k, v, dropout_p=0.0, block_q=64,
+                                 block_k=64)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_matches_hash_oracle_exactly(self, qkv):
+        q, k, v = qkv
+        p, seed = 0.3, 1234
+        out = flash_attention_bhsd(q, k, v, dropout_p=p, dropout_seed=seed,
+                                   block_q=64, block_k=64)
+        ref = self.oracle_dropout(q, k, v, p, seed)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_deterministic_and_seed_sensitive(self, qkv):
+        q, k, v = qkv
+        a = flash_attention_bhsd(q, k, v, dropout_p=0.2, dropout_seed=7,
+                                 block_q=64, block_k=64)
+        b = flash_attention_bhsd(q, k, v, dropout_p=0.2, dropout_seed=7,
+                                 block_q=64, block_k=64)
+        c = flash_attention_bhsd(q, k, v, dropout_p=0.2, dropout_seed=8,
+                                 block_q=64, block_k=64)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert np.abs(np.asarray(a) - np.asarray(c)).max() > 0
+
+    def test_block_size_invariant(self, qkv):
+        # the mask is position-based: tiling must not change the result
+        q, k, v = qkv
+        a = flash_attention_bhsd(q, k, v, dropout_p=0.25, dropout_seed=3,
+                                 block_q=64, block_k=64)
+        b = flash_attention_bhsd(q, k, v, dropout_p=0.25, dropout_seed=3,
+                                 block_q=128, block_k=64)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_grads_match_hash_oracle(self):
+        rng = np.random.RandomState(13)
+        BH, S, D = 2, 128, 32
+        q = jnp.asarray(rng.randn(BH, S, D), jnp.float32)
+        k = jnp.asarray(rng.randn(BH, S, D), jnp.float32)
+        v = jnp.asarray(rng.randn(BH, S, D), jnp.float32)
+        p, seed = 0.3, 99
+
+        keeps = np.stack([self.np_keep(seed, bh, S, S, p)
+                          for bh in range(BH)])
+
+        def ref(a, b, c):
+            s = jnp.einsum("bqd,bkd->bqk", a, b) / np.sqrt(D)
+            w = jax.nn.softmax(s, axis=-1)
+            wd = jnp.where(jnp.asarray(keeps), w, 0.0) / (1.0 - p)
+            return jnp.sum(jnp.sin(jnp.einsum("bqk,bkd->bqd", wd, c)))
+
+        def got(a, b, c):
+            return jnp.sum(jnp.sin(flash_attention_bhsd(
+                a, b, c, dropout_p=p, dropout_seed=seed, block_q=64,
+                block_k=64)))
+
+        ga = jax.grad(got, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for x, y in zip(ga, gr):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_grads_gqa_causal_dropout(self):
+        """The riskiest path: dkv's _qflat-derived head index must give
+        the SAME mask the forward used, under GQA + causal."""
+        rng = np.random.RandomState(21)
+        B, Hq, Hkv, S, D = 2, 4, 2, 128, 32
+        q = jnp.asarray(rng.randn(B, Hq, S, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, Hkv, S, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, Hkv, S, D), jnp.float32)
+        p, seed = 0.25, 17
+        keeps = np.stack([self.np_keep(seed, bh, S, S, p)
+                          for bh in range(B * Hq)]).reshape(B, Hq, S, S)
+
+        def ref(a, b, c):
+            G = Hq // Hkv
+            kf = jnp.repeat(b, G, axis=1)
+            vf = jnp.repeat(c, G, axis=1)
+            s_ = jnp.einsum("bhqd,bhkd->bhqk", a, kf) / np.sqrt(D)
+            causal = jnp.tril(jnp.ones((S, S), bool))
+            s_ = jnp.where(causal, s_, -jnp.inf)
+            w = jax.nn.softmax(s_, axis=-1)
+            wd = jnp.where(jnp.asarray(keeps), w, 0.0) / (1.0 - p)
+            return jnp.sum(jnp.sin(jnp.einsum("bhqk,bhkd->bhqd", wd, vf)))
+
+        def got(a, b, c):
+            return jnp.sum(jnp.sin(flash_attention_bhsd(
+                a, b, c, causal=True, dropout_p=p, dropout_seed=seed,
+                block_q=64, block_k=64)))
+
+        ga = jax.grad(got, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for x, y in zip(ga, gr):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_drop_rate(self):
+        keep = self.np_keep(5, 0, 256, 256, 0.4)
+        rate = 1.0 - keep.mean()
+        assert abs(rate - 0.4) < 0.01, rate
