@@ -63,7 +63,20 @@ def init_parallel_env(mesh_shape: Optional[dict] = None):
             epoch = os.environ.get("PADDLE_RESTART_EPOCH", "0")
             key = f"__jax_coordinator/{epoch}"
             if proc_id == 0:
-                store.set(key, f"{host}:{free_port(host)}".encode())
+                # the coordinator service runs INSIDE proc 0, so the
+                # advertised host must be proc 0's reachable address. When
+                # proc 0 owns the PADDLE_MASTER address (the common
+                # single-node / master-on-rank-0 layout) advertise that;
+                # otherwise (explicit --master on another node) advertise
+                # this machine's hostname instead of crashing on the bind.
+                try:
+                    port = free_port(host)
+                    adv = host
+                except OSError:
+                    import socket as _socket
+                    adv = _socket.gethostname()
+                    port = free_port("")
+                store.set(key, f"{adv}:{port}".encode())
             addr = store.wait(key).decode()
         else:
             port = os.environ.get("MASTER_PORT", "8476")

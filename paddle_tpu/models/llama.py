@@ -286,10 +286,13 @@ class LlamaForCausalLM(nn.Layer):
             w = self.model.embed_tokens.weight
 
             def f(ha, wa, lab):
+                tgt = lab[:, 1:].reshape(-1)
                 per_tok = matmul_cross_entropy(
-                    ha[:, :-1, :].reshape(-1, ha.shape[-1]), wa,
-                    lab[:, 1:].reshape(-1))
-                return per_tok.mean()
+                    ha[:, :-1, :].reshape(-1, ha.shape[-1]), wa, tgt)
+                # masked mean over non-ignored tokens, matching the
+                # reference cross_entropy(reduction='mean') semantics
+                valid = (tgt != -100).astype(per_tok.dtype)
+                return per_tok.sum() / jnp.maximum(valid.sum(), 1.0)
             loss = apply_op(f, h, w, labels, op_name="fused_causal_ce")
             return None, loss
         logits = self._logits(h)

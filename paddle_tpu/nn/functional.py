@@ -920,12 +920,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             "pure key padding use all-ones q_segment_ids")
     if attn_mask is not None and \
             getattr(attn_mask, "stop_gradient", True) is False:
-        import warnings
-        warnings.warn(
+        # the caller explicitly asked for a mask gradient that every route
+        # (Pallas and composite) would silently zero — fail loudly
+        raise ValueError(
             "scaled_dot_product_attention treats attn_mask as a constant: "
             "no gradient will flow to it. For a trainable additive bias, "
-            "add it to the logits of a composite attention instead.",
-            stacklevel=2)
+            "add it to the logits of a composite attention instead, or set "
+            "attn_mask.stop_gradient = True.")
     s_q, s_k = query.shape[1], key.shape[1]
     causal_tagged = (
         attn_mask is not None
@@ -1076,10 +1077,17 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                     + label_smoothing * smooth
             loss = -jnp.where(valid, picked, 0.0)
             if w:
-                loss = loss * jnp.take(w[0], safe)
+                tw = jnp.take(w[0], safe)
+                loss = loss * tw
+                if reduction == "mean":
+                    # reference mean: sum / sum-of-weights over valid tokens
+                    wt = tw * valid.astype(loss.dtype)
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
             if reduction == "mean":
-                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0) \
-                    if ignore_index >= 0 else loss.size
+                # reference mean divides by the count of NON-ignored tokens
+                # (including at the default ignore_index=-100); with no
+                # ignored labels this equals loss.size, so always mask-mean
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
                 return jnp.sum(loss) / denom
         return _reduce(loss, reduction)
     args = [input, label] + ([weight] if weight is not None else [])

@@ -59,8 +59,8 @@ def _fwd(h, w_vd, labels, valid, n_chunks):
             jnp.zeros((T,), jnp.float32))
     (m, s, lab), _ = jax.lax.scan(body, init, (wc, starts))
     lse = m + jnp.log(s)
-    # ignored tokens: zero loss (F.cross_entropy convention — the mean
-    # still divides by ALL tokens at the default ignore_index)
+    # ignored tokens: zero loss; callers reducing to a mean must divide by
+    # the VALID-token count (F.cross_entropy masked-mean semantics)
     return jnp.where(valid, lse - lab, 0.0), lse
 
 

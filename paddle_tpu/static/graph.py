@@ -31,6 +31,11 @@ __all__ = ["Program", "Executor", "program_guard", "data",
 
 
 _token_counter = [0]
+# slotted/unsettable objects can't carry the token attribute; key them by
+# id() in a side table with a GC finalizer evicting the entry, so a dead
+# object's reused id() can never alias its token (and, unlike a
+# WeakKeyDictionary, value-equal distinct objects never share a token)
+_token_side_table: dict = {}
 
 
 def _cache_token(obj) -> int:
@@ -45,7 +50,17 @@ def _cache_token(obj) -> int:
         try:
             object.__setattr__(obj, "_exe_cache_token", tok)
         except (AttributeError, TypeError):
-            return id(obj)  # slotted object: fall back (documented risk)
+            key = id(obj)
+            if key in _token_side_table:
+                return _token_side_table[key]
+            import weakref
+            try:
+                weakref.finalize(obj, _token_side_table.pop, key, None)
+            except TypeError:
+                # unweakrefable AND unsettable: id+type — narrow residual
+                # aliasing window only for such exotic objects
+                return hash((type(obj).__qualname__, id(obj)))
+            _token_side_table[key] = tok
     return tok
 
 

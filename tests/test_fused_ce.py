@@ -149,6 +149,27 @@ def test_ignore_index_parity():
     assert np.all(np.asarray(g_got[0])[T // 2:] == 0.0)
 
 
+def test_cross_entropy_masked_mean_semantics():
+    """reference cross_entropy(reduction='mean') divides by the count of
+    non-ignored tokens whenever any label equals ignore_index — including
+    the default -100 (reference loss.py mask/count branch)."""
+    import paddle_tpu as pt
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.RandomState(7)
+    T, V = 48, 32
+    logits = rng.randn(T, V).astype(np.float32)
+    lab = rng.randint(0, V, (T,))
+    lab[T // 3:] = -100
+
+    got = F.cross_entropy(pt.to_tensor(logits),
+                          pt.to_tensor(lab.astype(np.int64))).numpy()
+    lse = np.log(np.exp(logits).sum(-1))
+    per = lse - logits[np.arange(T), np.where(lab == -100, 0, lab)]
+    want = per[: T // 3].mean()  # mean over VALID tokens only
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
 def test_llama_fused_vs_plain_with_padding():
     import paddle_tpu as pt
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -171,5 +192,16 @@ def test_llama_fused_vs_plain_with_padding():
         _, fused = m(x, labels=y)
         np.testing.assert_allclose(fused.numpy(), plain.numpy(),
                                    rtol=1e-5, atol=1e-6)
+        # reference masked-mean semantics: with -100-padded labels the mean
+        # divides by the VALID token count, so loss must equal the mean of
+        # per-token losses over unpadded positions only. Cross-check by
+        # doubling the padded tail: more padding must NOT shrink the loss.
+        labels2 = ids.copy()
+        labels2[:, 10:] = -100
+        _, fused_more_pad = m(x, labels=pt.to_tensor(
+            labels2.astype(np.int64)))
+        assert fused_more_pad.numpy() > 0.5 * fused.numpy(), \
+            "loss scaled down by the valid fraction — mean is dividing " \
+            "by ALL tokens instead of valid tokens"
     finally:
         LlamaForCausalLM._FUSED_CE_MIN_VOCAB = old
