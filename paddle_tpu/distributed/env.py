@@ -73,8 +73,19 @@ def init_parallel_env(mesh_shape: Optional[dict] = None):
                     port = free_port(host)
                     adv = host
                 except OSError:
+                    # rank 0 doesn't own the master address: advertise the
+                    # IP of the interface that reaches it (UDP connect
+                    # sends nothing, just resolves routing) — a bare
+                    # gethostname() is often unresolvable cluster-wide
                     import socket as _socket
-                    adv = _socket.gethostname()
+                    s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                    try:
+                        s.connect((host, 1))
+                        adv = s.getsockname()[0]
+                    except OSError:
+                        adv = _socket.gethostname()
+                    finally:
+                        s.close()
                     port = free_port("")
                 store.set(key, f"{adv}:{port}".encode())
             addr = store.wait(key).decode()

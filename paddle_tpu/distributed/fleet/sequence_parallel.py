@@ -108,11 +108,8 @@ def _ring_attention_arrays(q, k, v, mesh, axis, causal, sm_scale):
         a0 = jnp.zeros((b, h, sq, d), jnp.float32)
         # mark the replicated initializers device-varying so the scan carry
         # type matches the rank-dependent outputs (shard_map vma rule)
-        try:
-            m0, l0, a0 = (jax.lax.pcast(x, to="varying")
-                          for x in (m0, l0, a0))
-        except (AttributeError, TypeError):
-            m0, l0, a0 = (jax.lax.pvary(x, axis) for x in (m0, l0, a0))
+        from .utils import pvary_compat
+        m0, l0, a0 = (pvary_compat(x, axis) for x in (m0, l0, a0))
         m, l, acc, _, _ = jax.lax.fori_loop(0, n, step,
                                             (m0, l0, a0, kl, vl))
         out = acc / jnp.maximum(l, 1e-20)[..., None]

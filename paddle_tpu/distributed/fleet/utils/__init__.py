@@ -27,7 +27,27 @@ from . import fs  # noqa: F401
 from .fs import HDFSClient, LocalFS  # noqa: F401
 
 __all__ = ["recompute", "recompute_sequential", "LocalFS", "HDFSClient",
-           "fs"]
+           "fs", "pvary_compat"]
+
+
+def pvary_compat(x, axis):
+    """Mark a freshly-created invariant array device-varying over ``axis``
+    (the shard_map vma rule for scan carries whose other inputs are
+    rank-dependent). No-op when the value is already varying or the running
+    jax predates/postdates the pcast/pvary split — shared by the ring
+    attention and SPMD pipeline kernels."""
+    try:
+        if axis in getattr(jax.typeof(x), "vma", ()):
+            return x
+    except (AttributeError, TypeError):
+        pass
+    try:
+        return jax.lax.pcast(x, to="varying")
+    except (AttributeError, TypeError):
+        try:
+            return jax.lax.pvary(x, axis)
+        except (AttributeError, TypeError):
+            return x
 
 
 def _owning_layer(function) -> Layer | None:

@@ -910,29 +910,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     without ever materializing or reading the S×S mask. Segment ids map the
     reference's varlen/unpadded flash variant.
 
-    ``attn_mask`` is a *constant* by contract on every route (the
-    reference's fused attention emits no mask gradient either)."""
+    A non-trainable ``attn_mask`` is a *constant* on every route; a
+    trainable float mask (``stop_gradient=False`` — a learned additive
+    bias) takes the differentiable composite path and receives a gradient,
+    matching the reference's logits-add / grad_bias behavior."""
     from paddle_tpu.core.flags import flag
     use_pallas = flag("use_pallas_kernels")
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError(
             "q_segment_ids and kv_segment_ids must be passed together; for "
             "pure key padding use all-ones q_segment_ids")
-    if attn_mask is not None and \
-            getattr(attn_mask, "stop_gradient", True) is False:
-        # the caller explicitly asked for a mask gradient that every route
-        # (Pallas and composite) would silently zero — fail loudly
-        raise ValueError(
-            "scaled_dot_product_attention treats attn_mask as a constant: "
-            "no gradient will flow to it. For a trainable additive bias, "
-            "add it to the logits of a composite attention instead, or set "
-            "attn_mask.stop_gradient = True.")
+    # trainable float masks (learned relative-position biases through
+    # MultiHeadAttention / memory_efficient_attention) must RECEIVE a
+    # gradient — the reference's composite adds the mask to the logits and
+    # its fused kernel emits grad_bias. Route them to the differentiable
+    # composite (the Pallas kernel streams the bias as a constant).
+    mask_trainable = attn_mask is not None and \
+        getattr(attn_mask, "stop_gradient", True) is False
     s_q, s_k = query.shape[1], key.shape[1]
     causal_tagged = (
         attn_mask is not None
         and getattr(attn_mask, "_causal_diag", False)
         and s_q == s_k and tuple(attn_mask.shape)[-2:] == (s_q, s_k))
-    if use_pallas:
+    if use_pallas and not mask_trainable:
         try:
             import jax as _j
             if _j.default_backend() == "tpu":
@@ -967,12 +967,12 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     drop_key = _gen.next_key() if (dropout_p > 0 and training) else None
     seg_mask = _segment_mask(q_segment_ids, kv_segment_ids)
-    # attn_mask is a constant by contract (the reference's fused attention
-    # emits no mask gradient either) — closed over, NOT taped, so both the
-    # Pallas route (zero bias grad) and this composite agree
+    # a non-trainable attn_mask is a constant — closed over, NOT taped, so
+    # the Pallas route (zero bias grad) and this composite agree; a
+    # trainable one is passed as a taped operand instead (grad flows)
     mask_arr = None if attn_mask is None else _unwrap(attn_mask)
 
-    def f(q, k, v):
+    def f(q, k, v, *taped_mask):
         scale = 1.0 / math.sqrt(q.shape[-1])
         # [B,S,H,D] -> [B,H,S,D]
         qt = jnp.swapaxes(q, 1, 2)
@@ -990,8 +990,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if seg_mask is not None:
             logits = jnp.where(seg_mask[:, None],
                                logits, jnp.finfo(logits.dtype).min)
-        if mask_arr is not None:
-            m = jax.lax.stop_gradient(mask_arr)
+        if taped_mask or mask_arr is not None:
+            m = taped_mask[0] if taped_mask \
+                else jax.lax.stop_gradient(mask_arr)
             if m.dtype == jnp.bool_:
                 logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
             else:
@@ -1006,6 +1007,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         out = jnp.einsum("bhqk,bhkd->bhqd", w, vt)
         return jnp.swapaxes(out, 1, 2)
 
+    if mask_trainable:
+        return apply_op(f, query, key, value, attn_mask,
+                        op_name="scaled_dot_product_attention")
     return apply_op(f, query, key, value,
                     op_name="scaled_dot_product_attention")
 
@@ -1080,15 +1084,22 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                 tw = jnp.take(w[0], safe)
                 loss = loss * tw
                 if reduction == "mean":
-                    # reference mean: sum / sum-of-weights over valid tokens
-                    wt = tw * valid.astype(loss.dtype)
-                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+                    # reference mean: sum / sum-of-weights over valid
+                    # tokens; reduce in f32 so bf16/f16 losses never round
+                    # the denominator (integer counts are exact only to
+                    # 256 in bf16)
+                    wt = jnp.sum(jnp.where(valid, tw, 0),
+                                 dtype=jnp.float32)
+                    return (jnp.sum(loss, dtype=jnp.float32) /
+                            jnp.maximum(wt, 1e-12)).astype(loss.dtype)
             if reduction == "mean":
                 # reference mean divides by the count of NON-ignored tokens
                 # (including at the default ignore_index=-100); with no
-                # ignored labels this equals loss.size, so always mask-mean
-                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
-                return jnp.sum(loss) / denom
+                # ignored labels this equals loss.size, so always mask-mean.
+                # f32 accumulation: see weighted branch.
+                denom = jnp.maximum(jnp.sum(valid, dtype=jnp.float32), 1.0)
+                return (jnp.sum(loss, dtype=jnp.float32) /
+                        denom).astype(loss.dtype)
         return _reduce(loss, reduction)
     args = [input, label] + ([weight] if weight is not None else [])
     return apply_op(f, *args, op_name="cross_entropy")

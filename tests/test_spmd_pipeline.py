@@ -1,0 +1,242 @@
+"""SPMD (collective) pipeline: the compiled-ppermute engine that replaces
+single-controller device_put hops so pipeline stages can span hosts
+(reference counterpart: fleet/meta_parallel/pp_utils/p2p_communication.py
+send_v2/recv_v2 + pipeline_parallel.py 1F1B/interleave)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.fleet as fleet
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+@pytest.fixture()
+def pp_mesh():
+    return dist.init_mesh({"dp": 2, "pp": 4})
+
+
+def _mlp_chunks(rng, C, d=8):
+    Ws = rng.randn(C, d, d).astype(np.float32) * 0.3
+    bs = rng.randn(C, d).astype(np.float32) * 0.1
+    return Ws, bs
+
+
+def _body(p, x):
+    return jnp.tanh(x @ p["W"] + p["b"])
+
+
+@pytest.mark.parametrize("v,M", [(1, 4), (2, 4), (1, 6), (2, 6), (3, 8)])
+def test_forward_parity_vs_sequential(pp_mesh, v, M):
+    """Any micro-count (no M % S constraint), any virtual-stage depth:
+    the pipelined result equals running the chunks sequentially."""
+    S = 4
+    rng = np.random.RandomState(v * 10 + M)
+    Ws, bs = _mlp_chunks(rng, v * S)
+    params = {"W": jnp.asarray(Ws).reshape(v, S, 8, 8),
+              "b": jnp.asarray(bs).reshape(v, S, 8)}
+    xs = jnp.asarray(rng.randn(M, 2, 8).astype(np.float32))
+    out = fleet.pipeline_spmd(_body, params, xs, mesh=pp_mesh,
+                              num_virtual_stages=v)
+    ref = np.asarray(xs)
+    for c in range(v * S):
+        ref = np.tanh(ref @ Ws[c] + bs[c])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_grad_parity_vs_sequential(pp_mesh):
+    """jax.grad through the scan+ppermute schedule = the reverse pipeline;
+    gradients must match the sequential oracle exactly (no bubble-mask
+    leakage into active gradients)."""
+    S, v, M = 4, 2, 4
+    C = v * S
+    rng = np.random.RandomState(3)
+    Ws, bs = _mlp_chunks(rng, C)
+    xs = jnp.asarray(rng.randn(M, 2, 8).astype(np.float32))
+
+    def loss_pipe(W, b, x):
+        out = fleet.pipeline_spmd(
+            _body, {"W": W, "b": b}, x, mesh=pp_mesh, num_virtual_stages=v)
+        return (out ** 2).mean()
+
+    def loss_seq(Wf, bf, x):
+        h = x
+        for c in range(C):
+            h = jnp.tanh(h @ Wf[c] + bf[c])
+        return (h ** 2).mean()
+
+    got = jax.grad(loss_pipe, argnums=(0, 1, 2))(
+        jnp.asarray(Ws).reshape(v, S, 8, 8),
+        jnp.asarray(bs).reshape(v, S, 8), xs)
+    ref = jax.grad(loss_seq, argnums=(0, 1, 2))(
+        jnp.asarray(Ws), jnp.asarray(bs), xs)
+    np.testing.assert_allclose(np.asarray(got[0]).reshape(C, 8, 8),
+                               np.asarray(ref[0]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got[1]).reshape(C, 8),
+                               np.asarray(ref[1]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got[2]), np.asarray(ref[2]),
+                               atol=1e-5)
+
+
+def test_pytree_boundary_activations(pp_mesh):
+    """Stage boundaries are pytrees — the reference's _p2p_helper
+    handshakes arbitrary tensor tuples; here the tuple rides the same
+    compiled ppermute (multi-stream models: residual + auxiliary)."""
+    S, v, M = 4, 1, 4
+    rng = np.random.RandomState(5)
+    Ws, _ = _mlp_chunks(rng, S)
+    params = {"W": jnp.asarray(Ws).reshape(v, S, 8, 8)}
+
+    def body(p, xy):
+        x, aux = xy
+        x2 = jnp.tanh(x @ p["W"])
+        return (x2, aux + x2.sum(-1))  # aux accumulates across stages
+
+    xs = jnp.asarray(rng.randn(M, 2, 8).astype(np.float32))
+    aux0 = jnp.zeros((M, 2), jnp.float32)
+    out, aux = fleet.pipeline_spmd(body, params, (xs, aux0), mesh=pp_mesh,
+                                   num_virtual_stages=v)
+    ref, ra = np.asarray(xs), np.asarray(aux0)
+    for c in range(S):
+        ref = np.tanh(ref @ Ws[c])
+        ra = ra + ref.sum(-1)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(aux), ra, atol=1e-4)
+
+
+def test_schedule_stats_match_list_scheduler():
+    """The compiled schedule's analytic accounting must agree with the
+    measured bubble of the host-scheduled engine at the same geometry
+    (S=4, v=2, M=4 -> 0.2727; v=1 -> (S-1)/(M+S-1))."""
+    st = fleet.spmd_schedule_stats(4, 2, 4)
+    assert abs(st["bubble_fraction"] - 0.2727) < 1e-4
+    st1 = fleet.spmd_schedule_stats(4, 1, 4)
+    assert abs(st1["bubble_fraction"] - 3 / 7) < 1e-3
+    # deeper interleave shrinks the bubble monotonically
+    bub = [fleet.spmd_schedule_stats(4, v, 8)["bubble_fraction"]
+           for v in (1, 2, 4)]
+    assert bub[0] > bub[1] > bub[2]
+
+
+def test_layer_engine_trains(pp_mesh):
+    rng = np.random.RandomState(0)
+    pt.seed(0)
+
+    def block():
+        return nn.Sequential(nn.Linear(8, 8), nn.Tanh())
+
+    pl = fleet.SpmdPipelineLayer(block, num_virtual_stages=2,
+                                 loss_fn=nn.MSELoss())
+    eng = fleet.SpmdPipelineParallel(pl, accumulate_steps=4)
+    o = opt.AdamW(learning_rate=3e-3, parameters=eng.parameters())
+    X = pt.to_tensor(rng.randn(8, 8).astype(np.float32))
+    Y = pt.to_tensor(rng.randn(8, 8).astype(np.float32) * 0.1)
+    losses = [float(eng.train_batch((X, Y), o).numpy()) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.9, losses[::5]
+    stats = eng.last_schedule_stats
+    assert stats["bubble_fraction"] == 0.2727
+    assert stats["n_chunks"] == 8
+
+
+def test_layer_parity_vs_eager_sequential(pp_mesh):
+    """The stacked-parameter pipeline Layer must produce the same outputs
+    and parameter gradients as eagerly running its chunks in order."""
+    rng = np.random.RandomState(1)
+    pt.seed(7)
+
+    def block():
+        return nn.Sequential(nn.Linear(8, 8), nn.Tanh())
+
+    pl = fleet.SpmdPipelineLayer(block, num_virtual_stages=2)
+    S, v = pl.num_stages, pl.num_virtual_stages
+    xs = pt.to_tensor(rng.randn(4, 2, 8).astype(np.float32),
+                      stop_gradient=False)
+    out = pl(xs)
+    loss = (out * out).mean()
+    loss.backward()
+
+    # eager oracle: apply chunks c = r*S + s in order with the same weights
+    W = pl._stacked()["0.weight"].numpy()  # [v, S, in, out]
+    b = pl._stacked()["0.bias"].numpy()
+    h = xs.numpy()
+    for c in range(S * v):
+        r, s = divmod(c, S)
+        h = np.tanh(h @ W[r, s] + b[r, s])
+    np.testing.assert_allclose(out.numpy(), h, atol=1e-5)
+    gW = pl._stacked()["0.weight"].grad
+    assert gW is not None and np.isfinite(gW.numpy()).all()
+    assert np.abs(gW.numpy()).max() > 0
+
+
+def test_train_step_integration(pp_mesh):
+    """Whole-step SPMD compile: TrainStep shards the stacked parameters
+    over pp via their _sharding_spec and the loss stays finite."""
+    pt.seed(2)
+    rng = np.random.RandomState(2)
+
+    def block():
+        return nn.Sequential(nn.Linear(8, 8), nn.Tanh())
+
+    pl = fleet.SpmdPipelineLayer(block, num_virtual_stages=1)
+    o = opt.AdamW(learning_rate=1e-3, parameters=pl.parameters())
+    mse = nn.MSELoss()
+
+    def loss_fn(m, x, y):
+        out = m(x)
+        return mse(pt.reshape(out, [-1, 8]), y)
+
+    step = pt.jit.TrainStep(pl, loss_fn, o, mesh=pp_mesh)
+    Xm = pt.to_tensor(rng.randn(4, 2, 8).astype(np.float32))
+    Yf = pt.to_tensor(rng.randn(8, 8).astype(np.float32))
+    v1 = float(step(Xm, Yf).numpy())
+    v2 = float(step(Xm, Yf).numpy())
+    assert np.isfinite(v1) and np.isfinite(v2) and v2 < v1
+
+
+def test_stateless_block_required(pp_mesh):
+    with pytest.raises(ValueError, match="stateless"):
+        fleet.SpmdPipelineLayer(lambda: nn.BatchNorm1D(8))
+
+
+def test_loss_parity_spmd_vs_host_scheduled(pp_mesh):
+    """Both pipeline engines, same chunk weights, same batch -> same loss
+    (the VERDICT 'unchanged loss parity' criterion for the new path)."""
+    rng = np.random.RandomState(9)
+    S, v = 4, 2
+    pt.seed(11)
+
+    def block():
+        return nn.Sequential(nn.Linear(8, 8), nn.Tanh())
+
+    pl = fleet.SpmdPipelineLayer(block, num_virtual_stages=v,
+                                 loss_fn=nn.MSELoss())
+    # host-scheduled engine over layers rebuilt with the SAME weights,
+    # in chunk order c = r*S + s
+    W = pl._stacked()["0.weight"].numpy()
+    b = pl._stacked()["0.bias"].numpy()
+    descs = []
+    for c in range(S * v):
+        r, s = divmod(c, S)
+        lin = nn.Linear(8, 8)
+        lin.weight.set_value(W[r, s])
+        lin.bias.set_value(b[r, s])
+        descs += [lin, nn.Tanh()]
+    host = fleet.PipelineLayer(descs, num_stages=S,
+                               num_virtual_pipeline_stages=v,
+                               loss_fn=nn.MSELoss())
+    hostp = fleet.PipelineParallel(host, accumulate_steps=4)
+    spmd = fleet.SpmdPipelineParallel(pl, accumulate_steps=4)
+
+    X = rng.randn(8, 8).astype(np.float32)
+    Y = rng.randn(8, 8).astype(np.float32)
+    o1 = opt.SGD(learning_rate=0.0, parameters=spmd.parameters())
+    o2 = opt.SGD(learning_rate=0.0, parameters=hostp.parameters())
+    l1 = float(spmd.train_batch((pt.to_tensor(X), pt.to_tensor(Y)),
+                                o1).numpy())
+    l2 = float(hostp.train_batch((pt.to_tensor(X), pt.to_tensor(Y)),
+                                 o2).numpy())
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
