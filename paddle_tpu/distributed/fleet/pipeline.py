@@ -51,6 +51,30 @@ __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
            "PipelineParallel"]
 
 
+def _tree_map(fn, x):
+    """Map ``fn`` over Tensor leaves of a (possibly nested) tuple/list
+    activation structure — the reference's ``_p2p_helper`` handshakes
+    arbitrary tensor tuples between stages (p2p_communication.py:298)."""
+    if isinstance(x, (tuple, list)):
+        return type(x)(_tree_map(fn, t) for t in x)
+    return fn(x)
+
+
+def _tree_leaves(x) -> List:
+    if isinstance(x, (tuple, list)):
+        out = []
+        for t in x:
+            out.extend(_tree_leaves(t))
+        return out
+    return [x]
+
+
+def _call_layer(layer, x):
+    """Reference PipelineLayer forward convention: tuple activations
+    unpack as positional args; a single tensor passes directly."""
+    return layer(*x) if isinstance(x, (tuple, list)) else layer(x)
+
+
 class LayerDesc:
     """Lazy layer constructor (reference: pp_layers.py:57) so stages only
     materialize where placed."""
@@ -96,9 +120,10 @@ class _RecomputeGroup(Layer):
         from paddle_tpu.nn.containers import LayerList
         self.seq = LayerList(layers)
 
-    def forward(self, x):
+    def forward(self, *xs):
+        x = xs if len(xs) > 1 else xs[0]
         for l in self.seq:
-            x = l(x)
+            x = _call_layer(l, x)
         return x
 
 
@@ -167,7 +192,7 @@ class PipelineLayer(Layer):
                 f"{len(built)} layers cannot fill {self.num_chunks} chunks "
                 f"({self.num_stages} stages x {self.num_virtual_stages} "
                 "virtual)")
-        bounds = self._segment(len(built), self.num_chunks, seg_method)
+        bounds = self._segment(built, self.num_chunks, seg_method)
         self._chunk_layers: List[List[Layer]] = []
         from paddle_tpu.nn.containers import LayerList
         all_list = LayerList()
@@ -190,15 +215,72 @@ class PipelineLayer(Layer):
         self._place_params()
 
     @staticmethod
-    def _segment(n_layers: int, n_stages: int, method: str) -> List[int]:
-        if method != "uniform":
-            raise NotImplementedError(
-                f"seg_method {method!r}; only 'uniform' is implemented")
-        base, rem = divmod(n_layers, n_stages)
-        bounds = [0]
-        for s in range(n_stages):
-            bounds.append(bounds[-1] + base + (1 if s < rem else 0))
-        return bounds
+    def _segment(built: List[Layer], n_stages: int,
+                 method: str) -> List[int]:
+        """Chunk boundaries over the built layer list.
+
+        ``"uniform"``       — equal layer counts (reference default).
+        ``"layer:REGEX"``   — layers whose class name matches REGEX
+                              (case-insensitive search) weigh 1, others 0;
+                              each chunk gets an equal share of matches,
+                              boundaries fall after each share (reference
+                              SegmentLayers.do_segment, pp_layers.py:112).
+        ``"uniform_params"`` — parameter-count-weighted balance: chunk
+                              boundaries minimize the spread of summed
+                              parameter counts (greenfield: unbalanced
+                              stacks — embedding-heavy stage 0 — otherwise
+                              eat the bubble the interleave removed).
+        """
+        n_layers = len(built)
+        if method == "uniform":
+            base, rem = divmod(n_layers, n_stages)
+            bounds = [0]
+            for s in range(n_stages):
+                bounds.append(bounds[-1] + base + (1 if s < rem else 0))
+            return bounds
+        if method.startswith("layer:"):
+            import re
+            pat = re.compile(method.split(":", 1)[1], re.IGNORECASE)
+            weights = [1 if pat.search(type(l).__name__) else 0
+                       for l in built]
+            total = sum(weights)
+            if total == 0:
+                raise ValueError(
+                    f"seg_method {method!r} matched no layer "
+                    f"({sorted({type(l).__name__ for l in built})})")
+            if total % n_stages:
+                raise ValueError(
+                    f"{total} layers matching {method!r} cannot split "
+                    f"evenly into {n_stages} chunks")
+            share = total // n_stages
+            bounds, acc = [0], 0
+            for idx, wgt in enumerate(weights):
+                acc += wgt
+                if acc == share and len(bounds) < n_stages:
+                    bounds.append(idx + 1)
+                    acc = 0
+            bounds.append(n_layers)
+            return bounds
+        if method == "uniform_params":
+            # weight each layer by its parameter count (min 1 so
+            # parameter-free activations still advance the cursor), then
+            # cut at the ideal cumulative fractions
+            weights = [max(sum(int(np.prod(p.shape))
+                               for p in l.parameters()), 1)
+                       for l in built]
+            csum = np.cumsum(weights, dtype=np.float64)
+            total = float(csum[-1])
+            bounds = [0]
+            for j in range(1, n_stages):
+                pos = int(np.searchsorted(csum, total * j / n_stages)) + 1
+                lo = bounds[-1] + 1              # every chunk >= 1 layer
+                hi = n_layers - (n_stages - j)   # leave room for the rest
+                bounds.append(min(max(pos, lo), hi))
+            bounds.append(n_layers)
+            return bounds
+        raise NotImplementedError(
+            f"seg_method {method!r}; use 'uniform', 'layer:REGEX', or "
+            "'uniform_params'")
 
     # chunk c lives on stage c % S (round-robin interleave placement)
     def chunk_stage(self, c: int) -> int:
@@ -240,20 +322,23 @@ class PipelineLayer(Layer):
         recompute, so only the run boundaries stay live on the tape."""
         if self.recompute_interval <= 0 or not self.training:
             for layer in self._chunk_layers[c]:
-                x = layer(x)
+                x = _call_layer(layer, x)
             return x
         from .utils import recompute
         for group in self.__dict__["_recompute_groups"][c]:
-            x = recompute(group, x)
+            x = recompute(group, *x) if isinstance(x, (tuple, list)) \
+                else recompute(group, x)
         return x
 
     def forward(self, x):
         """Non-pipelined sequential run (debug/eval parity path)."""
         import jax
         for c in range(self.num_chunks):
-            if isinstance(x, Tensor):
-                x = Tensor(jax.device_put(x.data, self.chunk_device(c)),
-                           stop_gradient=x.stop_gradient)
+            x = _tree_map(
+                lambda t: Tensor(jax.device_put(t.data,
+                                                self.chunk_device(c)),
+                                 stop_gradient=t.stop_gradient)
+                if isinstance(t, Tensor) else t, x)
             x = self.chunk_forward(c, x)
         return x
 
@@ -487,28 +572,36 @@ class PipelineParallel(Layer):
         n_micro = self.accumulate_steps
         L = self._layers
         C = L.num_chunks
-        micro_x = ops.split(inputs, n_micro, axis=0)
+        if isinstance(inputs, (tuple, list)):  # multi-stream model inputs
+            parts = [ops.split(t, n_micro, axis=0) for t in inputs]
+            micro_x = [tuple(p[m] for p in parts) for m in range(n_micro)]
+        else:
+            micro_x = ops.split(inputs, n_micro, axis=0)
         micro_y = ops.split(labels, n_micro, axis=0)
 
         # saved per-(micro, chunk) forward results to drive backward in
-        # schedule order; activations hop stages via device_put
-        fwd_out = {}  # (m, c) -> (output Tensor, input Tensor)
+        # schedule order; activation PYTREES hop stages leaf-by-leaf via
+        # device_put (the reference's tuple p2p handshake)
+        fwd_out = {}  # (m, c) -> (output tree, input tree)
         losses = []
-        grads_ready = {}  # m -> cotangent flowing into chunk c during bwd
+        grads_ready = {}  # m -> cotangent tree flowing into chunk c
         peak_in_flight = [0]
+
+        def to_stage(tree, c, stop_gradient):
+            return _tree_map(
+                lambda t: Tensor(jax.device_put(t.data, L.chunk_device(c)),
+                                 stop_gradient=stop_gradient)
+                if isinstance(t, Tensor) else t, tree)
 
         def run_fwd(m, c):
             x = fwd_out[(m, c - 1)][0] if c > 0 else micro_x[m]
-            x = Tensor(jax.device_put(x.data, L.chunk_device(c)),
-                       stop_gradient=False)
+            x = to_stage(x, c, stop_gradient=False)
             with RecordEvent(f"pp_fwd_m{m}_c{c}"):
                 out = L.chunk_forward(c, x)
             fwd_out[(m, c)] = (out, x)
             peak_in_flight[0] = max(peak_in_flight[0], len(fwd_out))
             if c == C - 1:
-                y = Tensor(jax.device_put(micro_y[m].data,
-                                          L.chunk_device(c)),
-                           stop_gradient=True)
+                y = to_stage(micro_y[m], c, stop_gradient=True)
                 with RecordEvent(f"pp_loss_m{m}"):
                     loss = self._loss_fn(out, y)
                 losses.append(loss)
@@ -521,14 +614,31 @@ class PipelineParallel(Layer):
                     # scale for mean over micro-batches
                     out.backward(Tensor(np.float32(1.0 / n_micro)))
                 else:
-                    out.backward(grads_ready.pop(m))
+                    from paddle_tpu.core.autograd import backward as \
+                        tape_backward
+                    roots, cots = [], []
+                    for o, g in zip(_tree_leaves(out),
+                                    _tree_leaves(grads_ready.pop(m))):
+                        if isinstance(o, Tensor) and not o.stop_gradient:
+                            roots.append(o)
+                            cots.append(g)
+                    tape_backward(roots, cots)
             if c > 0:
-                g = x_in.grad
-                grads_ready[m] = Tensor(
-                    jax.device_put(g.data, L.chunk_device(c - 1)),
-                    stop_gradient=True)
-            # x_in is a non-leaf boundary tensor: drop its grad storage
-            x_in.grad = None
+                def hop_grad(t):
+                    if not isinstance(t, Tensor):
+                        return t
+                    g = t.grad
+                    if g is None:  # leaf unused by this chunk: zero cot
+                        import jax.numpy as jnp
+                        g = Tensor(jnp.zeros(t.shape, t.data.dtype))
+                    return Tensor(
+                        jax.device_put(g.data, L.chunk_device(c - 1)),
+                        stop_gradient=True)
+
+                grads_ready[m] = _tree_map(hop_grad, x_in)
+            # boundary tensors are non-leaves: drop their grad storage
+            _tree_map(lambda t: setattr(t, "grad", None) or t
+                      if isinstance(t, Tensor) else t, x_in)
 
         if n_micro not in self._schedule_cache:
             self._schedule_cache[n_micro] = self._build_schedule(n_micro)

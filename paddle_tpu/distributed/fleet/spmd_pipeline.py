@@ -344,6 +344,9 @@ class SpmdPipelineParallel(Layer):
         inputs, labels = data
         M = self.accumulate_steps
         B = inputs.shape[0]
+        if B % M:
+            raise ValueError(
+                f"batch {B} not divisible by accumulate_steps {M}")
         micro_x = ops.reshape(inputs, [M, B // M] + list(inputs.shape[1:]))
         out = self._layers(micro_x)
         merged = ops.reshape(out, [B] + list(out.shape[2:]))

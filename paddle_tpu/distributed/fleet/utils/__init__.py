@@ -42,12 +42,12 @@ def pvary_compat(x, axis):
     except (AttributeError, TypeError):
         pass
     try:
-        return jax.lax.pcast(x, to="varying")
+        return jax.lax.pcast(x, axis, to="varying")
     except (AttributeError, TypeError):
-        try:
-            return jax.lax.pvary(x, axis)
-        except (AttributeError, TypeError):
-            return x
+        # pre-pcast jax: the deprecated spelling. If neither exists, let
+        # the error surface — an invariant carry would only fail later
+        # with an opaque shard_map vma mismatch.
+        return jax.lax.pvary(x, axis)
 
 
 def _owning_layer(function) -> Layer | None:

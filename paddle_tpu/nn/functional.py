@@ -927,6 +927,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     # composite (the Pallas kernel streams the bias as a constant).
     mask_trainable = attn_mask is not None and \
         getattr(attn_mask, "stop_gradient", True) is False
+    if mask_trainable and attn_mask.dtype == jnp.bool_:
+        # a bool mask enters as a where() selector — structurally zero
+        # grad on every route; the caller asked for one, so fail loudly
+        raise ValueError(
+            "a boolean attn_mask cannot receive a gradient (it selects, "
+            "it is not added to the logits); pass a float additive mask "
+            "or set attn_mask.stop_gradient = True")
     s_q, s_k = query.shape[1], key.shape[1]
     causal_tagged = (
         attn_mask is not None

@@ -300,3 +300,143 @@ class TestRecomputeInterval:
             np.testing.assert_allclose(
                 params["on"][name].numpy(), params["off"][name].numpy(),
                 rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+class _SplitHead(nn.Layer):
+    """Emits a (main, aux) tuple — multi-stream boundary."""
+
+    def __init__(self, d):
+        super().__init__()
+        self.lin = nn.Linear(d, d)
+        self.aux = nn.Linear(d, d)
+
+    def forward(self, x):
+        return self.lin(x), self.aux(x)
+
+
+class _DualBlock(nn.Layer):
+    """Transforms both streams (takes tuple, returns tuple)."""
+
+    def __init__(self, d):
+        super().__init__()
+        self.a = nn.Linear(d, d)
+        self.b = nn.Linear(d, d)
+
+    def forward(self, x, aux):
+        return nn.functional.relu(self.a(x)), nn.functional.relu(
+            self.b(aux))
+
+
+class _MergeHead(nn.Layer):
+    """Merges the streams back to one tensor."""
+
+    def __init__(self, d):
+        super().__init__()
+        self.lin = nn.Linear(2 * d, d)
+
+    def forward(self, x, aux):
+        return self.lin(pt.concat([x, aux], axis=-1))
+
+
+class TestTupleActivations:
+    """Pytree activations across stage boundaries (reference _p2p_helper
+    handshakes arbitrary tensor tuples, p2p_communication.py:298):
+    encoder-decoder-style dual-stream pipeline parity."""
+
+    def _dual_layers(self):
+        return [_SplitHead(8), _DualBlock(8), _DualBlock(8), _MergeHead(8)]
+
+    def test_tuple_pipeline_loss_parity(self, mesh_pp4):
+        rng = np.random.RandomState(4)
+        X = rng.randn(8, 8).astype(np.float32)
+        Y = rng.randn(8, 8).astype(np.float32)
+        n_micro = 4
+
+        pt.seed(21)
+        plain_layers = self._dual_layers()
+        op = opt.AdamW(learning_rate=0.01,
+                       parameters=[p for l in plain_layers
+                                   for p in l.parameters()])
+        ref_losses = []
+        for step in range(4):
+            mb = []
+            for k in range(n_micro):
+                h = t(X[k * 2:(k + 1) * 2])
+                for i, l in enumerate(plain_layers):
+                    h = l(*h) if isinstance(h, tuple) else l(h)
+                loss = nn.MSELoss()(h, t(Y[k * 2:(k + 1) * 2]))
+                loss.backward(pt.to_tensor(np.float32(1.0 / n_micro)))
+                mb.append(float(loss.numpy()))
+            op.step()
+            op.clear_grad(set_to_zero=False)
+            ref_losses.append(np.mean(mb))
+
+        pt.seed(21)
+        pl = fleet.PipelineLayer(self._dual_layers(), num_stages=4,
+                                 loss_fn=nn.MSELoss())
+        pp = fleet.PipelineParallel(pl, accumulate_steps=n_micro)
+        opp = opt.AdamW(learning_rate=0.01, parameters=pp.parameters())
+        got = []
+        for step in range(4):
+            got.append(float(pp.train_batch((t(X), t(Y)), opp).numpy()))
+        np.testing.assert_allclose(got, ref_losses, rtol=1e-4, atol=1e-6)
+
+    def test_tuple_inputs_supported(self, mesh_pp4):
+        """Multi-tensor model INPUT: each element is micro-split."""
+        pl = fleet.PipelineLayer([_DualBlock(8), _DualBlock(8),
+                                  _DualBlock(8), _MergeHead(8)],
+                                 num_stages=4, loss_fn=nn.MSELoss())
+        pp = fleet.PipelineParallel(pl, accumulate_steps=2)
+        o = opt.SGD(learning_rate=0.01, parameters=pp.parameters())
+        rng = np.random.RandomState(0)
+        xa = t(rng.randn(4, 8)); xb = t(rng.randn(4, 8))
+        loss = pp.train_batch(((xa, xb), t(rng.randn(4, 8))), o)
+        assert np.isfinite(float(loss.numpy()))
+
+
+class TestSegmentation:
+    def test_layer_regex_segmentation(self, mesh_pp4):
+        """reference SegmentLayers 'layer:NAME': chunks get equal shares
+        of matching layers; boundaries fall after each share."""
+        descs = []
+        for _ in range(8):
+            descs += [fleet.LayerDesc(nn.Linear, 8, 8),
+                      fleet.LayerDesc(nn.ReLU)]
+        pl = fleet.PipelineLayer(descs, num_stages=4,
+                                 seg_method="layer:Linear")
+        sizes = [len(seg) for seg in pl._stage_layers]
+        # reference cut: right AFTER each share's last match (the 2nd
+        # Linear), so the trailing ReLUs ride with the NEXT chunk
+        assert sizes == [3, 4, 4, 5]
+        for seg in pl._stage_layers:
+            assert sum(1 for l in seg
+                       if type(l).__name__ == "Linear") == 2
+
+    def test_layer_regex_uneven_raises(self, mesh_pp4):
+        descs = [fleet.LayerDesc(nn.Linear, 8, 8) for _ in range(6)] + \
+            [fleet.LayerDesc(nn.ReLU), fleet.LayerDesc(nn.ReLU)]
+        with pytest.raises(ValueError, match="evenly"):
+            fleet.PipelineLayer(descs, num_stages=4,
+                                seg_method="layer:Linear")
+
+    def test_uniform_params_balances_unbalanced_stack(self, mesh_pp4):
+        """Embedding-heavy stage-0 stack: parameter-weighted segmentation
+        must NOT put the same layer count everywhere."""
+        descs = [fleet.LayerDesc(nn.Embedding, 1000, 64)] + \
+            [fleet.LayerDesc(nn.Linear, 64, 64) for _ in range(7)]
+        pl = fleet.PipelineLayer(descs, num_stages=4,
+                                 seg_method="uniform_params")
+        sizes = [len(seg) for seg in pl._stage_layers]
+        assert sum(sizes) == 8 and min(sizes) >= 1
+        # the embedding (64K params) dominates: stage 0 holds ONLY it,
+        # while uniform would have put 2 layers there
+        assert sizes[0] == 1
+        counts = [sum(int(np.prod(p.shape)) for l in seg
+                      for p in l.parameters())
+                  for seg in pl._stage_layers]
+        assert counts[0] >= max(counts[1:])
+
+    def test_unknown_seg_method_raises(self, mesh_pp4):
+        with pytest.raises(NotImplementedError):
+            fleet.PipelineLayer(_descs(), num_stages=4,
+                                seg_method="cost_model")
