@@ -185,7 +185,8 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
             "reverse-mode) and a loop var or a tensor/layer captured by "
             "cond_fn/body_fn requires grad — its gradient would silently "
             "be zero. Detach the inputs or wrap the call in no_grad(), "
-            "and use a bounded scan for trainable loops")
+            "or use static.nn.bounded_while_loop(cond, body, vars, "
+            "max_iters) which IS differentiable")
 
     def f(*vars_):
         def c(vs):
@@ -207,26 +208,223 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     return list(out) if isinstance(out, tuple) else [out]
 
 
+def _closure_tensors(*fns):
+    """Trainable tensors captured by ``fns``'s closures / bound self —
+    parameters of captured Layers and bare Tensors. Ordered, deduped."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.nn.layer_base import Layer
+
+    out, seen = [], set()
+
+    def add(t):
+        if isinstance(t, Tensor) and not t.stop_gradient \
+                and id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+
+    visited = set()
+
+    def scan(obj):
+        if obj is None or id(obj) in visited:
+            return
+        visited.add(id(obj))
+        if isinstance(obj, Layer):
+            for p in obj.parameters():
+                add(p)
+        elif isinstance(obj, Tensor):
+            add(obj)
+        elif callable(obj):
+            # recurse into helper functions the closure captures (the
+            # `body = lambda h: layer(h)` indirection) — their cells may
+            # hold the trainable layer
+            scan(getattr(obj, "__self__", None))
+            for cell in getattr(obj, "__closure__", None) or ():
+                try:
+                    scan(cell.cell_contents)
+                except ValueError:
+                    pass
+
+    for fn in fns:
+        scan(fn)
+    return out
+
+
+def bounded_while_loop(cond_fn, body_fn, loop_vars, max_iters: int,
+                       name=None):
+    """TRAINABLE data-dependent loop with a static iteration bound.
+
+    Runs ``body_fn`` while ``cond_fn`` holds, at most ``max_iters`` times;
+    iterations after the condition first fails are masked no-ops (the loop
+    vars pass through unchanged), so the whole loop is a fixed-length
+    ``lax.scan`` and **gradients flow** — through the loop vars AND through
+    parameters/tensors captured by the closures (threaded as taped
+    operands, so eager ``backward`` differentiates them too). This is the
+    TPU answer to the reference's differentiable while
+    (``paddle/fluid/operators/controlflow/while_op.cc:349`` WhileGradOp +
+    append_backward's block construction): XLA cannot reverse an unbounded
+    ``while``, but a bounded masked scan reverses exactly, and dynamic-halt
+    models (loop-until-converged, adaptive computation time) are bounded in
+    practice.
+
+    If the condition still holds after ``max_iters`` iterations the loop
+    truncates there (the remaining iterations are simply not run) — pick
+    the bound accordingly. ``static.nn.while_loop`` stays the
+    forward-only unbounded alternative.
+    """
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.autograd import apply_op, no_grad
+    from paddle_tpu.core.tensor import Tensor
+
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    if max_iters <= 0:
+        return list(loop_vars)
+    n_vars = len(loop_vars)
+    captured = _closure_tensors(cond_fn, body_fn)
+
+    def f(*arrays):
+        var_arrays = arrays[:n_vars]
+        cap_arrays = arrays[n_vars:]
+        saved = [t._data for t in captured]
+        for t, a in zip(captured, cap_arrays):
+            t._data = a  # closures see the traced values -> grads flow
+        try:
+            def eval_cond(vs):
+                with no_grad():
+                    out = cond_fn(*[Tensor(v) for v in vs])
+                out = out.data if isinstance(out, Tensor) else out
+                return jnp.reshape(out, ()).astype(bool)
+
+            def step(carry, _):
+                vs, act = carry
+                with no_grad():
+                    new = body_fn(*[Tensor(v) for v in vs])
+                if not isinstance(new, (list, tuple)):
+                    new = (new,)
+                new_arrays = [o.data if isinstance(o, Tensor)
+                              else jnp.asarray(o) for o in new]
+                vs_next = tuple(
+                    jnp.where(act, nv, v)
+                    for nv, v in zip(new_arrays, vs))
+                return (vs_next, act & eval_cond(vs_next)), None
+
+            (final, _), _ = jax.lax.scan(
+                step, (tuple(var_arrays), eval_cond(var_arrays)), None,
+                length=int(max_iters))
+            return final
+        finally:
+            for t, a in zip(captured, saved):
+                t._data = a
+
+    out = apply_op(f, *loop_vars, *captured,
+                   op_name="bounded_while_loop")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def _switch_over(fns, pos_of, operand_tensors, op_name):
+    """Shared ``lax.switch`` lowering: trace every branch ONCE (flat — a
+    50-branch switch compiles one switch, not 50 nested conds), verify the
+    branches return the same python structure, dispatch on the traced
+    position computed by ``pos_of`` from the operand arrays."""
+    import jax
+    from paddle_tpu.core.autograd import apply_op, no_grad
+
+    struct = {}
+
+    def f(*arrays):
+        def mk(fn, tag):
+            def run(_):
+                with no_grad():  # one tape node for the whole switch
+                    out = fn()
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    _unwrap_tree(out))
+                struct[tag] = treedef
+                return tuple(leaves)
+            return run
+
+        pos = pos_of(arrays)
+        return jax.lax.switch(pos, [mk(fn, j) for j, fn in enumerate(fns)],
+                              None)
+
+    out = apply_op(f, *operand_tensors, op_name=op_name)
+    first = struct[0]
+    for tag, td in struct.items():
+        if td != first:
+            raise ValueError(
+                f"{op_name} branches returned different structures: "
+                f"branch 0 {first}, branch {tag} {td}")
+    leaves = list(out) if isinstance(out, (tuple, list)) else [out]
+    return jax.tree_util.tree_unflatten(first, leaves)
+
+
 def case(pred_fn_pairs, default=None, name=None):
-    """Reference: control_flow.py ``case`` — first true pred wins."""
+    """Reference: control_flow.py ``case`` — first true pred wins; with no
+    ``default`` the last fn runs when nothing matches. Lowers to ONE
+    ``lax.switch`` over argmax(preds + [True]) (argmax returns the FIRST
+    maximum, i.e. the first true pred)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+
     if not pred_fn_pairs:
         raise ValueError("pred_fn_pairs must be non-empty")
-    pred, fn = pred_fn_pairs[0]
-    rest = pred_fn_pairs[1:]
-    if not rest:
-        if default is None:
-            return cond(pred, fn, fn)
-        return cond(pred, fn, default)
-    return cond(pred, fn, lambda: case(rest, default))
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [fn for _, fn in pred_fn_pairs]
+    pred_arrays = [p.data if isinstance(p, Tensor) else p for p in preds]
+    if not any(isinstance(p, jax.core.Tracer) for p in pred_arrays):
+        # concrete preds: dygraph semantics — run the taken branch on tape
+        for p, fn in zip(pred_arrays, fns):
+            if bool(jnp.reshape(p, ())):
+                return fn()
+        return (default or fns[-1])()
+
+    fns_all = fns + [default or fns[-1]]
+
+    def pos_of(arrays):
+        flags = jnp.stack([jnp.reshape(a, ()).astype(bool)
+                           for a in arrays] + [jnp.asarray(True)])
+        return jnp.argmax(flags).astype(jnp.int32)
+
+    return _switch_over(fns_all, pos_of, preds, "case")
 
 
 def switch_case(branch_index, branch_fns, default=None, name=None):
-    """Reference: control_flow.py ``switch_case``."""
-    from paddle_tpu import ops
-    pairs = sorted(branch_fns.items() if isinstance(branch_fns, dict)
-                   else branch_fns)
-    preds = [(ops.equal(branch_index, i), fn) for i, fn in pairs]
-    return case(preds, default=default)
+    """Reference: control_flow.py ``switch_case`` — keyed dispatch; with no
+    ``default`` the MAX-index branch catches unmatched indices. ONE flat
+    ``lax.switch``."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = [(i, fn) if not isinstance(fn, (tuple, list)) else tuple(fn)
+                 for i, fn in enumerate(branch_fns)]
+        pairs = sorted(pairs)
+    keys = [int(k) for k, _ in pairs]
+    fns = [fn for _, fn in pairs]
+    idx_arr = branch_index.data if isinstance(branch_index, Tensor) \
+        else branch_index
+    if not isinstance(idx_arr, jax.core.Tracer):
+        i = int(jnp.reshape(idx_arr, ()))
+        fn = dict(zip(keys, fns)).get(i)
+        if fn is None:
+            fn = default or fns[-1]  # max key (sorted) is the fallback
+        return fn()
+
+    fns_all = fns + [default or fns[-1]]
+    karr = jnp.asarray(keys, jnp.int32)
+
+    def pos_of(arrays):
+        i = jnp.reshape(arrays[0], ()).astype(jnp.int32)
+        match = i == karr
+        return jnp.where(jnp.any(match), jnp.argmax(match),
+                         len(keys)).astype(jnp.int32)
+
+    return _switch_over(fns_all, pos_of, [branch_index], "switch_case")
 
 
-__all__ += ["cond", "while_loop", "case", "switch_case"]
+__all__ += ["cond", "while_loop", "bounded_while_loop", "case",
+            "switch_case"]

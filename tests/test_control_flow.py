@@ -213,3 +213,194 @@ class TestReviewRegressions:
         y0 = t([1.0, 1.0])  # loop var itself detached
         with pytest.raises(ValueError, match="forward-only"):
             snn.while_loop(lambda y: y.sum() < 10, lambda y: fc(y), [y0])
+
+
+class TestBoundedWhileLoop:
+    """static.nn.bounded_while_loop: the DIFFERENTIABLE bounded loop
+    (reference capability: while_op.cc:349 WhileGradOp — paddle trains
+    through while loops; here a masked lax.scan reverses exactly)."""
+
+    def test_newton_sqrt_grads_match_eager_oracle(self):
+        import paddle_tpu as pt
+        from paddle_tpu import static
+
+        def run(use_bounded):
+            a = pt.to_tensor(np.float32(2.0), stop_gradient=False)
+            x = pt.to_tensor(np.float32(1.5), stop_gradient=False)
+
+            def cond_fn(xv):
+                return pt.abs(xv * xv - a) > 1e-4
+
+            def body_fn(xv):
+                return xv - (xv * xv - a) / (2.0 * xv)
+
+            if use_bounded:
+                (out,) = static.nn.bounded_while_loop(
+                    cond_fn, body_fn, [x], max_iters=25)
+            else:
+                out = x
+                while bool(cond_fn(out).numpy()):
+                    out = body_fn(out)
+            out.backward()
+            return float(out.numpy()), float(a.grad.numpy()), \
+                float(x.grad.numpy())
+
+        got_val, got_ga, got_gx = run(True)
+        ref_val, ref_ga, ref_gx = run(False)
+        np.testing.assert_allclose(got_val, ref_val, rtol=1e-6)
+        np.testing.assert_allclose(got_ga, ref_ga, rtol=1e-5)
+        # d sqrt(a)/da = 1/(2 sqrt(a))
+        np.testing.assert_allclose(got_ga, 1 / (2 * np.sqrt(2.0)),
+                                   rtol=1e-3)
+        np.testing.assert_allclose(got_gx, ref_gx, atol=1e-6)
+
+    def test_loop_until_converged_model_trains(self):
+        """A fixed-point ('deep equilibrium'-style) block: iterate h until
+        the update is small, train the captured Layer through the loop —
+        the model the forward-only while_loop rejects."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu import static
+
+        rng = np.random.RandomState(0)
+        pt.seed(0)
+        lin = nn.Linear(4, 4)
+        X = pt.to_tensor(rng.randn(8, 4).astype(np.float32))
+        Y = pt.to_tensor((rng.randn(8, 4) * 0.3).astype(np.float32))
+        o = opt.Adam(learning_rate=3e-2, parameters=lin.parameters())
+
+        def fixed_point(x):
+            h0 = pt.zeros_like(x)
+            d0 = pt.to_tensor(np.float32(1.0))
+
+            def cond_fn(h, d):
+                return d > 1e-3
+
+            def body_fn(h, d):
+                h2 = 0.5 * h + 0.5 * pt.tanh(lin(h) + x)
+                return [h2, pt.max(pt.abs(h2 - h))]
+
+            h, _ = static.nn.bounded_while_loop(cond_fn, body_fn,
+                                                [h0, d0], max_iters=40)
+            return h
+
+        losses = []
+        for _ in range(25):
+            loss = nn.MSELoss()(fixed_point(X), Y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, losses[::8]
+
+    def test_grads_match_eager_loop_through_layer(self):
+        """Parameter gradients through the bounded loop == eager Python
+        while loop (same trip count, masked iterations are exact
+        identity)."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+        from paddle_tpu import static
+
+        rng = np.random.RandomState(1)
+        pt.seed(3)
+        lin = nn.Linear(4, 4)
+        X = pt.to_tensor(rng.randn(2, 4).astype(np.float32))
+
+        def cond_fn(h, d):
+            return d > 1e-3
+
+        def body(h, x):
+            return 0.5 * h + 0.5 * pt.tanh(lin(h) + x)
+
+        h0 = pt.zeros_like(X)
+        d0 = pt.to_tensor(np.float32(1.0))
+        h, _ = static.nn.bounded_while_loop(
+            cond_fn, lambda h, d: [body(h, X),
+                                   pt.max(pt.abs(body(h, X) - h))],
+            [h0, d0], max_iters=50)
+        h.mean().backward()
+        got = lin.weight.grad.numpy().copy()
+        for p in lin.parameters():
+            p.grad = None
+
+        h = pt.zeros_like(X)
+        d = 1.0
+        while d > 1e-3:
+            h2 = body(h, X)
+            d = float(pt.max(pt.abs(h2 - h)).numpy())
+            h = h2
+        h.mean().backward()
+        ref = lin.weight.grad.numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+    def test_truncates_at_max_iters(self):
+        import paddle_tpu as pt
+        from paddle_tpu import static
+
+        i0 = pt.to_tensor(np.float32(0.0))
+        (out,) = static.nn.bounded_while_loop(
+            lambda i: i < 1e9, lambda i: i + 1.0, [i0], max_iters=7)
+        assert float(out.numpy()) == 7.0
+
+    def test_zero_iters_passthrough(self):
+        import paddle_tpu as pt
+        from paddle_tpu import static
+
+        x = pt.to_tensor(np.float32(3.0))
+        outs = static.nn.bounded_while_loop(
+            lambda v: v > 0, lambda v: v - 1, [x], max_iters=0)
+        assert float(outs[0].numpy()) == 3.0
+
+
+class TestFlatSwitch:
+    def test_switch_case_single_flat_switch_in_jaxpr(self):
+        """A 10-branch switch compiles ONE lax.switch (cond primitive with
+        11 branches), not a 10-deep nested cond chain."""
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as pt
+        from paddle_tpu import static
+
+        fns = {i: (lambda i=i: pt.to_tensor(np.float32(i)) * 2.0)
+               for i in range(10)}
+
+        def fn(idx):
+            return static.nn.switch_case(pt.Tensor(idx), fns).data
+
+        jaxpr = jax.make_jaxpr(fn)(jnp.asarray(3, jnp.int32))
+        conds = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "cond"]
+        assert len(conds) == 1, jaxpr
+        assert len(conds[0].params["branches"]) == 11  # 10 + default
+        # and it dispatches correctly
+        assert float(fn(jnp.asarray(4, jnp.int32))) == 8.0
+        # unmatched index, no default: max-key branch (reference契约)
+        assert float(fn(jnp.asarray(99, jnp.int32))) == 18.0
+
+    def test_case_first_true_wins_traced(self):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as pt
+        from paddle_tpu import static
+
+        def fn(x):
+            xt = pt.Tensor(x)
+            return static.nn.case([
+                (xt > 2.0, lambda: pt.to_tensor(np.float32(10.0))),
+                (xt > 1.0, lambda: pt.to_tensor(np.float32(20.0))),
+            ], default=lambda: pt.to_tensor(np.float32(30.0))).data
+
+        assert float(fn(jnp.asarray(5.0))) == 10.0
+        assert float(fn(jnp.asarray(1.5))) == 20.0
+        assert float(fn(jnp.asarray(0.5))) == 30.0
+
+    def test_switch_case_default_called_for_unmatched(self):
+        import paddle_tpu as pt
+        from paddle_tpu import static
+
+        out = static.nn.switch_case(
+            pt.to_tensor(np.int32(7)),
+            {1: lambda: pt.to_tensor(np.float32(1.0)),
+             2: lambda: pt.to_tensor(np.float32(2.0))},
+            default=lambda: pt.to_tensor(np.float32(-1.0)))
+        assert float(out.numpy()) == -1.0
