@@ -76,26 +76,11 @@ def _unwrap_tree(x):
 
 
 def _closure_requires_grad(fn) -> bool:
-    """Best-effort scan of ``fn``'s closure cells and bound self for
-    tensors/layers that require grad (globals are out of scope — the
-    docstrings state the forward-only contract)."""
-    from paddle_tpu.core.tensor import Tensor
-    from paddle_tpu.nn.layer_base import Layer
-
-    def needs(obj):
-        if isinstance(obj, Tensor):
-            return not obj.stop_gradient
-        if isinstance(obj, Layer):
-            return any(not p.stop_gradient for p in obj.parameters())
-        return False
-
-    seen = [getattr(fn, "__self__", None)]
-    for cell in getattr(fn, "__closure__", None) or ():
-        try:
-            seen.append(cell.cell_contents)
-        except ValueError:
-            pass
-    return any(needs(o) for o in seen if o is not None)
+    """True if ``fn``'s closure (recursively, incl. helper callables and
+    containers) captures a trainable tensor/layer — same collector the
+    trainable ``bounded_while_loop`` uses, so the forward-only guard and
+    the differentiable path agree on what "captured" means."""
+    return bool(_closure_tensors(fn))
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None, operands=()):
@@ -233,6 +218,12 @@ def _closure_tensors(*fns):
                 add(p)
         elif isinstance(obj, Tensor):
             add(obj)
+        elif isinstance(obj, (list, tuple, set)):
+            for item in obj:  # layers held in a plain container
+                scan(item)
+        elif isinstance(obj, dict):
+            for item in obj.values():
+                scan(item)
         elif callable(obj):
             # recurse into helper functions the closure captures (the
             # `body = lambda h: layer(h)` indirection) — their cells may
@@ -302,6 +293,10 @@ def bounded_while_loop(cond_fn, body_fn, loop_vars, max_iters: int,
                     new = body_fn(*[Tensor(v) for v in vs])
                 if not isinstance(new, (list, tuple)):
                     new = (new,)
+                if len(new) != n_vars:
+                    raise ValueError(
+                        f"body_fn returned {len(new)} values for "
+                        f"{n_vars} loop vars")
                 new_arrays = [o.data if isinstance(o, Tensor)
                               else jnp.asarray(o) for o in new]
                 vs_next = tuple(
@@ -379,12 +374,16 @@ def case(pred_fn_pairs, default=None, name=None):
                 return fn()
         return (default or fns[-1])()
 
-    fns_all = fns + [default or fns[-1]]
+    # no default: the last fn doubles as the fallback WITHOUT being traced
+    # twice — the no-match position simply points at it
+    fns_all = fns + ([default] if default is not None else [])
+    fallback = len(fns_all) - 1
 
     def pos_of(arrays):
         flags = jnp.stack([jnp.reshape(a, ()).astype(bool)
-                           for a in arrays] + [jnp.asarray(True)])
-        return jnp.argmax(flags).astype(jnp.int32)
+                           for a in arrays])
+        return jnp.where(jnp.any(flags), jnp.argmax(flags),
+                         fallback).astype(jnp.int32)
 
     return _switch_over(fns_all, pos_of, preds, "case")
 
@@ -414,14 +413,15 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
             fn = default or fns[-1]  # max key (sorted) is the fallback
         return fn()
 
-    fns_all = fns + [default or fns[-1]]
+    fns_all = fns + ([default] if default is not None else [])
+    fallback = len(fns_all) - 1  # explicit default, or the max-key branch
     karr = jnp.asarray(keys, jnp.int32)
 
     def pos_of(arrays):
         i = jnp.reshape(arrays[0], ()).astype(jnp.int32)
         match = i == karr
         return jnp.where(jnp.any(match), jnp.argmax(match),
-                         len(keys)).astype(jnp.int32)
+                         fallback).astype(jnp.int32)
 
     return _switch_over(fns_all, pos_of, [branch_index], "switch_case")
 

@@ -371,7 +371,9 @@ class TestFlatSwitch:
         jaxpr = jax.make_jaxpr(fn)(jnp.asarray(3, jnp.int32))
         conds = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "cond"]
         assert len(conds) == 1, jaxpr
-        assert len(conds[0].params["branches"]) == 11  # 10 + default
+        # no explicit default: the max-key branch doubles as fallback
+        # WITHOUT being traced twice — exactly 10 branches
+        assert len(conds[0].params["branches"]) == 10
         # and it dispatches correctly
         assert float(fn(jnp.asarray(4, jnp.int32))) == 8.0
         # unmatched index, no default: max-key branch (reference契约)
@@ -404,3 +406,54 @@ class TestFlatSwitch:
              2: lambda: pt.to_tensor(np.float32(2.0))},
             default=lambda: pt.to_tensor(np.float32(-1.0)))
         assert float(out.numpy()) == -1.0
+
+
+class TestClosureCollection:
+    def test_layers_in_container_receive_grads(self):
+        """Layers captured inside a plain Python list must be collected
+        and differentiated (review regression)."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+        from paddle_tpu import static
+
+        pt.seed(5)
+        blocks = [nn.Linear(4, 4), nn.Linear(4, 4)]
+        x = pt.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+        h0 = pt.zeros_like(x)
+        d0 = pt.to_tensor(np.float32(1.0))
+
+        def body_fn(h, d):
+            h2 = 0.5 * h + 0.5 * pt.tanh(blocks[1](blocks[0](h)) + x)
+            return [h2, pt.max(pt.abs(h2 - h))]
+
+        h, _ = static.nn.bounded_while_loop(
+            lambda h, d: d > 1e-3, body_fn, [h0, d0], max_iters=40)
+        h.mean().backward()
+        for b in blocks:
+            assert b.weight.grad is not None
+            assert np.abs(b.weight.grad.numpy()).max() > 0
+
+    def test_while_loop_guard_sees_helper_indirection(self):
+        """The forward-only guard must catch a trainable layer reached
+        only through a helper lambda (review regression)."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+        from paddle_tpu import static
+
+        lin = nn.Linear(2, 2)
+        step = lambda h: lin(h)  # noqa: E731
+        h0 = pt.to_tensor(np.zeros((1, 2), np.float32))
+        with pytest.raises(ValueError, match="forward-only"):
+            static.nn.while_loop(
+                lambda h: pt.max(pt.abs(h)) < 10.0,
+                lambda h: step(h), [h0])
+
+    def test_body_arity_mismatch_raises(self):
+        import paddle_tpu as pt
+        from paddle_tpu import static
+
+        h0 = pt.to_tensor(np.float32(0.0))
+        with pytest.raises(ValueError, match="loop vars"):
+            static.nn.bounded_while_loop(
+                lambda h: h < 5, lambda h: [h + 1, h], [h0], max_iters=3)
