@@ -46,16 +46,32 @@ class ReduceOp:
 
 
 class Group:
-    """A communicator = a tuple of mesh axis names (the reference's
-    ProcessGroup/ring-id, reduced to its essence on a mesh)."""
+    """A communicator. Two flavors, matching the two planes the reference's
+    ProcessGroup serves:
+
+    - **device groups**: a tuple of mesh axis names — XLA collectives
+      inside shard_map regions (the ring-id, reduced to its essence).
+    - **host groups**: an explicit list of global host-process ``ranks`` —
+      the store-backed OBJECT collectives address processes directly, so
+      arbitrary rank subsets are representable there (and only there).
+    """
 
     _registry = {}
     _next_id = 0
 
-    def __init__(self, axes: Union[str, Sequence[str]], mesh=None):
+    def __init__(self, axes: Union[str, Sequence[str]], mesh=None,
+                 ranks: Optional[Sequence[int]] = None):
         self.axes: Tuple[str, ...] = (axes,) if isinstance(axes, str) \
             else tuple(axes)
         self._mesh = mesh
+        if ranks is not None:
+            ranks = tuple(int(r) for r in ranks)
+            if len(set(ranks)) != len(ranks):
+                raise ValueError(f"duplicate ranks in group: {ranks}")
+        # USER order is the group-rank order (reference new_group
+        # semantics): scatter payload gi goes to ranks[gi], gathers return
+        # in this order — never silently sorted
+        self.ranks: Optional[Tuple[int, ...]] = ranks
 
     @property
     def mesh(self):
@@ -63,6 +79,8 @@ class Group:
 
     @property
     def nranks(self) -> int:
+        if self.ranks is not None:
+            return len(self.ranks)
         m = self.mesh
         if m is None:
             return 1
@@ -77,16 +95,24 @@ class Group:
 
 
 def new_group(ranks=None, axes=None, mesh=None) -> Group:
-    """Create a communicator. On a mesh, groups are axis-aligned: pass
-    ``axes``; the reference's arbitrary rank lists have no XLA analog and
-    raise (paddle LLM recipes only ever build axis-aligned groups)."""
+    """Create a communicator. On a mesh, DEVICE groups are axis-aligned:
+    pass ``axes``. An explicit ``ranks`` subset builds a HOST group —
+    usable by the store-backed object collectives (which address host
+    processes directly); arbitrary rank lists still have no XLA analog, so
+    a host group inside a shard_map region raises."""
     if axes is None:
         m = mesh or get_mesh()
-        if ranks is not None and m is not None and \
-                len(ranks) != int(np.prod(list(m.shape.values()))):
-            raise NotImplementedError(
-                "arbitrary-rank groups are not representable as mesh axes; "
-                "pass axes=('dp',) etc.")
+        full = int(np.prod(list(m.shape.values()))) if m is not None \
+            else None
+        if ranks is not None and (m is None or len(ranks) != full):
+            # proper subset (or no mesh): host-rank group for the object-
+            # collective plane
+            g = Group((), mesh, ranks=ranks)
+            gid = Group._next_id
+            Group._next_id += 1
+            Group._registry[gid] = g
+            g.id = gid
+            return g
         axes = tuple(m.axis_names) if m is not None else ("dp",)
     g = Group(axes, mesh)
     gid = Group._next_id
@@ -115,6 +141,11 @@ def _axes(group) -> Tuple[str, ...]:
         m = get_mesh()
         return tuple(m.axis_names) if m is not None else ()
     if isinstance(group, Group):
+        if group.ranks is not None:
+            raise RuntimeError(
+                "host-rank groups (new_group(ranks=[...])) serve the "
+                "store-backed OBJECT collectives; device collectives need "
+                "an axis-aligned group (new_group(axes=('dp',)))")
         return group.axes
     if isinstance(group, str):
         return (group,)
@@ -195,7 +226,7 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
     return result
 
 
-_obj_seq = {"ag": 0, "bc": 0, "sc": 0}
+_obj_seq: dict = {}  # (kind, group-tag) -> per-call sequence counter
 
 
 def _multi_host_world():
@@ -214,14 +245,28 @@ def _multi_host_world():
             int(world) if world is not None else jax.process_count())
 
 
-def _check_default_group(group, what: str):
-    """The store-backed object collectives address ranks by global host
-    rank; a subgroup would wait forever on non-member slots."""
-    if group is not None and getattr(group, "nranks", None) not in (
-            None, _multi_host_world()[1]):
+def _group_members(group, what: str):
+    """(member ranks, my global rank, store tag) for an object collective.
+
+    ``group=None`` → the full world. A host-rank group
+    (``new_group(ranks=[...])``) scopes the collective to its members —
+    store keys are namespaced by the member tuple so concurrent groups
+    never collide. Axis (device) groups are rejected: they partition
+    chips, not host processes."""
+    rank, world = _multi_host_world()
+    if group is None:
+        return tuple(range(world)), rank, "w"
+    ranks = getattr(group, "ranks", None)
+    if ranks is None:
+        if getattr(group, "nranks", None) in (None, world):
+            return tuple(range(world)), rank, "w"
         raise NotImplementedError(
-            f"multi-process {what} supports only the default (world) "
-            "group; subgroup object collectives are not implemented")
+            f"{what}: device (axis) groups do not scope host-object "
+            "collectives; build a host group with new_group(ranks=[...])")
+    bad = [r for r in ranks if not 0 <= r < world]
+    if bad:
+        raise ValueError(f"{what}: ranks {bad} outside world {world}")
+    return ranks, rank, "-".join(map(str, ranks))
 
 
 def _reaped_barrier(store, name: str, world: int):
@@ -236,15 +281,17 @@ def _reaped_barrier(store, name: str, world: int):
         store.delete_prefix(f"__barrier/{epoch}/{name}")
 
 
-def _obj_key(kind: str) -> str:
-    """Unique per-call store namespace. All processes issue collectives in
-    the same program order, so a per-process counter is consistent; the
-    elastic restart epoch prevents reuse across relaunches."""
+def _obj_key(kind: str, tag: str = "w") -> str:
+    """Unique per-call store namespace. All MEMBER processes issue a
+    group's collectives in the same program order, so a per-(kind, group)
+    counter is consistent; the member-tuple tag keeps concurrent groups
+    apart and the elastic restart epoch prevents reuse across
+    relaunches."""
     import os
     epoch = os.environ.get("PADDLE_RESTART_EPOCH", "0")
-    seq = _obj_seq[kind]
-    _obj_seq[kind] += 1
-    return f"__objcol/{epoch}/{kind}{seq}"
+    seq = _obj_seq.get((kind, tag), 0)
+    _obj_seq[(kind, tag)] = seq + 1
+    return f"__objcol/{epoch}/{tag}/{kind}{seq}"
 
 
 def all_gather_object(object_list, obj, group=None):
@@ -254,19 +301,20 @@ def all_gather_object(object_list, obj, group=None):
     others — the store-backed control plane the reference implements over
     its gloo/TCP store."""
     import pickle
-    rank, world = _multi_host_world()
-    if world <= 1:
+    members, rank, tag = _group_members(group, "all_gather_object")
+    if rank not in members:
+        return None  # non-members pass through (reference semantics)
+    if len(members) <= 1:
         object_list.append(obj)
         return None
-    _check_default_group(group, "all_gather_object")
     from .tcp_store import job_store
     store = job_store()
-    key = _obj_key("ag")
+    key = _obj_key("ag", tag)
     store.set(f"{key}/{rank}", pickle.dumps(obj))
-    for r in range(world):
+    for r in members:
         object_list.append(pickle.loads(store.wait(f"{key}/{r}")))
-    # everyone has read everything: safe to drop our slot
-    _reaped_barrier(store, key, world)
+    # every member has read everything: safe to drop our slot
+    _reaped_barrier(store, key, len(members))
     store.delete_key(f"{key}/{rank}")
     return None
 

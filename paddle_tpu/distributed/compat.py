@@ -119,17 +119,20 @@ def broadcast_object_list(object_list: list, src: int = 0, group=None):
     if _single_process():
         return None
     import pickle
-    from .collective import (_check_default_group, _multi_host_world,
-                             _obj_key, _reaped_barrier)
+    from .collective import _group_members, _obj_key, _reaped_barrier
     from .tcp_store import job_store
-    _check_default_group(group, "broadcast_object_list")
-    rank, world = _multi_host_world()
+    members, rank, tag = _group_members(group, "broadcast_object_list")
+    if src not in members:
+        raise ValueError(
+            f"broadcast_object_list src {src} not in group {members}")
+    if rank not in members or len(members) <= 1:
+        return None
     store = job_store()
-    key = _obj_key("bc")
+    key = _obj_key("bc", tag)
     if rank == src:
         store.set(key, pickle.dumps(list(object_list)))
     object_list[:] = pickle.loads(store.wait(key))
-    _reaped_barrier(store, key + "/done", world)
+    _reaped_barrier(store, key + "/done", len(members))
     if rank == src:
         store.delete_key(key)
     return None
@@ -148,24 +151,27 @@ def scatter_object_list(out_object_list: list, in_object_list=None,
                                                   % len(in_object_list)])
         return None
     import pickle
-    from .collective import (_check_default_group, _multi_host_world,
-                             _obj_key, _reaped_barrier)
+    from .collective import _group_members, _obj_key, _reaped_barrier
     from .tcp_store import job_store
-    _check_default_group(group, "scatter_object_list")
-    rank, world = _multi_host_world()
+    members, rank, tag = _group_members(group, "scatter_object_list")
+    if src not in members:
+        raise ValueError(
+            f"scatter_object_list src {src} not in group {members}")
+    if rank not in members:
+        return None
     store = job_store()
-    key = _obj_key("sc")
+    key = _obj_key("sc", tag)
     if rank == src:
-        if not in_object_list or len(in_object_list) != world:
+        if not in_object_list or len(in_object_list) != len(members):
             raise ValueError(
-                f"scatter_object_list needs one object per rank "
-                f"({world}), got "
+                f"scatter_object_list needs one object per group rank "
+                f"({len(members)}), got "
                 f"{0 if not in_object_list else len(in_object_list)}")
-        for r in range(world):
-            store.set(f"{key}/{r}", pickle.dumps(in_object_list[r]))
+        for gi, r in enumerate(members):
+            store.set(f"{key}/{r}", pickle.dumps(in_object_list[gi]))
     out_object_list.clear()
     out_object_list.append(pickle.loads(store.wait(f"{key}/{rank}")))
-    _reaped_barrier(store, key + "/done", world)
+    _reaped_barrier(store, key + "/done", len(members))
     store.delete_key(f"{key}/{rank}")
     return None
 

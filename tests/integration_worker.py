@@ -130,6 +130,26 @@ def main():
                              src=0)
     assert outs == [f"part{rank}"], outs
 
+    # 6b. SUBGROUP object collectives (host-rank groups): members talk,
+    # non-members pass through untouched
+    g1 = dist.new_group(ranks=[1])
+    sub = []
+    dist.all_gather_object(sub, {"r": rank}, group=g1)
+    if rank == 1:
+        assert sub == [{"r": 1}], sub
+    else:
+        assert sub == [], sub  # non-member: untouched
+    objs2 = [f"sub-{rank}"] if rank == 1 else ["original"]
+    dist.broadcast_object_list(objs2, src=1, group=g1)
+    if rank == 1:
+        assert objs2 == ["sub-1"], objs2
+    else:
+        assert objs2 == ["original"], objs2  # non-member: untouched
+    g01 = dist.new_group(ranks=[0, 1])
+    sub2 = []
+    dist.all_gather_object(sub2, rank * 10, group=g01)
+    assert sub2 == [0, 10], sub2
+
     em.stop()
     rpc.shutdown()
     print(f"INTEGRATION OK rank={rank}", flush=True)

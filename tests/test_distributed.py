@@ -357,3 +357,41 @@ def test_broadcast_src_out_of_range_raises(mesh8):
             lambda t: dist.broadcast(t, src=8, group=dist.Group("dp")),
             mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))
         f(x)
+
+
+class TestHostGroups:
+    """new_group(ranks=[...]) builds a HOST group for the store-backed
+    object collectives (reference ProcessGroup subgroups); device
+    collectives reject it with an actionable error."""
+
+    def test_new_group_ranks_subset_is_host_group(self):
+        import paddle_tpu.distributed as dist
+        g = dist.new_group(ranks=[0, 2])
+        assert g.ranks == (0, 2) and g.nranks == 2
+
+    def test_host_group_rejected_by_device_collectives(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import _axes
+        g = dist.new_group(ranks=[0, 2])
+        with pytest.raises(RuntimeError, match="host-rank"):
+            _axes(g)
+
+    def test_group_members_validation(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed.collective import _group_members
+        g = dist.new_group(ranks=[0, 5])
+        with pytest.raises(ValueError, match="outside world"):
+            _group_members(g, "test")
+
+    def test_single_process_world_group_gather(self):
+        import paddle_tpu.distributed as dist
+        out = []
+        dist.all_gather_object(out, {"a": 1})
+        assert out == [{"a": 1}]
+
+    def test_user_rank_order_preserved(self):
+        import paddle_tpu.distributed as dist
+        g = dist.new_group(ranks=[2, 0])
+        assert g.ranks == (2, 0)  # group-rank order = user order
+        with pytest.raises(ValueError, match="duplicate"):
+            dist.new_group(ranks=[1, 1])
