@@ -100,21 +100,21 @@ def new_group(ranks=None, axes=None, mesh=None) -> Group:
     usable by the store-backed object collectives (which address host
     processes directly); arbitrary rank lists still have no XLA analog, so
     a host group inside a shard_map region raises."""
+    g = None
     if axes is None:
         m = mesh or get_mesh()
         full = int(np.prod(list(m.shape.values()))) if m is not None \
             else None
-        if ranks is not None and (m is None or len(ranks) != full):
-            # proper subset (or no mesh): host-rank group for the object-
-            # collective plane
+        if ranks is not None and (
+                m is None or list(ranks) != list(range(full))):
+            # anything but the identity covering of the mesh — a subset, a
+            # permutation, no mesh at all — is a host-rank group for the
+            # object-collective plane (order/dups validated by Group)
             g = Group((), mesh, ranks=ranks)
-            gid = Group._next_id
-            Group._next_id += 1
-            Group._registry[gid] = g
-            g.id = gid
-            return g
-        axes = tuple(m.axis_names) if m is not None else ("dp",)
-    g = Group(axes, mesh)
+        else:
+            axes = tuple(m.axis_names) if m is not None else ("dp",)
+    if g is None:
+        g = Group(axes, mesh)
     gid = Group._next_id
     Group._next_id += 1
     Group._registry[gid] = g

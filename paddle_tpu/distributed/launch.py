@@ -135,15 +135,17 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         nproc_per_node = np_max
         np_range = None
     elif np_range is not None:
-        if nnodes != 1:
-            raise NotImplementedError(
-                "--np elastic scale-in/out is single-node scoped (process "
-                "granularity); multi-node jobs keep fixed-size restart")
         if nproc_per_node != 1:
             raise ValueError(
                 "--np min:max and --nproc_per_node are mutually "
                 "exclusive: the elastic range sets the process count")
-        nproc_per_node = np_max
+        if nnodes == 1:
+            nproc_per_node = np_max
+        elif np_max != nnodes:
+            raise ValueError(
+                f"multi-node elastic: --np max ({np_max}) must equal "
+                f"--nnodes ({nnodes}) — one trainer per host (the TPU "
+                "process shape); min bounds the surviving node count")
     world_size = nnodes * nproc_per_node
     if master is None:
         store = TCPStore(is_master=True, world_size=world_size)
@@ -169,6 +171,11 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         except Exception:
             pass
         return code
+
+    if np_range is not None and nnodes > 1:
+        return _elastic_multinode(script, script_args, master_addr, store,
+                                  nnodes, node_rank, np_min, np_max,
+                                  max_restarts, log_dir)
 
     epoch = int(store.add("__restart_epoch", 0))
     attempts = 0  # local relaunch budget (epoch can over-bump on races)
@@ -277,6 +284,216 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         attempts += 1
         if attempts > max_restarts:
             return _exit(fail_code if fail_code is not None else 1)
+        epoch = new_epoch
+
+
+_LHB_INTERVAL = 0.5   # launcher heartbeat period (s)
+_LHB_TIMEOUT = 4.0    # peer launcher declared dead after this silence
+_SETTLE = 2.0         # membership join window per epoch
+
+
+def _elastic_multinode(script, script_args, master_addr, store, nnodes,
+                       node_rank, np_min, np_max, max_restarts, log_dir):
+    """Cluster-wide elastic membership (reference:
+    fleet/elastic/manager.py:126 — etcd-leased node registry with a leader
+    deciding the world; here the TCPStore is the registry).
+
+    Per epoch: every live launcher registers ``__join/{epoch}/{node}``,
+    the LOWEST-rank joiner (with an atomic-claim fallback should it die
+    mid-decision) publishes the verdict ``__world/{epoch}`` = the member
+    list; members spawn one trainer each with contiguous re-ranked
+    PADDLE_TRAINER_ID. Launchers heartbeat ``__lhb/{node}``; a stale
+    member heartbeat or a local trainer failure bumps the shared epoch,
+    driving a new membership round — survivors >= min continue smaller
+    (scale-in). A late/re-started launcher whose join missed the verdict
+    announces itself through ``__scale_out`` and is absorbed by the next
+    round (scale-out). Scale events never consume ``max_restarts``; only
+    local trainer crashes do."""
+    epoch = int(store.add("__restart_epoch", 0))
+    scale_seen = int(store.add("__scale_out", 0))
+    attempts = 0
+
+    def mn_exit(code, cur_epoch, members):
+        """Membership-scoped exit sync: acks are keyed by (epoch, node) so
+        a dead launcher's ack from an OLD membership can never satisfy the
+        store host's wait and tear the store down under a replacement
+        launcher still using it. The store-hosting node waits (bounded)
+        for the FINAL epoch's members; a host crash-exit still ends the
+        job — the store is the rendezvous, like the reference's etcd."""
+        try:
+            store.set(f"__exit_ack/{cur_epoch}/{node_rank}", b"1")
+            if store._server:
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline and not all(
+                        store.get(f"__exit_ack/{cur_epoch}/{n}")
+                        is not None for n in members):
+                    time.sleep(0.1)
+        except Exception:
+            pass
+        return code
+
+    def beat():
+        store.set(f"__lhb/{node_rank}", str(time.time()).encode())
+
+    def bump_if_current(e):
+        if int(store.add("__restart_epoch", 0)) == e:
+            store.add("__restart_epoch", 1)
+
+    def wait_next_epoch(e):
+        while int(store.add("__restart_epoch", 0)) == e:
+            beat()
+            time.sleep(0.2)
+        return int(store.add("__restart_epoch", 0))
+
+    while True:
+        beat()
+        store.set(f"__join/{epoch}/{node_rank}", b"1")
+
+        # settle window: fast-path out when every possible node joined
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < _SETTLE:
+            if all(store.get(f"__join/{epoch}/{n}") is not None
+                   for n in range(nnodes)):
+                break
+            time.sleep(0.1)
+
+        verdict_key = f"__world/{epoch}"
+        t_claim = time.monotonic()
+        while store.get(verdict_key) is None:
+            joined = [n for n in range(nnodes)
+                      if store.get(f"__join/{epoch}/{n}") is not None]
+            lowest = joined and joined[0] == node_rank
+            fallback = time.monotonic() - t_claim > 2 * _SETTLE
+            if (lowest or fallback) and \
+                    int(store.add(f"__claim/{epoch}", 1)) == 1:
+                if len(joined) < np_min:
+                    store.set(verdict_key, b"__abort")
+                else:
+                    store.set(verdict_key,
+                              ",".join(map(str, joined)).encode())
+            beat()
+            time.sleep(0.1)
+        verdict = store.get(verdict_key)
+        if verdict == b"__abort":
+            # drain acks from every launcher that saw this round, so the
+            # store host doesn't drop the server mid-poll under peers
+            joined = [n for n in range(nnodes)
+                      if store.get(f"__join/{epoch}/{n}") is not None]
+            return mn_exit(1, epoch, joined)
+        members = [int(x) for x in verdict.decode().split(",")]
+        world = len(members)
+
+        if node_rank not in members:
+            # our join missed this epoch's verdict: we ARE the replacement
+            # capacity — announce and fold into the next round
+            store.add("__scale_out", 1)
+            scale_seen = int(store.add("__scale_out", 0))
+            epoch = wait_next_epoch(epoch)
+            continue
+
+        rank = members.index(node_rank)
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": "0",
+            "PADDLE_NODE_RANK": str(node_rank),
+            "PADDLE_MASTER": master_addr,
+            "PADDLE_STORE_PORT": str(store.port),
+            "PADDLE_RESTART_EPOCH": str(epoch),
+        })
+        lf = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            lf = open(os.path.join(log_dir, f"worker.n{node_rank}.log"),
+                      "w")
+        proc = subprocess.Popen(
+            [sys.executable, script, *script_args], env=env, stdout=lf,
+            stderr=subprocess.STDOUT if lf else None)
+
+        fail_code = None
+        last_beat = 0.0
+        grace = time.monotonic() + _LHB_TIMEOUT  # peers re-join slowly
+        # staleness by VALUE-change observation on the reader's monotonic
+        # clock: cross-host wall-clock arithmetic would declare a
+        # skewed-NTP peer dead forever and churn restarts
+        lhb_seen: dict = {}
+
+        def lhb_stale(n: int) -> bool:
+            v = store.get(f"__lhb/{n}")
+            if v is None:
+                return False  # never beat: still booting, not dead
+            prev = lhb_seen.get(n)
+            mono = time.monotonic()
+            if prev is None or prev[0] != v:
+                lhb_seen[n] = (v, mono)
+                return False
+            return mono - prev[1] > _LHB_TIMEOUT
+
+        while True:
+            now = time.monotonic()
+            if now - last_beat >= _LHB_INTERVAL:
+                beat()
+                last_beat = now
+            code = proc.poll()
+            if code not in (None, 0):
+                fail_code = code
+                bump_if_current(epoch)
+                break
+            if code == 0:
+                break
+            if int(store.add("__restart_epoch", 0)) > epoch:
+                break  # cluster-wide membership change requested
+            bumped = int(store.add("__scale_out", 0))
+            if bumped > scale_seen:
+                scale_seen = bumped
+                if world < np_max:
+                    bump_if_current(epoch)
+                    break
+            if now > grace:
+                stale = [n for n in members if n != node_rank
+                         and lhb_stale(n)]
+                if stale:
+                    bump_if_current(epoch)
+                    break
+            time.sleep(0.2)
+
+        if proc.poll() is None:
+            proc.terminate()
+        proc.wait()
+        if lf:
+            lf.close()
+
+        if fail_code is None and proc.returncode == 0 and \
+                int(store.add("__restart_epoch", 0)) == epoch:
+            # clean local exit: leave when every MEMBER finished this
+            # epoch (or a membership change supersedes it)
+            store.set(f"__done/{epoch}/{node_rank}", b"1")
+            while True:
+                beat()
+                if int(store.add("__restart_epoch", 0)) != epoch:
+                    break
+                bumped = int(store.add("__scale_out", 0))
+                if bumped > scale_seen and world < np_max:
+                    # a replacement announced itself during completion:
+                    # run one more round at the bigger size instead of
+                    # exiting and tearing the store down under it
+                    scale_seen = bumped
+                    bump_if_current(epoch)
+                    break
+                if all(store.get(f"__done/{epoch}/{n}") is not None
+                       for n in members):
+                    return mn_exit(0, epoch, members)
+                time.sleep(0.2)
+
+        if fail_code is not None:
+            attempts += 1
+            if attempts > max_restarts:
+                return mn_exit(fail_code, epoch, [])
+        new_epoch = int(store.add("__restart_epoch", 0))
+        if new_epoch == epoch:  # ensure forward progress
+            store.add("__restart_epoch", 1)
+            new_epoch = int(store.add("__restart_epoch", 0))
         epoch = new_epoch
 
 
