@@ -49,15 +49,44 @@ def peak_flops(device) -> float:
     return 0.0  # CPU: MFU not meaningful
 
 
-def _time_steps(fn, steps, warmup, ready):
+def _time_steps(fn, steps, warmup, ready, reps=3):
+    """Per-step seconds by SLOPE: time a short and a long dispatch window
+    and divide the difference by the extra steps. A plain total/steps
+    folds one constant host<->device round-trip (~tens of ms through the
+    sandbox tunnel) into the window, inflating short steps by RTT/steps —
+    the MoE suite entry read 8ms/step (~20%) high before this. The slope
+    cancels every per-window constant; per-CALL dispatch overhead stays
+    in, as it should (a real training loop pays it too). Returns the
+    minimum of ``reps`` slopes (least-interference estimate).
+    """
+    mean, _ = _time_steps_stats(fn, steps, warmup, ready, reps=reps,
+                                reduce="min")
+    return mean
+
+
+def _time_steps_stats(fn, steps, warmup, ready, reps=3, reduce="min"):
+    """(per_step_seconds, spread_seconds) over ``reps`` slope measurements
+    (spread = max-min). ``reduce``: "min" (noise floor) or "mean"."""
     for _ in range(warmup):
         out = fn()
     ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn()
-    ready(out)
-    return (time.perf_counter() - t0) / steps
+
+    def window(n):
+        t0 = time.perf_counter()
+        o = None
+        for _ in range(n):
+            o = fn()
+        ready(o)
+        return time.perf_counter() - t0
+
+    n1, n2 = steps, 3 * steps
+    vals = []
+    for _ in range(reps):
+        t1 = window(n1)
+        t2 = window(n2)
+        vals.append((t2 - t1) / (n2 - n1))
+    agg = min(vals) if reduce == "min" else sum(vals) / len(vals)
+    return agg, (max(vals) - min(vals))
 
 
 def bench_full_model(on_tpu):
@@ -124,8 +153,12 @@ def bench_full_model(on_tpu):
             f"{cfg.num_hidden_layers} (one per layer) — the bench must "
             "exercise the Pallas hot path")
 
-    dt = _time_steps(lambda: step(x), steps, warmup,
-                     lambda loss: loss.numpy())
+    # 5 independent slope measurements: mean is the headline, spread is
+    # published so driver snapshots and docs stop drifting against each
+    # other on tunnel noise (one canonical number +- variance)
+    dt, dt_spread = _time_steps_stats(lambda: step(x), steps, warmup,
+                                      lambda loss: loss.numpy(), reps=5,
+                                      reduce="mean")
 
     d, ffn, V, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
                     cfg.num_hidden_layers)
@@ -142,6 +175,8 @@ def bench_full_model(on_tpu):
         "params_millions": round(n_params / 1e6, 1),
         "tokens_per_sec": round(T / dt, 1),
         "step_ms": round(dt * 1e3, 2),
+        "step_ms_spread": round(dt_spread * 1e3, 2),
+        "spread_pct_of_mean": round(dt_spread / dt * 100, 2),
         "achieved_tflops": round(train_flops / dt / 1e12, 2),
         "config": {"d": d, "ffn": ffn, "vocab": V, "layers": L,
                    "heads": cfg.num_attention_heads,

@@ -18,8 +18,12 @@ Two dispatch formulations behind the same API (``dispatch_mode``):
   global_gather`` shape: each of the T*K (token, expert) assignments gets a
   capacity slot ``e*C + position`` (position = running count within the
   expert, the same order-dependent rule as the dense path, so drops are
-  bit-identical); tokens scatter-add into an ``[E*C, M]`` buffer, the
-  grouped GEMM runs, and combine gathers rows back per assignment. Peak
+  bit-identical). The data movement is GATHER-ONLY in both directions:
+  tiny int32 scatters invert assignment→slot into a slot→token map once,
+  then dispatch-forward, dispatch-backward, combine-forward and
+  combine-backward are all row gathers (``custom_vjp`` supplies the
+  inverse-map backward) — TPU scatters of [*, M] rows serialize badly and
+  were the measured bottleneck of the scatter-add formulation. Peak
   intermediate is O(E*C*M + T*E) — no ``[T, E, C]`` tensor ever exists,
   which at DeepSeekMoE scale (E=64, T=16K) is the difference between ~2 MB
   of routing state and a multi-GB one-hot wall.
@@ -43,6 +47,74 @@ from ..mesh import get_mesh
 from ..sharding_api import shard_tensor
 
 __all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate"]
+
+
+def _ragged_moves(n_slots):
+    """Gather-only dispatch/combine over a slot↔assignment inverse map.
+
+    ``slot_src`` [n_slots+1] holds the token filling each capacity slot
+    (sentinel = T → the zero pad row); ``slots_stack`` [K, T] holds each
+    assignment's slot (sentinel = n_slots → the zero pad row). The two maps
+    are inverses, so every VJP is itself a gather — no [*, M] row scatter
+    ever runs (TPU scatters serialize; this was the ragged path's measured
+    bottleneck). Integer operands take ``float0`` cotangents.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _f0(x):
+        return np.zeros(x.shape, jax.dtypes.float0)
+
+    @jax.custom_vjp
+    def dispatch(xt, slot_src, slots_stack):
+        pad = jnp.concatenate([xt, jnp.zeros((1, xt.shape[1]), xt.dtype)])
+        return pad[slot_src[:n_slots]]
+
+    def dispatch_fwd(xt, slot_src, slots_stack):
+        return dispatch(xt, slot_src, slots_stack), \
+            (slots_stack, slot_src, xt.shape[0])
+
+    def dispatch_bwd(res, g):
+        slots_stack, slot_src, T = res
+        gpad = jnp.concatenate([g, jnp.zeros((1, g.shape[1]), g.dtype)])
+        dxt = gpad[slots_stack[0]]
+        for k in range(1, slots_stack.shape[0]):
+            dxt = dxt + gpad[slots_stack[k]]
+        return dxt, _f0(slot_src), _f0(slots_stack)
+
+    dispatch.defvjp(dispatch_fwd, dispatch_bwd)
+
+    @jax.custom_vjp
+    def combine(flat, w_stack, slot_src, slots_stack, w_slot):
+        # out[t] = Σ_k flat[slots[k, t]] * w[k, t]
+        pad = jnp.concatenate(
+            [flat, jnp.zeros((1, flat.shape[1]), flat.dtype)])
+        out = pad[slots_stack[0]] * w_stack[0][:, None]
+        for k in range(1, slots_stack.shape[0]):
+            out = out + pad[slots_stack[k]] * w_stack[k][:, None]
+        return out
+
+    def combine_fwd(flat, w_stack, slot_src, slots_stack, w_slot):
+        return combine(flat, w_stack, slot_src, slots_stack, w_slot), \
+            (flat, w_stack, slot_src, slots_stack, w_slot)
+
+    def combine_bwd(res, g):
+        flat, w_stack, slot_src, slots_stack, w_slot = res
+        # d_flat[s] = g[token(s)] * w(s): the INVERSE map makes this a
+        # gather of g rows, not a scatter of weighted rows
+        gpad = jnp.concatenate([g, jnp.zeros((1, g.shape[1]), g.dtype)])
+        d_flat = gpad[slot_src[:n_slots]] * w_slot[:n_slots, None]
+        # d_w[k, t] = <flat[slots[k, t]], g[t]>
+        fpad = jnp.concatenate(
+            [flat, jnp.zeros((1, flat.shape[1]), flat.dtype)])
+        d_w = jnp.stack([
+            (fpad[slots_stack[k]] * g).sum(-1)
+            for k in range(slots_stack.shape[0])])
+        return d_flat, d_w.astype(w_stack.dtype), _f0(slot_src), \
+            _f0(slots_stack), jnp.zeros_like(w_slot)
+
+    combine.defvjp(combine_fwd, combine_bwd)
+    return dispatch, combine
 
 
 class _GateBase(Layer):
@@ -177,15 +249,21 @@ class MoELayer(Layer):
 
             if ragged:
                 # ---- index routing (global_scatter/global_gather shape):
-                # slot = e*C + position; dropped assignments land on a
-                # sentinel row that is sliced off. Every slot receives at
-                # most one token (positions are unique per expert), so the
-                # scatter-add is conflict-free.
-                buf = jnp.zeros((E * C + 1, M), xt.dtype)
+                # slot = e*C + position; dropped assignments point at the
+                # sentinel pad row. Build the slot→token inverse map with
+                # tiny int32 scatters (conflict-free: positions are unique
+                # per expert), then every [*, M] move is a gather.
+                tok = jnp.arange(T, dtype=jnp.int32)
+                slot_src = jnp.full((E * C + 1,), T, jnp.int32)
+                slots_list = []
                 for idx, gv, pos_t, keep in picks:
                     slots = jnp.where(keep, idx * C + pos_t, E * C)
-                    buf = buf.at[slots].add(xt)
-                expert_in = buf[:E * C].reshape(E, C, M)
+                    slot_src = slot_src.at[slots].set(tok)
+                    slots_list.append(slots)
+                slots_stack = jnp.stack(slots_list)  # [K, T]
+                dispatch, combine = _ragged_moves(E * C)
+                expert_in = dispatch(xt, slot_src,
+                                     slots_stack).reshape(E, C, M)
             else:
                 # ---- dense GShard one-hot contraction ([T, E, C] lives).
                 # dispatch and combine share one per-pick [T,E]x[T,C]
@@ -210,12 +288,16 @@ class MoELayer(Layer):
 
             if ragged:
                 flat = expert_out.reshape(E * C, M)
-                out = jnp.zeros_like(xt)
-                for idx, gv, pos_t, keep in picks:
-                    slots = jnp.where(keep, idx * C + pos_t, 0)
-                    w = (gv * keep.astype(gv.dtype) / denom).astype(
-                        xt.dtype)
-                    out = out + flat[slots] * w[:, None]
+                w_stack = jnp.stack([
+                    (gv * kp.astype(gv.dtype) / denom).astype(xt.dtype)
+                    for _, gv, _, kp in picks])  # [K, T]
+                # per-slot combine weight (for the gather-only backward):
+                # same tiny int32-scatter trick as slot_src
+                w_slot = jnp.zeros((E * C + 1,), xt.dtype)
+                for (idx, gv, pos_t, keep), wk in zip(picks, w_stack):
+                    slots = jnp.where(keep, idx * C + pos_t, E * C)
+                    w_slot = w_slot.at[slots].set(wk)
+                out = combine(flat, w_stack, slot_src, slots_stack, w_slot)
             else:
                 out = jnp.einsum("tec,ecm->tm", combine, expert_out)
 

@@ -287,9 +287,14 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         epoch = new_epoch
 
 
-_LHB_INTERVAL = 0.5   # launcher heartbeat period (s)
-_LHB_TIMEOUT = 4.0    # peer launcher declared dead after this silence
-_SETTLE = 2.0         # membership join window per epoch
+_LHB_INTERVAL = 0.5    # launcher heartbeat period (s)
+_LHB_TIMEOUT = 4.0     # peer launcher declared dead after this silence
+_SETTLE = 2.0          # membership join window per epoch
+_BOOT_TIMEOUT = 30.0   # wait this long for an under-min join set (cold
+                       # start pod stagger) before aborting the job
+_CLAIM_TIMEOUT = 40.0  # a won-but-unpublished claim (claimer died mid-
+                       # decision) is abandoned by bumping the epoch; must
+                       # exceed _BOOT_TIMEOUT so the abort can fire first
 
 
 def _elastic_multinode(script, script_args, master_addr, store, nnodes,
@@ -309,6 +314,21 @@ def _elastic_multinode(script, script_args, master_addr, store, nnodes,
     announces itself through ``__scale_out`` and is absorbed by the next
     round (scale-out). Scale events never consume ``max_restarts``; only
     local trainer crashes do."""
+    try:
+        return _elastic_multinode_loop(
+            script, script_args, master_addr, store, nnodes, node_rank,
+            np_min, np_max, max_restarts, log_dir)
+    except (ConnectionError, OSError) as e:
+        # the TCPStore is the rendezvous; losing it (the store-hosting
+        # launcher exited) fails this node cleanly, not with a traceback
+        print(f"[elastic] job store lost ({e!r}) — the store-hosting "
+              "launcher is gone; failing this node", file=sys.stderr)
+        return 1
+
+
+def _elastic_multinode_loop(script, script_args, master_addr, store,
+                            nnodes, node_rank, np_min, np_max,
+                            max_restarts, log_dir):
     epoch = int(store.add("__restart_epoch", 0))
     scale_seen = int(store.add("__scale_out", 0))
     attempts = 0
@@ -359,20 +379,39 @@ def _elastic_multinode(script, script_args, master_addr, store, nnodes,
 
         verdict_key = f"__world/{epoch}"
         t_claim = time.monotonic()
+        stale_epoch = False
         while store.get(verdict_key) is None:
+            if int(store.add("__restart_epoch", 0)) > epoch:
+                # round superseded (e.g. a wedged claim was abandoned by a
+                # peer bumping the epoch) — re-join at the new one
+                stale_epoch = True
+                break
+            elapsed = time.monotonic() - t_claim
             joined = [n for n in range(nnodes)
                       if store.get(f"__join/{epoch}/{n}") is not None]
             lowest = joined and joined[0] == node_rank
-            fallback = time.monotonic() - t_claim > 2 * _SETTLE
-            if (lowest or fallback) and \
+            fallback = elapsed > 2 * _SETTLE
+            if (lowest or fallback) and len(joined) >= np_min and \
                     int(store.add(f"__claim/{epoch}", 1)) == 1:
-                if len(joined) < np_min:
-                    store.set(verdict_key, b"__abort")
-                else:
-                    store.set(verdict_key,
-                              ",".join(map(str, joined)).encode())
+                # decide only with quorum: at cold start launchers may
+                # join many seconds apart (pod stagger) — an under-min
+                # join set WAITS (up to _BOOT_TIMEOUT) instead of
+                # aborting a job that is one second from healthy
+                store.set(verdict_key,
+                          ",".join(map(str, joined)).encode())
+            if elapsed > _BOOT_TIMEOUT and len(joined) < np_min and \
+                    int(store.add(f"__claim/{epoch}", 1)) == 1:
+                store.set(verdict_key, b"__abort")
+            if elapsed > _CLAIM_TIMEOUT:
+                # a claimer won __claim then died before publishing: no
+                # verdict can ever appear for THIS epoch — abandon it
+                # (fresh epoch = fresh claim key, the wedge clears)
+                bump_if_current(epoch)
             beat()
             time.sleep(0.1)
+        if stale_epoch:
+            epoch = int(store.add("__restart_epoch", 0))
+            continue
         verdict = store.get(verdict_key)
         if verdict == b"__abort":
             # drain acks from every launcher that saw this round, so the
@@ -405,8 +444,10 @@ def _elastic_multinode(script, script_args, master_addr, store, nnodes,
         lf = None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
-            lf = open(os.path.join(log_dir, f"worker.n{node_rank}.log"),
-                      "w")
+            # epoch-scoped name: the previous epoch's log holds the crash
+            # that CAUSED this round — never truncate it
+            lf = open(os.path.join(
+                log_dir, f"worker.n{node_rank}.e{epoch}.log"), "w")
         proc = subprocess.Popen(
             [sys.executable, script, *script_args], env=env, stdout=lf,
             stderr=subprocess.STDOUT if lf else None)
@@ -489,7 +530,12 @@ def _elastic_multinode(script, script_args, master_addr, store, nnodes,
         if fail_code is not None:
             attempts += 1
             if attempts > max_restarts:
-                return mn_exit(fail_code, epoch, [])
+                # best-effort drain of the just-supervised membership; if
+                # this node hosts the store, survivors continuing into the
+                # next round still lose it — the store IS the rendezvous
+                # (reference analog: losing etcd fails the job)
+                return mn_exit(fail_code, epoch,
+                               [n for n in members if n != node_rank])
         new_epoch = int(store.add("__restart_epoch", 0))
         if new_epoch == epoch:  # ensure forward progress
             store.add("__restart_epoch", 1)
