@@ -258,11 +258,156 @@ def bench_layer(on_tpu):
                               "layer_tokens_per_sec": round(tokens / dt, 1)}
 
 
+def bench_decode():
+    """Serving numbers for the zoo Llama (headline 0.7B config, bf16):
+    prefill tokens/sec and decode tokens/sec at B=1 and B=8, via the
+    whole-loop compiled generator. Separation by budget slope: one full
+    generate call costs prefill + mnt * per_token (+ window RTT, cancelled
+    by the call-count slope inside _time_steps); timing two budgets
+    isolates the decode slope, and the intercept is the prefill."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=7168,
+        num_hidden_layers=8, num_attention_heads=16,
+        num_key_value_heads=4, max_position_embeddings=4096,
+        tie_word_embeddings=True)
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    model.bfloat16()
+    S1, S2 = 512, 1024
+    m1, m2 = 8, 72
+    rng = np.random.RandomState(0)
+    out = {}
+    for B in (1, 8):
+        def t_of(S, mnt):
+            ids = pt.to_tensor(rng.randint(0, cfg.vocab_size,
+                                           (B, S)).astype(np.int32))
+            call = lambda: model.generate_compiled(  # noqa: E731
+                ids, max_new_tokens=mnt, temperature=0.0)
+            return _time_steps(call, 2, 1, lambda r: r.numpy())
+
+        # decode rate: budget slope at fixed prompt; prefill rate: prompt
+        # slope at the MINIMUM budget (mnt=1) so the longer prompt's extra
+        # decode-attention cost contaminates the slope by at most one step
+        # (an intercept estimate drowns in call noise at B=1 where the
+        # whole prefill is a few ms)
+        t1, t2 = t_of(S1, m1), t_of(S1, m2)
+        per_tok = (t2 - t1) / (m2 - m1)
+        prefill_per_tok = max(
+            (t_of(S2, 1) - t_of(S1, 1)) / (S2 - S1), 1e-9)
+        out[f"B{B}"] = {
+            "prefill_tok_per_s": round(B / prefill_per_tok, 1),
+            "prefill_ms_at_512": round(prefill_per_tok * S1 * 1e3, 2),
+            "decode_ms_per_tok": round(per_tok * 1e3, 3),
+            "decode_tok_per_s": round(B / per_tok, 1),
+        }
+        print(json.dumps({f"B{B}": out[f"B{B}"]}), file=sys.stderr,
+              flush=True)
+        gc.collect()
+    out["config"] = {"prompt": S1, "d": cfg.hidden_size,
+                     "layers": cfg.num_hidden_layers,
+                     "vocab": cfg.vocab_size, "dtype": "bf16"}
+    return out
+
+
+def bench_eager():
+    """Eager-dispatch overhead — SURVEY §7's #1 risk ('per-op eager
+    dispatch is untenable'), finally measured (reference ships the
+    equivalent microbench: eager/tests/performance_tests/
+    benchmark_eager_cuda.cc). Two numbers: µs per small eager op (tape
+    node + XLA dispatch, slope-timed so the sync constant cancels), and
+    the eager-vs-TrainStep step-time ratio at the headline config — the
+    factor a user pays for skipping compilation on the hot loop."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    # --- 1) µs/op on a chain of small adds (dependent: no fusion escape)
+    a = pt.to_tensor(np.ones((8, 8), np.float32))
+    b = pt.to_tensor(np.ones((8, 8), np.float32))
+
+    def chain(n):
+        c = a
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c = pt.ops.add(c, b)
+        float(np.asarray(c.numpy()).sum())
+        return time.perf_counter() - t0
+
+    chain(20)  # warm
+    n1, n2 = 100, 500
+    us_per_op = min((chain(n2) - chain(n1)) / (n2 - n1)
+                    for _ in range(3)) * 1e6
+
+    # --- 2) eager vs TrainStep, headline model (scaled to keep the eager
+    # run tractable: same recipe, 4 layers, B=2)
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = LlamaConfig(
+        vocab_size=128256 if on_tpu else 512,
+        hidden_size=2048 if on_tpu else 128,
+        intermediate_size=7168 if on_tpu else 448,
+        num_hidden_layers=4 if on_tpu else 2,
+        num_attention_heads=16 if on_tpu else 4,
+        num_key_value_heads=4 if on_tpu else 2,
+        max_position_embeddings=4096 if on_tpu else 512,
+        tie_word_embeddings=True)
+    B, S = (2, 2048) if on_tpu else (2, 128)
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=True)
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randint(0, cfg.vocab_size, (B, S))
+                     .astype(np.int64))
+
+    def eager_step():
+        _, loss = model(x, labels=x)
+        loss.backward()
+        opt.step()
+        opt.clear_grad(set_to_zero=False)
+        return loss
+
+    eager_dt = _time_steps(eager_step, 1, 1, lambda l: l.numpy(), reps=2)
+
+    pt.seed(0)
+    model2 = LlamaForCausalLM(cfg)
+    model2.bfloat16()
+    opt2 = pt.optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model2.parameters(),
+                              multi_precision=True)
+    step = TrainStep(model2, lambda m, t: m(t, labels=t)[1], opt2)
+    comp_dt = _time_steps(lambda: step(x), 3, 1, lambda l: l.numpy())
+
+    return {
+        "eager_us_per_small_op": round(us_per_op, 1),
+        "eager_step_ms": round(eager_dt * 1e3, 1),
+        "trainstep_step_ms": round(comp_dt * 1e3, 1),
+        "eager_over_trainstep": round(eager_dt / comp_dt, 1),
+        "config": {"layers": cfg.num_hidden_layers, "d": cfg.hidden_size,
+                   "batch": B, "seq": S},
+    }
+
+
 def main():
     import jax
 
     if "--suite" in sys.argv or os.environ.get("BENCH_SUITE"):
         print(json.dumps({"suite": bench_suite()}))
+        return
+
+    if "--decode" in sys.argv:
+        print(json.dumps({"decode": bench_decode()}))
+        return
+
+    if "--eager" in sys.argv:
+        print(json.dumps({"eager": bench_eager()}))
         return
 
     on_tpu = jax.default_backend() == "tpu"

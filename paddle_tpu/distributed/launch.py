@@ -319,11 +319,16 @@ def _elastic_multinode(script, script_args, master_addr, store, nnodes,
             script, script_args, master_addr, store, nnodes, node_rank,
             np_min, np_max, max_restarts, log_dir)
     except (ConnectionError, OSError) as e:
-        # the TCPStore is the rendezvous; losing it (the store-hosting
-        # launcher exited) fails this node cleanly, not with a traceback
-        print(f"[elastic] job store lost ({e!r}) — the store-hosting "
-              "launcher is gone; failing this node", file=sys.stderr)
-        return 1
+        # only claim "store lost" when the store actually IS unreachable —
+        # a FileNotFoundError from Popen or a log-dir PermissionError must
+        # keep its traceback, not masquerade as a network failure
+        try:
+            store.get("__probe")
+        except Exception:
+            print(f"[elastic] job store lost ({e!r}) — the store-hosting "
+                  "launcher is gone; failing this node", file=sys.stderr)
+            return 1
+        raise
 
 
 def _elastic_multinode_loop(script, script_args, master_addr, store,
@@ -530,12 +535,12 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
         if fail_code is not None:
             attempts += 1
             if attempts > max_restarts:
-                # best-effort drain of the just-supervised membership; if
-                # this node hosts the store, survivors continuing into the
-                # next round still lose it — the store IS the rendezvous
+                # exit immediately: surviving members are CONTINUING (they
+                # rejoin the next round), so waiting for their exit acks
+                # would only stall 15 s. If this node hosts the store the
+                # job dies with it — the store IS the rendezvous
                 # (reference analog: losing etcd fails the job)
-                return mn_exit(fail_code, epoch,
-                               [n for n in members if n != node_rank])
+                return mn_exit(fail_code, epoch, [])
         new_epoch = int(store.add("__restart_epoch", 0))
         if new_epoch == epoch:  # ensure forward progress
             store.add("__restart_epoch", 1)

@@ -15,12 +15,14 @@ from paddle_tpu.core import generator as G
 from paddle_tpu.core.autograd import no_grad
 from paddle_tpu.core.tensor import Tensor
 
-__all__ = ["sample_token", "generate_loop"]
+__all__ = ["sample_token", "generate_loop", "compiled_generate"]
 
 
 def sample_token(step_logits, temperature: float, top_k: int,
-                 top_p: float):
-    """[B, V] logits -> [B] token ids (greedy when temperature == 0)."""
+                 top_p: float, key=None):
+    """[B, V] logits -> [B] token ids (greedy when temperature == 0).
+    ``key`` makes the draw explicit (the compiled loop threads its own
+    split chain); default pulls from the global generator stream."""
     if temperature == 0:
         return jnp.argmax(step_logits, -1)
     sl = step_logits / temperature
@@ -34,7 +36,7 @@ def sample_token(step_logits, temperature: float, top_k: int,
         cutoff_idx = jnp.sum(cum < top_p, -1)
         cutoff = jnp.take_along_axis(srt, cutoff_idx[:, None], -1)
         sl = jnp.where(sl < cutoff, -jnp.inf, sl)
-    return jax.random.categorical(G.next_key(), sl)
+    return jax.random.categorical(G.next_key() if key is None else key, sl)
 
 
 def generate_loop(prefill, decode, input_ids, max_new_tokens: int = 32,
@@ -59,3 +61,95 @@ def generate_loop(prefill, decode, input_ids, max_new_tokens: int = 32,
             tok = Tensor(jnp.asarray(nxt_np[:, None]))
             logits, caches = decode(tok, caches)
         return Tensor(jnp.asarray(out_np))
+
+
+def compiled_generate(model, input_ids, max_new_tokens: int = 32,
+                      temperature: float = 0.0, top_k: int = 0,
+                      top_p: float = 1.0, eos_token_id=None) -> Tensor:
+    """The WHOLE generate loop as one compiled program.
+
+    Prefill + ``max_new_tokens`` decode steps run inside a single jit:
+    static-shape KV buffers ([B, S+new, n_kv, hd], written in place with
+    ``dynamic_update_slice``), a ``lax.scan`` over decode steps, and an
+    explicit split-chain RNG. This is the TPU serving answer to the
+    reference's AnalysisPredictor inference path
+    (``paddle/fluid/inference/api/analysis_predictor.cc``): no per-token
+    python dispatch, no shape churn (the eager loop's growing concat cache
+    recompiles nothing here — every step is the same program).
+
+    Token-for-token equal to ``generate_loop`` under greedy decoding
+    (``temperature=0``). Early-exit on EOS is not possible inside a
+    compiled loop — finished rows keep emitting ``eos_token_id`` and the
+    full budget always runs (pass a sensible ``max_new_tokens``).
+    Compiled executables are cached on the model per
+    (batch, prompt_len, budget, sampling-config) signature.
+    """
+    from paddle_tpu.jit.functional import functional_state, swap_state
+
+    cfg = model.cfg
+    train, frozen, buffers = functional_state(model)
+    st = {**train, **frozen, **buffers}
+    ids_arr = input_ids.data if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    B, S = int(ids_arr.shape[0]), int(ids_arr.shape[1])
+    mnt = int(max_new_tokens)
+    if mnt <= 0:
+        raise ValueError("max_new_tokens must be positive")
+    L = S + mnt
+    nl = cfg.num_hidden_layers
+    n_kv = cfg.num_key_value_heads
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    embed_name = next(n for n in st if n.endswith("embed_tokens.weight"))
+    dtype = st[embed_name].dtype
+
+    def run_model(stt, toks, caches):
+        tens = [tuple(Tensor(a) for a in c) for c in caches]
+        with no_grad(), swap_state(model, stt, collect_buffers=False):
+            h, new_c = model.model(Tensor(toks), caches=tens)
+            logits = model._logits(h[:, -1:, :])
+        return logits.data, [tuple(t.data for t in c) for c in new_c]
+
+    def pick(logits, finished, key):
+        nxt = sample_token(logits[:, -1, :].astype(jnp.float32),
+                           temperature, top_k, top_p, key=key)
+        nxt = nxt.astype(ids_arr.dtype)
+        if eos_token_id is not None:
+            nxt = jnp.where(finished, eos_token_id, nxt)
+            finished = finished | (nxt == eos_token_id)
+        return nxt, finished
+
+    def whole(stt, ids, key):
+        caches = [(jnp.zeros((B, L, n_kv, hd), dtype),
+                   jnp.zeros((B, L, n_kv, hd), dtype),
+                   jnp.zeros((), jnp.int32)) for _ in range(nl)]
+        logits, caches = run_model(stt, ids, caches)
+        key, sub = jax.random.split(key)
+        finished = jnp.zeros((B,), bool)
+        tok, finished = pick(logits, finished, sub)
+        out = jnp.zeros((B, mnt), ids.dtype)
+        out = jax.lax.dynamic_update_slice(out, tok[:, None], (0, 0))
+
+        def body(carry, i):
+            caches, tok, finished, key, out = carry
+            logits, caches = run_model(stt, tok[:, None], caches)
+            key, sub = jax.random.split(key)
+            nxt, finished = pick(logits, finished, sub)
+            out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
+            return (caches, nxt, finished, key, out), None
+
+        if mnt > 1:
+            (caches, tok, finished, key, out), _ = jax.lax.scan(
+                body, (caches, tok, finished, key, out),
+                jnp.arange(1, mnt))
+        return jnp.concatenate([ids, out], axis=1)
+
+    sig = (B, S, mnt, float(temperature), int(top_k), float(top_p),
+           eos_token_id, str(dtype), tuple(sorted(st)))
+    cache = model.__dict__.setdefault("_compiled_generate", {})
+    if sig not in cache:
+        cache[sig] = jax.jit(whole)
+    # greedy decoding draws nothing: leave the global RNG stream untouched
+    # (eager generate doesn't advance it either — pipeline reproducibility)
+    key = jax.random.PRNGKey(0) if temperature == 0 else G.next_key()
+    seq = cache[sig](st, ids_arr, key)
+    return Tensor(seq)

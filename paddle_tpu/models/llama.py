@@ -18,6 +18,7 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import functools
+import math
 from dataclasses import dataclass
 
 import jax.numpy as jnp
@@ -141,8 +142,15 @@ class LlamaAttention(nn.Layer):
 
     def forward(self, x, cache=None):
         """``cache=(k, v)`` ([B, P, n_kv, hd] each, P may be 0) switches to
-        the incremental-decode path: returns (out, (k', v')). Without a
-        cache, plain causal flash attention returns just ``out``."""
+        the incremental-decode path: returns (out, (k', v')). A
+        ``cache=(k_buf, v_buf, pos)`` triple ([B, L, n_kv, hd] preallocated
+        buffers + scalar write position) takes the STATIC-shape path —
+        every decode step has identical shapes, which is what lets the
+        whole generate loop compile into one program
+        (``generation.compiled_generate``). Without a cache, plain causal
+        flash attention returns just ``out``."""
+        if cache is not None and len(cache) == 3:
+            return self._static_forward(x, cache)
         B, S = x.shape[0], x.shape[1]
         q = ops.reshape(self.q_proj(x), [B, S, self.n_heads, self.head_dim])
         k = ops.reshape(self.k_proj(x), [B, S, self.n_kv, self.head_dim])
@@ -168,6 +176,57 @@ class LlamaAttention(nn.Layer):
         # mask (sdpa's tril offset is s_k - s_q = P); GQA heads stay at n_kv
         out = F.scaled_dot_product_attention(q, k_all, v_all, is_causal=True)
         return self.o_proj(ops.reshape(out, [B, S, -1])), (k_all, v_all)
+
+    def _static_forward(self, x, cache):
+        """Fixed-shape KV-cached attention: rotary at a TRACED position,
+        dynamic_update_slice into the preallocated buffers, masked
+        attention over the whole buffer (keys past ``pos+S`` masked out).
+        One tape node; S_q is 1 in decode, the prompt length in prefill."""
+        import jax
+        import jax.numpy as jnp
+
+        B, S = x.shape[0], x.shape[1]
+        q = ops.reshape(self.q_proj(x), [B, S, self.n_heads, self.head_dim])
+        k = ops.reshape(self.k_proj(x), [B, S, self.n_kv, self.head_dim])
+        v = ops.reshape(self.v_proj(x), [B, S, self.n_kv, self.head_dim])
+        k_buf, v_buf, pos = cache
+        L = int(k_buf.shape[1])
+        hd = self.head_dim
+        grp = self.n_heads // self.n_kv
+        theta = self.cfg.rope_theta
+        scale = 1.0 / math.sqrt(hd)
+
+        def f(qa, ka, va, kb, vb, p):
+            p = jnp.reshape(p, ()).astype(jnp.int32)
+            cos_np, sin_np = _rope_cache(L, hd, theta, str(qa.dtype))
+            cos = jax.lax.dynamic_slice_in_dim(
+                jnp.asarray(cos_np), p, S)[None, :, None, :]
+            sin = jax.lax.dynamic_slice_in_dim(
+                jnp.asarray(sin_np), p, S)[None, :, None, :]
+
+            def rot(t):
+                t1, t2 = t[..., 0::2], t[..., 1::2]
+                return jnp.stack([t1 * cos - t2 * sin,
+                                  t2 * cos + t1 * sin],
+                                 axis=-1).reshape(t.shape)
+
+            qr, kr = rot(qa), rot(ka)
+            kb = jax.lax.dynamic_update_slice(kb, kr, (0, p, 0, 0))
+            vb = jax.lax.dynamic_update_slice(vb, va, (0, p, 0, 0))
+            qg = qr.reshape(B, S, self.n_kv, grp, hd)
+            s = jnp.einsum("bskgh,blkh->bskgl", qg.astype(jnp.float32),
+                           kb.astype(jnp.float32)) * scale
+            q_pos = p + jnp.arange(S)
+            live = jnp.arange(L)[None, :] <= q_pos[:, None]  # [S, L]
+            s = jnp.where(live[None, :, None, None, :], s,
+                          jnp.finfo(jnp.float32).min)
+            w = jax.nn.softmax(s, axis=-1).astype(va.dtype)
+            out = jnp.einsum("bskgl,blkh->bskgh", w, vb)
+            return out.reshape(B, S, self.n_heads * hd), kb, vb
+
+        out, kb2, vb2 = apply_op(f, q, k, v, k_buf, v_buf, pos,
+                                 op_name="static_kv_attention")
+        return self.o_proj(out), (kb2, vb2, pos + S)
 
 
 class LlamaMLP(nn.Layer):
@@ -329,6 +388,17 @@ class LlamaForCausalLM(nn.Layer):
 
         return generate_loop(prefill, decode, input_ids, max_new_tokens,
                              temperature, top_k, top_p, eos_token_id)
+
+    def generate_compiled(self, input_ids, max_new_tokens: int = 32,
+                          temperature: float = 0.0, top_k: int = 0,
+                          top_p: float = 1.0, eos_token_id=None):
+        """Whole-loop compiled generation: prefill + every decode step in
+        ONE jitted program over static KV buffers (see
+        ``generation.compiled_generate``). Greedy output is token-for-token
+        equal to ``generate``."""
+        from .generation import compiled_generate
+        return compiled_generate(self, input_ids, max_new_tokens,
+                                 temperature, top_k, top_p, eos_token_id)
 
     @staticmethod
     def flops_per_token(cfg: LlamaConfig) -> float:
