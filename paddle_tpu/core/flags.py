@@ -80,3 +80,4 @@ define_flag("default_dtype", "float32", "default floating dtype for tensor creat
 define_flag("matmul_precision", "default", "jax matmul precision: default|high|highest")
 define_flag("use_pallas_kernels", True, "use Pallas fused kernels (flash attention etc.) when on TPU")
 define_flag("log_level", 0, "VLOG-style verbosity")
+define_flag("use_autotune", False, "sweep Pallas block sizes / fused-CE chunk counts once per shape signature and cache the winner (reference: FLAGS_use_autotune + phi/kernels/autotune)")

@@ -111,15 +111,48 @@ def _mce_bwd(n_chunks, res, dout):
 _mce.defvjp(_mce_fwd, _mce_bwd)
 
 
+def _auto_chunks(T, V, d, dtype) -> int:
+    """Vocab chunk count for this signature: the default, or — with
+    ``FLAGS_use_autotune`` — the winner of an on-chip sweep, cached per
+    (T, V, d, dtype) like the flash block sizes (reference
+    phi/kernels/autotune AutoTuneCache analog)."""
+    from paddle_tpu.core.flags import flag
+    if not flag("use_autotune"):
+        return _DEF_CHUNKS  # fast exit: no backend probe when disabled
+    import jax
+    if jax.default_backend() != "tpu":
+        return _DEF_CHUNKS
+    from paddle_tpu.ops.pallas.autotune import autotune
+
+    def build(nc):
+        from paddle_tpu.ops.pallas.autotune import aot_runner
+        if V % nc:
+            raise ValueError("chunk count must divide V")
+        with jax.ensure_compile_time_eval():
+            dt = jnp.dtype(dtype)
+            h0 = jnp.zeros((T, d), dt)
+            w0 = jnp.zeros((V, d), dt)
+            lab0 = jnp.zeros((T,), jnp.int32)
+            valid0 = jnp.ones((T,), bool)
+        return aot_runner(jax.value_and_grad(
+            lambda ha, wa: _mce(ha, wa, lab0, valid0, nc).sum(),
+            argnums=(0, 1)), h0, w0)
+
+    return autotune("fused_ce_chunks", (T, V, d, str(dtype)),
+                    [4, 8, 16, 32], build, _DEF_CHUNKS)
+
+
 def matmul_cross_entropy(h, w_vd, labels, ignore_index: int = -100,
-                         n_chunks: int = _DEF_CHUNKS):
+                         n_chunks=None):
     """Per-token CE of ``h @ w_vdᵀ`` against int ``labels``.
 
     ``h``: [T, d] (or [..., d], flattened), ``w_vd``: [V, d] (embedding
     -layout weight, as tied LM heads store it), ``labels``: int [T].
     Tokens whose label equals ``ignore_index`` contribute zero loss and
     zero gradient (``F.cross_entropy`` semantics). ``n_chunks`` must
-    divide V; falls back to 1 chunk (still fused) when it doesn't.
+    divide V; falls back to 1 chunk (still fused) when it doesn't;
+    ``None`` picks the default (or the autotuned winner under
+    ``FLAGS_use_autotune``).
     """
     lead = h.shape[:-1]
     h2 = h.reshape(-1, h.shape[-1])
@@ -127,6 +160,9 @@ def matmul_cross_entropy(h, w_vd, labels, ignore_index: int = -100,
     valid = lab != ignore_index
     lab = jnp.where(valid, lab, 0)  # safe index for the chunk gather
     V = w_vd.shape[0]
+    if n_chunks is None:
+        n_chunks = _auto_chunks(h2.shape[0], V, h2.shape[1],
+                                str(h2.dtype))
     if V % n_chunks:
         n_chunks = 1
     loss = _mce(h2, w_vd, lab, valid, n_chunks)

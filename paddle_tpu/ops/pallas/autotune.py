@@ -1,0 +1,190 @@
+"""Runtime kernel-config autotuning with a hit-rate-managed cache.
+
+TPU analog of the reference's conv/algo autotuner
+(``paddle/phi/kernels/autotune/cache.h`` AutoTuneCache — per-op maps keyed
+by a shape/dtype signature, hit/miss accounting;
+``auto_tune_base.h`` AutoTuneBase::Run — measure every candidate once,
+serve the cached winner after). Here the tunables are the Pallas flash
+-attention block sizes and the fused-CE vocab chunk count; candidates are
+measured on the REAL chip with synthetic operands at the exact
+(shape, dtype, variant) signature, outside any enclosing trace, so a
+`TrainStep` trace picks up tuned constants without ever timing tracers.
+
+Off by default (`FLAGS_use_autotune=1` / ``set_flags`` enables); when off,
+callers keep their hand-swept defaults. The cache can persist across
+processes through ``PADDLE_AUTOTUNE_CACHE`` (a JSON file), mirroring the
+reference's serialized autotune status.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Iterable, Optional, Tuple
+
+__all__ = ["AutoTuneCache", "autotune", "aot_runner"]
+
+
+class AutoTuneCache:
+    """Process-wide (op, signature) -> winning-config store."""
+
+    _instance: Optional["AutoTuneCache"] = None
+
+    def __init__(self):
+        self._store: dict = {}
+        self.hits = 0
+        self.misses = 0
+        path = os.environ.get("PADDLE_AUTOTUNE_CACHE")
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._store = {
+                        tuple(json.loads(k)):
+                            tuple(v) if isinstance(v, list) else v
+                        for k, v in json.load(f).items()}
+            except Exception:
+                self._store = {}
+
+    @classmethod
+    def instance(cls) -> "AutoTuneCache":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def lookup(self, key: Tuple):
+        got = self._store.get(key)
+        if got is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return got
+
+    def put(self, key: Tuple, value):
+        self._store[key] = value
+        path = os.environ.get("PADDLE_AUTOTUNE_CACHE")
+        if path:
+            try:
+                # atomic replace: a concurrent reader/interrupted writer
+                # must never see a torn file (which the loader would
+                # silently discard, losing every persisted winner)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({json.dumps(list(k)):
+                               list(v) if isinstance(v, (tuple, list))
+                               else v
+                               for k, v in self._store.items()}, f)
+                os.replace(tmp, path)
+            except Exception:
+                pass
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {"size": len(self._store), "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": self.hits / total if total else 0.0}
+
+    def clear(self):
+        self._store.clear()
+        self.hits = self.misses = 0
+
+
+def aot_runner(fn: Callable, *operands) -> Callable[[], object]:
+    """Zero-arg runner executing ``jit(fn)`` on concrete synthetic
+    ``operands`` — safe to call while an ENCLOSING trace is active (the
+    normal first-use site: inside a TrainStep trace). Two traps this
+    sidesteps: array creation inside a trace stages tracers (escaped via
+    ``ensure_compile_time_eval`` for the operands), and a nested ``jit``
+    call inlines into the outer trace instead of executing (escaped by
+    AOT ``lower().compile()`` — running a compiled executable on concrete
+    buffers never touches the trace machinery)."""
+    import jax
+    import jax.numpy as jnp
+
+    with jax.ensure_compile_time_eval():
+        concrete = [jnp.asarray(o) for o in operands]
+    compiled = jax.jit(fn).lower(*concrete).compile()
+    return lambda: compiled(*concrete)
+
+
+def _measure(fn: Callable[[], object], iters: int = 4) -> float:
+    """Seconds per call by slope (two windows — the per-window sync/RTT
+    constant cancels; see bench.py's methodology notes)."""
+    import numpy as np
+
+    def window(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn()
+        np.asarray(jax_leaf(out))
+        return time.perf_counter() - t0
+
+    def jax_leaf(o):
+        import jax
+        return jax.tree_util.tree_leaves(o)[0]
+
+    window(1)  # warm (compile)
+    for _ in range(2):
+        t1 = window(iters)
+        t2 = window(3 * iters)
+        slope = (t2 - t1) / (2 * iters)
+        if slope > 0:
+            return slope
+    # two non-positive slopes: the measurement is noise (loaded host) —
+    # treat the candidate as failed rather than crowning it infinitely fast
+    raise RuntimeError("unstable timing (non-positive slope)")
+
+
+def autotune(op: str, signature: Tuple, candidates: Iterable,
+             build_measure: Callable[[object], Callable[[], object]],
+             default):
+    """Return the best candidate for ``(op, signature)``.
+
+    Cache hit: the stored winner. Miss with tuning DISABLED (the default):
+    ``default``, uncached (enabling the flag later still sweeps). Miss with
+    ``FLAGS_use_autotune``: measure every candidate —
+    ``build_measure(cand)`` returns a zero-arg callable executing the
+    kernel at this signature — keep the fastest, cache it. A candidate
+    that fails to build/run is skipped (illegal tile shapes lose, not
+    crash)."""
+    from paddle_tpu.core.flags import flag
+
+    if not flag("use_autotune"):
+        # flag off means hand-swept defaults, FULL STOP — a cache file
+        # from an earlier tuned run must not silently win an A/B debug
+        return default
+    cache = AutoTuneCache.instance()
+    key = (op,) + tuple(signature)
+    got = cache.lookup(key)
+    if got is not None:
+        return got
+    try:
+        import jax
+        multi_host = jax.process_count() > 1
+    except Exception:
+        multi_host = False
+    if multi_host:
+        # independent per-host sweeps would cache DIFFERENT winners on
+        # timing noise, and the hosts would then trace divergent SPMD
+        # programs that deadlock at the first collective. Multi-host jobs
+        # consume a pre-warmed PADDLE_AUTOTUNE_CACHE (tuned single-host)
+        # or the defaults — never a local sweep.
+        return default
+    best, best_t = default, float("inf")
+    # builders use aot_runner(), so measurement executes on device even
+    # when this sweep fires inside an enclosing trace — the trace only
+    # ever sees the chosen constants
+    for cand in candidates:
+        try:
+            fn = build_measure(cand)
+            dt = _measure(fn)
+        except Exception:
+            continue
+        if dt < best_t:
+            best, best_t = cand, dt
+    if best_t == float("inf"):
+        # every candidate failed (transient OOM, loaded host): do NOT
+        # cache — a later call deserves a real sweep
+        return default
+    cache.put(key, best)
+    return best

@@ -75,6 +75,91 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# candidate (block_q, block_k) pairs for the runtime autotuner; the
+# hand-swept default stays first so a sweep that ties keeps it
+_BLOCK_CANDIDATES = [(1024, 1024), (512, 512), (512, 1024), (1024, 512),
+                     (2048, 1024), (256, 1024), (1024, 256)]
+
+
+def _auto_blocks(b, sq, sk, d, hq, hkv, dtype, causal, bias_kind, has_seg,
+                 has_drop):
+    """(block_q, block_k) for this call signature: the hand-swept default,
+    or — with ``FLAGS_use_autotune`` — the winner of an on-chip sweep over
+    ``_BLOCK_CANDIDATES``, measured once per signature with synthetic
+    operands (fwd+bwd, the full kernel trio) and cached (the reference's
+    ``AutoTuneBase::Run`` + ``AutoTuneCache`` shape, phi/kernels/autotune).
+
+    ``bias_kind``: None | "row" (a [.., 1, Sk] key-padding mask — streams
+    uncapped) | "full" (full-tile bias — block sizes get the _BIAS_BLOCK
+    cap). The two kinds tile differently, so they are distinct signatures
+    and the synthetic bias reproduces the caller's kind; candidates are
+    deduped AFTER clamping so a short sequence never times the same
+    effective tiling twice.
+    """
+    default = (_DEF_BLOCK_Q, _DEF_BLOCK_K)
+    if _interpret():
+        return default  # interpret mode: timing a sweep is meaningless
+    from paddle_tpu.core.flags import flag
+    if not flag("use_autotune"):
+        # fast exit BEFORE any candidate bookkeeping: the default path
+        # (eager dispatch included) must not pay for a disabled feature
+        return default
+    from .autotune import autotune
+
+    sig = (b, sq, sk, d, hq, hkv, dtype, causal, bias_kind, has_seg,
+           has_drop)
+
+    def effective(cand):
+        bq, bk = cand
+        if bias_kind == "full":
+            bq, bk = min(bq, _BIAS_BLOCK), min(bk, _BIAS_BLOCK)
+        return (_pick_block(bq, sq), _pick_block(bk, sk))
+
+    seen, cands = set(), []
+    for cand in _BLOCK_CANDIDATES:
+        eff = effective(cand)
+        if sq % eff[0] or sk % eff[1] or eff in seen:
+            continue
+        if eff[0] > _MAX_BLOCK or eff[1] > _MAX_BLOCK:
+            # the shape forces seq-sized tiles beyond VMEM — let the
+            # normal path raise its cheap early error instead of paying
+            # (and re-paying: failures are uncached) doomed Mosaic
+            # compiles in the sweep
+            continue
+        seen.add(eff)
+        cands.append(eff)
+
+    def build(cand):
+        from .autotune import aot_runner
+        bq, bk = cand
+        # operands created CONCRETE even under an enclosing trace
+        # (ensure_compile_time_eval), committed to device once by the
+        # aot_runner
+        with jax.ensure_compile_time_eval():
+            dt = jnp.dtype(dtype)
+            q0 = jnp.zeros((b, hq, sq, d), dt)
+            k0 = jnp.zeros((b, hkv, sk, d), dt)
+            v0 = jnp.zeros((b, hkv, sk, d), dt)
+            kw = dict(causal=causal, block_q=bq, block_k=bk)
+            if bias_kind == "row":
+                kw["bias"] = jnp.zeros((1, 1, 1, sk), jnp.float32)
+            elif bias_kind == "full":
+                kw["bias"] = jnp.zeros((1, 1, sq, sk), jnp.float32)
+            if has_seg:
+                kw["q_segment_ids"] = jnp.zeros((b, sq), jnp.int32)
+                kw["kv_segment_ids"] = jnp.zeros((b, sk), jnp.int32)
+            if has_drop:
+                kw["dropout_p"] = 0.1
+                kw["dropout_seed"] = jnp.zeros((1,), jnp.int32)
+
+        return aot_runner(jax.value_and_grad(
+            lambda qa, ka, va: flash_attention_bhsd(
+                qa, ka, va, **kw).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)), q0, k0, v0)
+
+    return autotune("flash_attention", sig, cands, build, default)
+
+
 def _compiler_params():
     sem = ("parallel", "parallel", "arbitrary")
     try:
@@ -679,7 +764,7 @@ def _norm_seg(seg, b, s, name):
 def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
                          q_segment_ids=None, kv_segment_ids=None,
                          dropout_p=0.0, dropout_seed=None,
-                         block_q=_DEF_BLOCK_Q, block_k=_DEF_BLOCK_K):
+                         block_q=None, block_k=None):
     """Flash attention on arrays in [B, H, S, D] (or [BH, S, D]) layout.
 
     GQA: 4-D ``k``/``v`` may carry fewer heads than ``q`` (``Hq % Hkv == 0``)
@@ -714,6 +799,27 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
         sq, sk, d = q.shape[1], k.shape[1], q.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    # validate BEFORE block resolution: an invalid call must fail in
+    # microseconds, not after a ~24 s autotune sweep
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("segment ids must be given for both q and kv")
+    dropout_p = float(dropout_p)
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "dropout_p > 0 requires dropout_seed (an int or int32 "
+            "array) so forward and recompute-backward agree")
+    if block_q is None or block_k is None:
+        bias_kind = None
+        if bias is not None:
+            rows = bias.shape[-2] if bias.ndim >= 2 else 1
+            bias_kind = "row" if rows == 1 else "full"
+        tq, tk = _auto_blocks(b, sq, sk, d, hq, hkv, str(q.dtype), causal,
+                              bias_kind, q_segment_ids is not None,
+                              dropout_p > 0.0)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     bias_bh = None
     if bias is not None:
         bias, bias_bh = _norm_bias(bias, b, hq, sq, sk)
@@ -738,21 +844,12 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
             f"(forced blocks ({block_q}, {block_k}) exceed {_MAX_BLOCK}); "
             "pad the sequence to a multiple of 128")
 
-    if (q_segment_ids is None) != (kv_segment_ids is None):
-        raise ValueError("segment ids must be given for both q and kv")
     q_seg = kv_seg = None
     if q_segment_ids is not None:
         q_seg = _norm_seg(q_segment_ids, b, sq, "q_segment_ids")
         kv_seg = _norm_seg(kv_segment_ids, b, sk, "kv_segment_ids")
-    dropout_p = float(dropout_p)
-    if not 0.0 <= dropout_p < 1.0:
-        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
     seed = None
     if dropout_p > 0.0:
-        if dropout_seed is None:
-            raise ValueError(
-                "dropout_p > 0 requires dropout_seed (an int or int32 "
-                "array) so forward and recompute-backward agree")
         seed = jnp.atleast_1d(jnp.asarray(dropout_seed)).astype(
             jnp.int32)[:1]
 
@@ -768,7 +865,7 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None, bias=None,
 def flash_attention_bshd(query, key, value, causal=False, sm_scale=None,
                          bias=None, q_segment_ids=None, kv_segment_ids=None,
                          dropout_p=0.0, dropout_seed=None,
-                         block_q=_DEF_BLOCK_Q, block_k=_DEF_BLOCK_K):
+                         block_q=None, block_k=None):
     """Flash attention with paddle's [batch, seq, heads, head_dim] layout,
     Tensor-in/Tensor-out, recorded on the autograd tape. ``key``/``value``
     may carry fewer heads (GQA) and a different sequence length (cross
