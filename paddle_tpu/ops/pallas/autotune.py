@@ -64,15 +64,26 @@ class AutoTuneCache:
         path = os.environ.get("PADDLE_AUTOTUNE_CACHE")
         if path:
             try:
-                # atomic replace: a concurrent reader/interrupted writer
-                # must never see a torn file (which the loader would
-                # silently discard, losing every persisted winner)
+                # merge-then-replace: re-read the file so concurrent
+                # processes sharing the cache don't erase each other's
+                # winners from stale snapshots (last-writer-wins only per
+                # KEY), and write atomically so a reader never sees a
+                # torn file (which the loader would silently discard)
+                merged = dict(self._store)
+                try:
+                    with open(path) as f:
+                        for k, v in json.load(f).items():
+                            merged.setdefault(
+                                tuple(json.loads(k)),
+                                tuple(v) if isinstance(v, list) else v)
+                except Exception:
+                    pass
                 tmp = f"{path}.tmp.{os.getpid()}"
                 with open(tmp, "w") as f:
                     json.dump({json.dumps(list(k)):
                                list(v) if isinstance(v, (tuple, list))
                                else v
-                               for k, v in self._store.items()}, f)
+                               for k, v in merged.items()}, f)
                 os.replace(tmp, path)
             except Exception:
                 pass

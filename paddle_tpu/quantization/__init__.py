@@ -16,8 +16,11 @@ from .observers import AbsmaxObserver  # noqa: F401
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 from .wrapper import QuantedLinear, QuantedConv2D  # noqa: F401
+from .int8 import (  # noqa: F401
+    Int8Linear, Int8Conv2D, convert_to_int8,
+)
 
 __all__ = ["QuantConfig", "SingleLayerConfig", "QuanterFactory", "quanter",
            "BaseQuanter", "BaseObserver", "FakeQuanterWithAbsMaxObserver",
            "AbsmaxObserver", "QAT", "PTQ", "QuantedLinear",
-           "QuantedConv2D"]
+           "QuantedConv2D", "Int8Linear", "Int8Conv2D", "convert_to_int8"]

@@ -170,3 +170,17 @@ def test_unstable_timing_rejected(monkeypatch):
     got = at.autotune("op", (9,), ["noisy", "steady"],
                       lambda c_: (lambda: c_), default="noisy")
     assert got == "steady"
+
+
+def test_concurrent_put_merges_file(tmp_path, monkeypatch):
+    """Two processes sharing PADDLE_AUTOTUNE_CACHE must not erase each
+    other's winners from stale snapshots (review regression)."""
+    path = tmp_path / "shared.json"
+    monkeypatch.setenv("PADDLE_AUTOTUNE_CACHE", str(path))
+    a = at.AutoTuneCache()   # loads empty
+    b = at.AutoTuneCache()   # loads empty (simulates a second process)
+    a.put(("op_a", 1), (512, 512))
+    b.put(("op_b", 2), 16)   # b's snapshot lacks op_a; merge must keep it
+    c = at.AutoTuneCache()
+    assert c.lookup(("op_a", 1)) == (512, 512)
+    assert c.lookup(("op_b", 2)) == 16

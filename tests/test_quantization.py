@@ -276,3 +276,176 @@ class TestReviewRegressions:
         gc.collect()
         asp_mod._prune_dead(asp_mod._param_masks)
         assert len(asp_mod._param_masks) == before
+
+
+class TestInt8Execution:
+    """TRUE int8 compute (reference executes int8 in its TensorRT
+    inference engines; here XLA's s8xs8->s32 dot): converted models hold
+    int8 weights and match the fake-quant simulation."""
+
+    def _deployed(self, seed=5):
+        pt.seed(seed)
+        rng = np.random.RandomState(seed)
+        model = Net()
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        for _ in range(4):
+            observed(pt.to_tensor(rng.randn(16, 8).astype(np.float32)))
+        return model, ptq.convert(observed), rng
+
+    def test_int8_matches_fake_quant_simulation(self):
+        from paddle_tpu.quantization import convert_to_int8, Int8Linear
+        import jax.numpy as jnp
+
+        _, deployed, rng = self._deployed()
+        int8_model = convert_to_int8(deployed)
+        assert isinstance(int8_model.fc1, Int8Linear)
+        assert int8_model.fc1.w_q.data.dtype == jnp.int8
+        x = rng.randn(16, 8).astype(np.float32)
+        sim = deployed(pt.to_tensor(x)).numpy()
+        got = int8_model(pt.to_tensor(x)).numpy()
+        # int32 accumulation vs f32 simulation of the same grid: exact
+        # while products fit f32 (K=8 here)
+        np.testing.assert_allclose(got, sim, rtol=1e-5, atol=1e-5)
+
+    def test_int8_close_to_fp32(self):
+        from paddle_tpu.quantization import convert_to_int8
+
+        model, deployed, rng = self._deployed(seed=6)
+        int8_model = convert_to_int8(deployed)
+        x = rng.randn(16, 8).astype(np.float32)
+        ref = model(pt.to_tensor(x)).numpy()
+        got = int8_model(pt.to_tensor(x)).numpy()
+        assert np.abs(ref - got).mean() < 0.1 * np.abs(ref).mean() + 0.05
+
+    def test_int8_conv(self):
+        from paddle_tpu.quantization import convert_to_int8, Int8Conv2D
+        import paddle_tpu.nn as nn
+
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 8, 3, padding=1)
+
+            def forward(self, x):
+                return self.conv(x)
+
+        pt.seed(7)
+        rng = np.random.RandomState(7)
+        model = ConvNet()
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        for _ in range(3):
+            observed(pt.to_tensor(rng.randn(2, 3, 8, 8)
+                                  .astype(np.float32)))
+        deployed = ptq.convert(observed)
+        int8_model = convert_to_int8(deployed)
+        assert isinstance(int8_model.conv, Int8Conv2D)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        sim = deployed(pt.to_tensor(x)).numpy()
+        got = int8_model(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, sim, rtol=1e-4, atol=1e-4)
+
+    def test_uncalibrated_raises(self):
+        from paddle_tpu.quantization import convert_to_int8
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization.wrapper import QuantedLinear
+        from paddle_tpu.quantization.config import SingleLayerConfig
+
+        lin = nn.Linear(4, 4)
+        quanted = QuantedLinear(lin, SingleLayerConfig(
+            FakeQuanterWithAbsMaxObserver(), FakeQuanterWithAbsMaxObserver()))
+
+        class Holder(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.q = quanted
+
+            def forward(self, x):
+                return self.q(x)
+
+        with pytest.raises(ValueError, match="calibrated|scales"):
+            convert_to_int8(Holder())
+
+    def test_int8_exports_through_jit_save(self, tmp_path):
+        """int8 deployment composes with the inference stack: the int8
+        weights export as constants in the saved program and the
+        Predictor serves them (the reference's TRT-engine-with-int8
+        analog: calibrate -> convert -> serialize -> serve)."""
+        from paddle_tpu.quantization import convert_to_int8
+
+        _, deployed, rng = self._deployed(seed=8)
+        int8_model = convert_to_int8(deployed)
+        x = rng.randn(4, 8).astype(np.float32)
+        want = int8_model(pt.to_tensor(x)).numpy()
+
+        path = str(tmp_path / "int8_model")
+        pt.jit.save(int8_model, path,
+                    input_spec=[pt.static.InputSpec([4, 8], "float32")])
+        from paddle_tpu import inference
+        cfg = inference.Config(path)
+        pred = inference.create_predictor(cfg)
+        got = pred.run([x])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_int8_conv_padding_forms_and_nhwc(self):
+        """Conv2D padding variants ([h, w] lists, flat pairs) and NHWC
+        layouts survive int8 conversion (review regressions)."""
+        from paddle_tpu.quantization import convert_to_int8
+        import paddle_tpu.nn as nn
+
+        for pad, fmt in [([1, 2], "NCHW"), (1, "NHWC")]:
+            class ConvNet(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.conv = nn.Conv2D(3, 4, 3, padding=pad,
+                                          data_format=fmt)
+
+                def forward(self, x):
+                    return self.conv(x)
+
+            pt.seed(9)
+            rng = np.random.RandomState(9)
+            model = ConvNet()
+            shape = (2, 3, 8, 8) if fmt == "NCHW" else (2, 8, 8, 3)
+            cfg = QuantConfig(activation=AbsmaxObserver(),
+                              weight=FakeQuanterWithAbsMaxObserver())
+            ptq = PTQ(cfg)
+            observed = ptq.quantize(model)
+            for _ in range(3):
+                observed(pt.to_tensor(rng.randn(*shape)
+                                      .astype(np.float32)))
+            deployed = ptq.convert(observed)
+            int8_model = convert_to_int8(deployed)
+            x = rng.randn(*shape).astype(np.float32)
+            sim = deployed(pt.to_tensor(x)).numpy()
+            got = int8_model(pt.to_tensor(x)).numpy()
+            np.testing.assert_allclose(got, sim, rtol=1e-4, atol=1e-4,
+                                       err_msg=f"pad={pad} fmt={fmt}")
+
+    def test_int8_distinct_weight_bits(self):
+        """4-bit weight quanter + 8-bit activations: the int path must use
+        each quanter's own bound (review regression)."""
+        from paddle_tpu.quantization import convert_to_int8
+
+        pt.seed(11)
+        rng = np.random.RandomState(11)
+        model = Net()
+        cfg = QuantConfig(
+            activation=AbsmaxObserver(),
+            weight=FakeQuanterWithAbsMaxObserver(bit_length=4))
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        for _ in range(4):
+            observed(pt.to_tensor(rng.randn(16, 8).astype(np.float32)))
+        deployed = ptq.convert(observed)
+        int8_model = convert_to_int8(deployed)
+        assert int8_model.fc1.w_bits == 4 and int8_model.fc1.x_bits == 8
+        x = rng.randn(16, 8).astype(np.float32)
+        sim = deployed(pt.to_tensor(x)).numpy()
+        got = int8_model(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, sim, rtol=1e-5, atol=1e-5)
