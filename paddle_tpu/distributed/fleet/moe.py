@@ -220,26 +220,23 @@ class MoELayer(Layer):
             logits = xt @ gw  # [T, E]
             probs = jax.nn.softmax(logits, axis=-1)
 
-            # top-k selection, sequential GShard style: pick expert k,
-            # mask it out, pick the next. Positions (running count within
-            # each expert, accumulated across picks) define the capacity
-            # drop rule — shared verbatim by both dispatch formulations.
-            remaining = probs
-            position_base = jnp.zeros((E,), jnp.int32)
+            # top-k selection, vectorized but ORDER-IDENTICAL to the
+            # sequential GShard argmax-and-mask walk: lax.top_k returns
+            # descending picks with first-index tie-breaks (same winner
+            # sequence), and ONE pick-major [K*T, E] cumsum reproduces the
+            # running per-expert counts the K-pass loop accumulated — so
+            # capacity drops stay bit-identical while K argmax+mask+cumsum
+            # sweeps collapse into one top_k and one cumsum.
             me = probs.mean(axis=0)  # mean gate prob per expert
-            ce_acc = jnp.zeros((E,), probs.dtype)
-            picks = []  # (expert idx [T], gate_val [T], pos [T], keep [T])
-            for _ in range(K):
-                idx = jnp.argmax(remaining, axis=-1)  # [T]
-                onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)  # [T, E]
-                ce_acc = ce_acc + onehot.mean(axis=0).astype(probs.dtype)
-                pos = jnp.cumsum(onehot, axis=0) - 1 + position_base[None, :]
-                position_base = position_base + onehot.sum(axis=0)
-                pos_t = (pos * onehot).sum(axis=-1)  # [T]
-                keep = pos_t < C
-                gate_val = (probs * onehot).sum(axis=-1)  # [T]
-                picks.append((idx, gate_val, pos_t, keep))
-                remaining = remaining * (1 - onehot.astype(probs.dtype))
+            gate_k, idx_k = jax.lax.top_k(probs, K)  # [T, K] descending
+            oh_flat = jax.nn.one_hot(
+                jnp.swapaxes(idx_k, 0, 1).reshape(K * T), E,
+                dtype=jnp.int32)  # [K*T, E], pick-major order
+            pos_flat = jnp.cumsum(oh_flat, axis=0) - 1
+            pos_km = (pos_flat * oh_flat).sum(-1).reshape(K, T)
+            ce_acc = (oh_flat.sum(axis=0).astype(probs.dtype) / T)
+            picks = [(idx_k[:, k], gate_k[:, k], pos_km[k],
+                      pos_km[k] < C) for k in range(K)]
 
             # renormalize gates over the KEPT assignments (dense path
             # normalized the combine tensor — same entries)

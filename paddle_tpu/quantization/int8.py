@@ -33,11 +33,15 @@ __all__ = ["Int8Linear", "Int8Conv2D", "convert_to_int8", "quantize_arr"]
 
 def quantize_arr(x, scale: float, bits: int = 8):
     """f32 array -> (int8 array) with the fake-quant grid:
-    q = clip(round(x·bound/s), ±bound), dequant step s/bound."""
+    q = clip(round(x/s·bound), ±bound), dequant step s/bound. The
+    expression ASSOCIATES exactly like quanters.fake_quant_ste
+    (round(x / s * bound)) — a pre-divided bound/s factor can flip
+    round() by one step near .5 boundaries and break bit-identity with
+    the simulation."""
     import jax.numpy as jnp
     bound = float(2 ** (bits - 1) - 1)
     s = max(float(scale), 1e-9)
-    return jnp.clip(jnp.round(x * (bound / s)), -bound,
+    return jnp.clip(jnp.round(x / s * bound), -bound,
                     bound).astype(jnp.int8)
 
 
@@ -105,8 +109,11 @@ def _norm2(v):
 
 
 def _norm_pad(padding):
-    """Conv2D padding forms -> lax (low, high) pairs: int, [h, w],
-    flat [h_lo, h_hi, w_lo, w_hi] (same rules as F.conv2d's _conv_nd)."""
+    """Conv2D padding forms -> lax padding: 'SAME'/'VALID' pass through
+    (lax accepts them), int, [h, w], flat [h_lo, h_hi, w_lo, w_hi] (same
+    rules as F.conv2d's _conv_nd)."""
+    if isinstance(padding, str):
+        return padding.upper()
     if isinstance(padding, int):
         return [(padding, padding)] * 2
     p = [int(i) for i in padding]

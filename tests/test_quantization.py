@@ -449,3 +449,34 @@ class TestInt8Execution:
         sim = deployed(pt.to_tensor(x)).numpy()
         got = int8_model(pt.to_tensor(x)).numpy()
         np.testing.assert_allclose(got, sim, rtol=1e-5, atol=1e-5)
+
+    def test_int8_conv_same_padding(self):
+        """String padding ('SAME') passes through to lax (review
+        regression)."""
+        from paddle_tpu.quantization import convert_to_int8
+        import paddle_tpu.nn as nn
+
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 3, padding="SAME")
+
+            def forward(self, x):
+                return self.conv(x)
+
+        pt.seed(13)
+        rng = np.random.RandomState(13)
+        model = ConvNet()
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        for _ in range(3):
+            observed(pt.to_tensor(rng.randn(2, 3, 8, 8)
+                                  .astype(np.float32)))
+        deployed = ptq.convert(observed)
+        int8_model = convert_to_int8(deployed)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        sim = deployed(pt.to_tensor(x)).numpy()
+        got = int8_model(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, sim, rtol=1e-4, atol=1e-4)
