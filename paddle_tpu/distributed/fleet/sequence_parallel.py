@@ -188,26 +188,36 @@ def _ring_flash_arrays(q, k, v, mesh, axis, causal, sm_scale):
 
         def ring_fwd(qk, kk, vk):
             # step 0 is always the resident (diagonal) shard; the output
-            # accumulator is f32 until the final cast
+            # accumulator is f32 until the final cast. Steps 1..n-1 are
+            # IDENTICAL non-causal kernels, so they run as ONE lax.scan
+            # body — program size and compile time stay O(1) in ring size
+            # (a python unroll at sp=64+ would emit hundreds of kernels)
             o, lse = fwd_block(qk, kk, vk, causal)
             o = o.astype(jnp.float32)
-            kc, vc = kk, vk
-            for s in range(1, n):
+
+            def step(carry, s):
+                o_, lse_, kc, vc = carry
                 kc = jax.lax.ppermute(kc, axis, perm)
                 vc = jax.lax.ppermute(vc, axis, perm)
                 if causal:
                     # src = rank - s (mod n) is a PAST shard iff rank >= s
                     def hit(args):
-                        o_, lse_, kc_, vc_ = args
+                        oo, ll, kc_, vc_ = args
                         o_s, lse_s = fwd_block(qk, kc_, vc_, False)
-                        return merge(o_, lse_, o_s, lse_s)
+                        return merge(oo, ll, o_s, lse_s)
 
-                    o, lse = jax.lax.cond(
+                    o_, lse_ = jax.lax.cond(
                         rank >= s, hit,
-                        lambda args: (args[0], args[1]), (o, lse, kc, vc))
+                        lambda args: (args[0], args[1]),
+                        (o_, lse_, kc, vc))
                 else:
                     o_s, lse_s = fwd_block(qk, kc, vc, False)
-                    o, lse = merge(o, lse, o_s, lse_s)
+                    o_, lse_ = merge(o_, lse_, o_s, lse_s)
+                return (o_, lse_, kc, vc), None
+
+            if n > 1:
+                (o, lse, _, _), _ = jax.lax.scan(
+                    step, (o, lse, kk, vk), jnp.arange(1, n))
             return o, lse
 
         @jax.custom_vjp
@@ -227,31 +237,37 @@ def _ring_flash_arrays(q, k, v, mesh, axis, causal, sm_scale):
             dq = zq + dq_s
             dk_acc = zk + dk_s
             dv_acc = zk + dv_s
-            kc, vc = kk, vk
-            for s in range(1, n):
+
+            def step(carry, s):
+                dq_, dka, dva, kc, vc = carry
                 kc = jax.lax.ppermute(kc, axis, perm)
                 vc = jax.lax.ppermute(vc, axis, perm)
                 # dk/dv accumulators ride the SAME ring so each
                 # contribution lands on its shard's row; after the full n
                 # rotations they are home again
-                dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
-                dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+                dka = jax.lax.ppermute(dka, axis, perm)
+                dva = jax.lax.ppermute(dva, axis, perm)
                 if causal:
                     def hit(args):
-                        dq_, dka_, dva_, kc_, vc_ = args
-                        g_q, g_k, g_v = bwd_block(qk, kc_, vc_, o, lse, do,
-                                                  False)
-                        return dq_ + g_q, dka_ + g_k, dva_ + g_v
+                        d_, ka_, va_, kc_, vc_ = args
+                        g_q, g_k, g_v = bwd_block(qk, kc_, vc_, o, lse,
+                                                  do, False)
+                        return d_ + g_q, ka_ + g_k, va_ + g_v
 
-                    dq, dk_acc, dv_acc = jax.lax.cond(
+                    dq_, dka, dva = jax.lax.cond(
                         rank >= s, hit, lambda args: args[:3],
-                        (dq, dk_acc, dv_acc, kc, vc))
+                        (dq_, dka, dva, kc, vc))
                 else:
                     g_q, g_k, g_v = bwd_block(qk, kc, vc, o, lse, do,
                                               False)
-                    dq = dq + g_q
-                    dk_acc = dk_acc + g_k
-                    dv_acc = dv_acc + g_v
+                    dq_ = dq_ + g_q
+                    dka = dka + g_k
+                    dva = dva + g_v
+                return (dq_, dka, dva, kc, vc), None
+
+            if n > 1:
+                (dq, dk_acc, dv_acc, _, _), _ = jax.lax.scan(
+                    step, (dq, dk_acc, dv_acc, kk, vk), jnp.arange(1, n))
             # one final rotation completes the cycle (n rotations total)
             dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
             dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
@@ -267,6 +283,20 @@ def _ring_flash_arrays(q, k, v, mesh, axis, causal, sm_scale):
     # which the checker (not the semantics) rejects inside shard_map
     return jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _ring_flash_tileable(S: int, n: int) -> bool:
+    """True when the per-rank shard length admits a VMEM-legal kernel
+    tiling (the auto path falls back to the jnp composite otherwise, so
+    flipping the default can never reject a previously-working shape)."""
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    if S % n:
+        return False
+    local = S // n
+    bq = fa._pick_block(fa._DEF_BLOCK_Q, local)
+    bk = fa._pick_block(fa._DEF_BLOCK_K, local)
+    return (local % bq == 0 and local % bk == 0
+            and bq <= fa._MAX_BLOCK and bk <= fa._MAX_BLOCK)
 
 
 def ring_attention(query, key, value, mesh=None, axis: str = "sp",
@@ -289,7 +319,10 @@ def ring_attention(query, key, value, mesh=None, axis: str = "sp",
         d = query.shape[-1]
         sm_scale = 1.0 / math.sqrt(d)
     if use_flash is None:
-        use_flash = _jax.default_backend() == "tpu"
+        # auto mode must not NARROW accepted shapes vs the composite:
+        # only take the kernel path when the per-rank shard tiles
+        use_flash = _jax.default_backend() == "tpu" and \
+            _ring_flash_tileable(query.shape[1], mesh.shape[axis])
     impl = _ring_flash_arrays if use_flash else _ring_attention_arrays
     return apply_op(
         lambda q, k, v: impl(q, k, v, mesh, axis, causal, sm_scale),

@@ -99,14 +99,26 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
     nl = cfg.num_hidden_layers
     n_kv = cfg.num_key_value_heads
     hd = cfg.hidden_size // cfg.num_attention_heads
-    embed_name = next(n for n in st if n.endswith("embed_tokens.weight"))
+    embed_name = next(n for n in st if "embed_tokens" in n
+                      and n.endswith("weight"))
     dtype = st[embed_name].dtype
+
+    # family seam: llama keeps the trunk at model.model + a _logits
+    # projector; the MoE LM's cached forward lives on the top Layer with
+    # an lm_head — serve both through the same compiled loop
+    backbone = getattr(model, "model", None)
+    if backbone is None or not callable(backbone):
+        backbone = model
+    if hasattr(model, "_logits"):
+        project = model._logits
+    else:
+        project = model.lm_head
 
     def run_model(stt, toks, caches):
         tens = [tuple(Tensor(a) for a in c) for c in caches]
         with no_grad(), swap_state(model, stt, collect_buffers=False):
-            h, new_c = model.model(Tensor(toks), caches=tens)
-            logits = model._logits(h[:, -1:, :])
+            h, new_c = backbone(Tensor(toks), caches=tens)
+            logits = project(h[:, -1:, :])
         return logits.data, [tuple(t.data for t in c) for c in new_c]
 
     def pick(logits, finished, key):

@@ -199,3 +199,20 @@ class MoeForCausalLM(nn.Layer):
 
         return generate_loop(prefill, decode, input_ids, max_new_tokens,
                              temperature, top_k, top_p, eos_token_id)
+
+    def generate_compiled(self, input_ids, max_new_tokens: int = 32,
+                          temperature: float = 0.0, top_k: int = 0,
+                          top_p: float = 1.0, eos_token_id=None):
+        """Whole-loop compiled generation over static KV buffers (see
+        ``generation.compiled_generate``); greedy output is
+        token-for-token equal to ``generate``."""
+        from .generation import compiled_generate
+        out = compiled_generate(self, input_ids, max_new_tokens,
+                                temperature, top_k, top_p, eos_token_id)
+        # tracing the loop stored TRACERS in every MoE layer's l_aux (the
+        # balance loss only means something in training forward passes);
+        # clear them so a later aux_loss() can't touch an escaped tracer
+        for layer in self.layers:
+            if hasattr(layer.mlp, "l_aux"):
+                layer.mlp.l_aux = None
+        return out

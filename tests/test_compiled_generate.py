@@ -97,3 +97,46 @@ def test_compiled_cache_reused():
     assert len(m.__dict__["_compiled_generate"]) == 1  # same signature
     m.generate_compiled(ids, max_new_tokens=6)
     assert len(m.__dict__["_compiled_generate"]) == 2
+
+
+def test_moe_compiled_equals_eager_greedy():
+    """The MoE family rides the same compiled loop (its cached forward
+    lives on the top Layer with an lm_head — the family seam)."""
+    from paddle_tpu.models.moe import MoeConfig, MoeForCausalLM
+
+    pt.seed(3)
+    cfg = MoeConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                    moe_intermediate_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, num_key_value_heads=2,
+                    num_experts=4, num_experts_per_tok=2,
+                    num_shared_experts=1, first_k_dense_replace=1)
+    m = MoeForCausalLM(cfg)
+    m.eval()
+    ids = pt.to_tensor(np.random.RandomState(0).randint(
+        0, 128, (2, 8)).astype(np.int64))
+    eager = m.generate(ids, max_new_tokens=8, temperature=0.0)
+    comp = m.generate_compiled(ids, max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(comp.numpy(), eager.numpy())
+
+
+def test_moe_aux_loss_usable_after_compiled_generate():
+    """Tracing the compiled loop must not leave escaped tracers in
+    layer.mlp.l_aux (review regression: aux_loss() after generation)."""
+    from paddle_tpu.models.moe import MoeConfig, MoeForCausalLM
+
+    pt.seed(4)
+    cfg = MoeConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                    moe_intermediate_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, num_key_value_heads=2,
+                    num_experts=4, num_experts_per_tok=2,
+                    num_shared_experts=0, first_k_dense_replace=0)
+    m = MoeForCausalLM(cfg)
+    m.eval()
+    ids = pt.to_tensor(np.random.RandomState(0).randint(
+        0, 64, (1, 6)).astype(np.int64))
+    m.generate_compiled(ids, max_new_tokens=4)
+    assert m.aux_loss() is None  # cleared, not an escaped tracer
+    # a fresh eager forward restores a REAL aux loss
+    m(ids, labels=ids)
+    aux = m.aux_loss()
+    assert aux is not None and np.isfinite(float(aux.numpy()))
