@@ -65,7 +65,8 @@ def generate_loop(prefill, decode, input_ids, max_new_tokens: int = 32,
 
 def compiled_generate(model, input_ids, max_new_tokens: int = 32,
                       temperature: float = 0.0, top_k: int = 0,
-                      top_p: float = 1.0, eos_token_id=None) -> Tensor:
+                      top_p: float = 1.0, eos_token_id=None,
+                      prefill_chunk: int = 0) -> Tensor:
     """The WHOLE generate loop as one compiled program.
 
     Prefill + ``max_new_tokens`` decode steps run inside a single jit:
@@ -83,6 +84,13 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
     full budget always runs (pass a sensible ``max_new_tokens``).
     Compiled executables are cached on the model per
     (batch, prompt_len, budget, sampling-config) signature.
+
+    ``prefill_chunk > 0`` processes the prompt in chunks of that size
+    through the same static KV cache (the attention's offset-causal mask
+    covers chunked prefill natively): peak prefill attention memory drops
+    from O(S·L) scores to O(chunk·L) — the long-prompt serving shape. The
+    prompt length must divide evenly; outputs are identical to one-shot
+    prefill.
     """
     from paddle_tpu.jit.functional import functional_state, swap_state
 
@@ -130,11 +138,33 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
             finished = finished | (nxt == eos_token_id)
         return nxt, finished
 
+    if prefill_chunk:
+        if prefill_chunk <= 0 or S % prefill_chunk:
+            raise ValueError(
+                f"prefill_chunk {prefill_chunk} must divide the prompt "
+                f"length {S}")
+        if prefill_chunk >= S:
+            prefill_chunk = 0  # one-shot: share that executable
+
     def whole(stt, ids, key):
         caches = [(jnp.zeros((B, L, n_kv, hd), dtype),
                    jnp.zeros((B, L, n_kv, hd), dtype),
                    jnp.zeros((), jnp.int32)) for _ in range(nl)]
-        logits, caches = run_model(stt, ids, caches)
+        if prefill_chunk:
+            # chunked prefill: same static cache, offset-causal per chunk
+            # (scan keeps the program O(1) in chunk count)
+            n_chunks = S // prefill_chunk
+            chunks = jnp.swapaxes(
+                ids.reshape(B, n_chunks, prefill_chunk), 0, 1)
+
+            def pre(cc, chunk):
+                lg, cc = run_model(stt, chunk, cc)
+                return cc, lg
+
+            caches, lgs = jax.lax.scan(pre, caches, chunks)
+            logits = lgs[-1]
+        else:
+            logits, caches = run_model(stt, ids, caches)
         key, sub = jax.random.split(key)
         finished = jnp.zeros((B,), bool)
         tok, finished = pick(logits, finished, sub)
@@ -156,7 +186,8 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
         return jnp.concatenate([ids, out], axis=1)
 
     sig = (B, S, mnt, float(temperature), int(top_k), float(top_p),
-           eos_token_id, str(dtype), tuple(sorted(st)))
+           eos_token_id, str(dtype), int(prefill_chunk),
+           tuple(sorted(st)))
     cache = model.__dict__.setdefault("_compiled_generate", {})
     if sig not in cache:
         cache[sig] = jax.jit(whole)

@@ -391,14 +391,16 @@ class LlamaForCausalLM(nn.Layer):
 
     def generate_compiled(self, input_ids, max_new_tokens: int = 32,
                           temperature: float = 0.0, top_k: int = 0,
-                          top_p: float = 1.0, eos_token_id=None):
+                          top_p: float = 1.0, eos_token_id=None,
+                          prefill_chunk: int = 0):
         """Whole-loop compiled generation: prefill + every decode step in
         ONE jitted program over static KV buffers (see
         ``generation.compiled_generate``). Greedy output is token-for-token
         equal to ``generate``."""
         from .generation import compiled_generate
         return compiled_generate(self, input_ids, max_new_tokens,
-                                 temperature, top_k, top_p, eos_token_id)
+                                 temperature, top_k, top_p, eos_token_id,
+                                 prefill_chunk=prefill_chunk)
 
     @staticmethod
     def flops_per_token(cfg: LlamaConfig) -> float:

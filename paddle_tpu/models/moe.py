@@ -202,13 +202,15 @@ class MoeForCausalLM(nn.Layer):
 
     def generate_compiled(self, input_ids, max_new_tokens: int = 32,
                           temperature: float = 0.0, top_k: int = 0,
-                          top_p: float = 1.0, eos_token_id=None):
+                          top_p: float = 1.0, eos_token_id=None,
+                          prefill_chunk: int = 0):
         """Whole-loop compiled generation over static KV buffers (see
         ``generation.compiled_generate``); greedy output is
         token-for-token equal to ``generate``."""
         from .generation import compiled_generate
         out = compiled_generate(self, input_ids, max_new_tokens,
-                                temperature, top_k, top_p, eos_token_id)
+                                temperature, top_k, top_p, eos_token_id,
+                                prefill_chunk=prefill_chunk)
         # tracing the loop stored TRACERS in every MoE layer's l_aux (the
         # balance loss only means something in training forward passes);
         # clear them so a later aux_loss() can't touch an escaped tracer

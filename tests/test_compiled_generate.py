@@ -140,3 +140,19 @@ def test_moe_aux_loss_usable_after_compiled_generate():
     m(ids, labels=ids)
     aux = m.aux_loss()
     assert aux is not None and np.isfinite(float(aux.numpy()))
+
+
+def test_chunked_prefill_matches_one_shot():
+    """prefill_chunk processes the prompt through the same static cache
+    in offset-causal chunks — identical tokens, O(chunk) prefill scores
+    (the long-prompt serving shape)."""
+    m = _tiny(12)
+    m.eval()
+    ids = pt.to_tensor(np.random.RandomState(7).randint(
+        0, 128, (2, 12)).astype(np.int64))
+    one = m.generate_compiled(ids, max_new_tokens=8, temperature=0.0)
+    chunked = m.generate_compiled(ids, max_new_tokens=8, temperature=0.0,
+                                  prefill_chunk=4)
+    np.testing.assert_array_equal(chunked.numpy(), one.numpy())
+    with pytest.raises(ValueError, match="divide"):
+        m.generate_compiled(ids, max_new_tokens=4, prefill_chunk=5)
