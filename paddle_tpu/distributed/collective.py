@@ -299,7 +299,12 @@ def all_gather_object(object_list, obj, group=None):
     all_gather_object). Single process: trivial. Multi-process (DCN): each
     rank publishes its pickled object to the job's TCPStore and reads the
     others — the store-backed control plane the reference implements over
-    its gloo/TCP store."""
+    its gloo/TCP store.
+
+    Non-member contract: on ranks OUTSIDE ``group`` this is a no-op and
+    ``object_list`` is left untouched (empty if passed empty) — matching
+    the reference's non-member pass-through. Symmetric caller code that
+    indexes ``object_list`` on every rank must guard on membership."""
     import pickle
     members, rank, tag = _group_members(group, "all_gather_object")
     if rank not in members:

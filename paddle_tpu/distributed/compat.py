@@ -115,7 +115,11 @@ def broadcast_object_list(object_list: list, src: int = 0, group=None):
     """Reference: communication/broadcast.py broadcast_object_list.
     Single process: the src host's objects already are everyone's objects.
     Multi-process (DCN): src publishes the pickled list to the job's
-    TCPStore, everyone else replaces their list contents in place."""
+    TCPStore, everyone else replaces their list contents in place.
+
+    Non-member contract: ranks OUTSIDE ``group`` return with
+    ``object_list`` untouched (a no-op, matching the reference) — don't
+    read the list on a non-member rank expecting broadcast contents."""
     if _single_process():
         return None
     import pickle

@@ -17,6 +17,9 @@ from paddle_tpu.core.tensor import Tensor
 
 __all__ = ["sample_token", "generate_loop", "compiled_generate"]
 
+# max live compiled_generate executables per model (LRU-evicted)
+_COMPILED_CACHE_CAP = 16
+
 
 def sample_token(step_logits, temperature: float, top_k: int,
                  top_p: float, key=None):
@@ -188,9 +191,19 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
     sig = (B, S, mnt, float(temperature), int(top_k), float(top_p),
            eos_token_id, str(dtype), int(prefill_chunk),
            tuple(sorted(st)))
-    cache = model.__dict__.setdefault("_compiled_generate", {})
-    if sig not in cache:
+    # LRU-capped executable cache: a serving loop over naturally varying
+    # prompt lengths would otherwise retain one executable per length for
+    # the model's lifetime. Callers with many distinct lengths should pad
+    # to fixed buckets (prefill_chunk makes bucketing cheap); the cap
+    # bounds memory either way.
+    from collections import OrderedDict
+    cache = model.__dict__.setdefault("_compiled_generate", OrderedDict())
+    if sig in cache:
+        cache.move_to_end(sig)
+    else:
         cache[sig] = jax.jit(whole)
+        while len(cache) > _COMPILED_CACHE_CAP:
+            cache.popitem(last=False)
     # greedy decoding draws nothing: leave the global RNG stream untouched
     # (eager generate doesn't advance it either — pipeline reproducibility)
     key = jax.random.PRNGKey(0) if temperature == 0 else G.next_key()

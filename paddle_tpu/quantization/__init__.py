@@ -12,7 +12,7 @@ from .config import QuantConfig, SingleLayerConfig  # noqa: F401
 from .factory import QuanterFactory, quanter  # noqa: F401
 from .base import BaseQuanter, BaseObserver  # noqa: F401
 from .quanters import FakeQuanterWithAbsMaxObserver  # noqa: F401
-from .observers import AbsmaxObserver  # noqa: F401
+from .observers import AbsmaxObserver, PerChannelAbsmaxObserver  # noqa: F401
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 from .wrapper import QuantedLinear, QuantedConv2D  # noqa: F401
@@ -22,5 +22,6 @@ from .int8 import (  # noqa: F401
 
 __all__ = ["QuantConfig", "SingleLayerConfig", "QuanterFactory", "quanter",
            "BaseQuanter", "BaseObserver", "FakeQuanterWithAbsMaxObserver",
-           "AbsmaxObserver", "QAT", "PTQ", "QuantedLinear",
+           "AbsmaxObserver", "PerChannelAbsmaxObserver", "QAT", "PTQ",
+           "QuantedLinear",
            "QuantedConv2D", "Int8Linear", "Int8Conv2D", "convert_to_int8"]

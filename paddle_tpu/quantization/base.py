@@ -6,7 +6,30 @@ import abc
 
 from paddle_tpu.nn import Layer
 
-__all__ = ["BaseQuanter", "BaseObserver"]
+__all__ = ["BaseQuanter", "BaseObserver", "bcast_shape", "channel_axis_of"]
+
+
+def bcast_shape(ndim: int, axis: int) -> list:
+    """Broadcast shape for a per-channel scale vector along ``axis`` of an
+    ``ndim``-d tensor — the ONE definition shared by the fake-quant
+    simulation, the observers, and the int8 execution path (drift between
+    them would desynchronize simulation from execution)."""
+    shape = [1] * ndim
+    shape[axis % ndim] = -1
+    return shape
+
+
+def channel_axis_of(quanter, what: str = "quanter") -> int:
+    """The channel axis of a quanter with 1-D scales; raises when the
+    quanter returns a vector but never declared its axis (a custom
+    @quanter extension bug that would otherwise mis-broadcast silently)."""
+    axis = quanter.quant_axis()
+    if axis is None:
+        raise ValueError(
+            f"{what} returned per-channel (1-D) scales but its "
+            "quant_axis() is None — override quant_axis() to name the "
+            "channel axis of the weight")
+    return int(axis)
 
 
 class BaseQuanter(Layer, metaclass=abc.ABCMeta):

@@ -13,6 +13,11 @@ class QuanterFactory:
         self._kwargs = kwargs
 
     def _instance(self, layer=None):
+        # per-channel quanters need the wrapped layer to infer the channel
+        # axis from the weight layout (Conv2D OIHW -> 0, Linear [in,out]
+        # -> 1); classes opt in via _wants_layer
+        if getattr(self._cls, "_wants_layer", False):
+            return self._cls(*self._args, layer=layer, **self._kwargs)
         return self._cls(*self._args, **self._kwargs)
 
     def __call__(self, *args, **kwargs):

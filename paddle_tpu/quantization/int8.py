@@ -31,29 +31,43 @@ from .wrapper import QuantedConv2D, QuantedLinear
 __all__ = ["Int8Linear", "Int8Conv2D", "convert_to_int8", "quantize_arr"]
 
 
-def quantize_arr(x, scale: float, bits: int = 8):
+def quantize_arr(x, scale, bits: int = 8, axis=None):
     """f32 array -> (int8 array) with the fake-quant grid:
     q = clip(round(x/s·bound), ±bound), dequant step s/bound. The
     expression ASSOCIATES exactly like quanters.fake_quant_ste
     (round(x / s * bound)) — a pre-divided bound/s factor can flip
     round() by one step near .5 boundaries and break bit-identity with
-    the simulation."""
+    the simulation. ``scale`` may be a per-channel vector along ``axis``
+    (broadcast against ``x``); scalar when ``axis`` is None."""
     import jax.numpy as jnp
+    from .base import bcast_shape
     bound = float(2 ** (bits - 1) - 1)
-    s = max(float(scale), 1e-9)
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-9)
+    if axis is not None and s.ndim == 1:
+        s = s.reshape(bcast_shape(x.ndim, axis))
     return jnp.clip(jnp.round(x / s * bound), -bound,
                     bound).astype(jnp.int8)
 
 
 class _Int8Base(Layer):
-    def __init__(self, w_q, w_scale: float, x_scale: float, bias,
-                 x_bits: int = 8, w_bits: int = 8):
+    def __init__(self, w_q, w_scale, x_scale: float, bias,
+                 x_bits: int = 8, w_bits: int = 8, w_axis=None):
+        """``w_scale`` is a scalar (per-tensor) or a 1-D per-output-channel
+        vector with ``w_axis`` naming the weight's channel axis (reference
+        default PTQ weight quantizer is per-channel —
+        ``quantization/imperative/ptq_quantizer.py:137``); activation
+        scales are per-tensor always."""
         super().__init__()
         import jax.numpy as jnp
-        if x_scale <= 0 or w_scale <= 0:
+        w_scale = np.asarray(w_scale, np.float32)
+        # per-channel: an individual zero scale is a legitimately pruned
+        # (all-zero) channel — clamp it like fake_quant_ste does; only a
+        # FULLY non-positive scale set means calibration never ran
+        if x_scale <= 0 or not (w_scale > 0).any():
             raise ValueError(
                 "int8 conversion needs calibrated positive scales; run "
                 "PTQ calibration (or QAT) before convert_to_int8")
+        w_scale = np.maximum(w_scale, 1e-9)
         # separate activation/weight bit widths: a 4-bit weight grid still
         # STORES as int8 (values in [-7, 7]) but dequantizes with its own
         # bound, matching the fake-quant simulation exactly
@@ -61,7 +75,8 @@ class _Int8Base(Layer):
         self.w_bits = int(w_bits)
         self._x_bound = float(2 ** (x_bits - 1) - 1)
         self._w_bound = float(2 ** (w_bits - 1) - 1)
-        self.w_scale = float(w_scale)
+        self.w_scale = float(w_scale) if w_scale.ndim == 0 else w_scale
+        self.w_axis = None if w_axis is None else int(w_axis)
         self.x_scale = float(x_scale)
         # int8 weights live as a BUFFER: frozen deployment artifact, 4x
         # smaller than f32 in HBM and checkpoints
@@ -75,13 +90,23 @@ class _Int8Base(Layer):
         return quantize_arr(x, self.x_scale, self.x_bits)
 
     @property
-    def _rescale(self) -> float:
+    def _rescale(self):
+        """Scalar, or a per-output-channel vector the forward broadcasts
+        along the output's channel axis."""
         return (self.x_scale / self._x_bound) * \
             (self.w_scale / self._w_bound)
 
 
 class Int8Linear(_Int8Base):
-    """y = dequant(s8(x) @ s8(w) -> s32) + bias, one f32 rescale."""
+    """y = dequant(s8(x) @ s8(w) -> s32) + bias, one f32 rescale
+    (per-channel: the rescale vector broadcasts over the output axis)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.w_axis is not None and self.w_axis not in (1, -1):
+            raise ValueError(
+                "Int8Linear per-channel scales must be along the OUTPUT "
+                f"axis of the [in, out] weight (axis 1), got {self.w_axis}")
 
     def forward(self, x):
         import jax
@@ -130,8 +155,13 @@ class Int8Conv2D(_Int8Base):
 
     def __init__(self, w_q, w_scale, x_scale, bias, stride, padding,
                  dilation, groups, data_format: str = "NCHW",
-                 x_bits: int = 8, w_bits: int = 8):
-        super().__init__(w_q, w_scale, x_scale, bias, x_bits, w_bits)
+                 x_bits: int = 8, w_bits: int = 8, w_axis=None):
+        super().__init__(w_q, w_scale, x_scale, bias, x_bits, w_bits,
+                         w_axis)
+        if self.w_axis is not None and self.w_axis not in (0, -4):
+            raise ValueError(
+                "Int8Conv2D per-channel scales must be along the OUTPUT "
+                f"axis of the OIHW weight (axis 0), got {self.w_axis}")
         self.stride = _norm2(stride)
         self.padding = _norm_pad(padding)
         self.dilation = _norm2(dilation)
@@ -157,9 +187,11 @@ class Int8Conv2D(_Int8Base):
                 rhs_dilation=dilation, feature_group_count=groups,
                 dimension_numbers=(fmt, "OIHW", fmt),
                 preferred_element_type=jnp.int32)
-            y = acc.astype(jnp.float32) * rescale
+            shape = (1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1)
+            rs = rescale if np.ndim(rescale) == 0 \
+                else jnp.reshape(jnp.asarray(rescale), shape)
+            y = acc.astype(jnp.float32) * rs
             if bias is not None:
-                shape = (1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1)
                 y = y + bias.reshape(shape)
             return y.astype(xa.dtype)
 
@@ -172,8 +204,17 @@ def _scales_of(quanted) -> tuple:
         raise ValueError(
             "convert_to_int8 needs BOTH activation and weight quanters "
             "(calibrated PTQ.convert / QAT.convert output)")
-    return (float(aq.scales().numpy()), float(wq.scales().numpy()),
-            aq.bit_length(), wq.bit_length())
+    a_s = np.asarray(aq.scales().numpy(), np.float32)
+    if a_s.size != 1:
+        raise ValueError(
+            "convert_to_int8 supports per-tensor ACTIVATION quanters "
+            f"only (got {a_s.size} activation scales); per-channel "
+            "quantization applies to weights")
+    from .base import channel_axis_of
+    w_s = np.asarray(wq.scales().numpy(), np.float32)
+    w_axis = channel_axis_of(wq, "weight quanter") if w_s.ndim else None
+    return (float(a_s.reshape(())), w_s if w_s.ndim else float(w_s),
+            aq.bit_length(), wq.bit_length(), w_axis)
 
 
 def convert_to_int8(model: Layer, inplace: bool = False) -> Layer:
@@ -195,18 +236,18 @@ def convert_to_int8(model: Layer, inplace: bool = False) -> Layer:
 def _walk(model: Layer):
     for name, child in list(model._sub_layers.items()):
         if isinstance(child, QuantedLinear):
-            s_x, s_w, x_bits, w_bits = _scales_of(child)
-            w_q = quantize_arr(child.weight.data, s_w, w_bits)
+            s_x, s_w, x_bits, w_bits, w_axis = _scales_of(child)
+            w_q = quantize_arr(child.weight.data, s_w, w_bits, w_axis)
             model._sub_layers[name] = Int8Linear(
-                w_q, s_w, s_x, child.bias, x_bits, w_bits)
+                w_q, s_w, s_x, child.bias, x_bits, w_bits, w_axis)
         elif isinstance(child, QuantedConv2D):
-            s_x, s_w, x_bits, w_bits = _scales_of(child)
+            s_x, s_w, x_bits, w_bits, w_axis = _scales_of(child)
             lyr = child._layer
-            w_q = quantize_arr(child.weight.data, s_w, w_bits)
+            w_q = quantize_arr(child.weight.data, s_w, w_bits, w_axis)
             model._sub_layers[name] = Int8Conv2D(
                 w_q, s_w, s_x, child.bias, lyr._stride, lyr._padding,
                 lyr._dilation, lyr._groups,
                 getattr(lyr, "_data_format", "NCHW"),
-                x_bits, w_bits)
+                x_bits, w_bits, w_axis)
         else:
             _walk(child)

@@ -10,7 +10,8 @@ import paddle_tpu as pt
 from .base import BaseObserver
 from .factory import QuanterFactory
 
-__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer"]
+__all__ = ["AbsmaxObserver", "AbsmaxObserverLayer",
+           "PerChannelAbsmaxObserver", "PerChannelAbsmaxObserverLayer"]
 
 
 class AbsmaxObserverLayer(BaseObserver):
@@ -35,3 +36,86 @@ class AbsmaxObserverLayer(BaseObserver):
 
 
 AbsmaxObserver = QuanterFactory(AbsmaxObserverLayer)
+
+
+class PerChannelAbsmaxObserverLayer(BaseObserver):
+    """Per-channel weight observer (reference:
+    ``python/paddle/quantization/imperative/ptq_quantizer.py:137``
+    PerChannelAbsmaxQuantizer — the reference's DEFAULT PTQ weight
+    quantizer): one abs-max scale per output channel instead of one for
+    the whole tensor. For conv stacks per-tensor weight scales cost real
+    accuracy — a single hot filter inflates every other filter's grid.
+
+    The channel axis follows the weight layout of the wrapped layer
+    (passed by ``QuanterFactory._instance``): Conv2D weights are OIHW so
+    the output-channel axis is 0; Linear weights are [in, out] so it is
+    the last axis. ``quant_axis=...`` overrides.
+
+    Forward fake-quantizes through the per-channel grid (broadcast scale)
+    with a straight-through gradient, so the same class serves as a QAT
+    weight quanter; in eval mode scales stay frozen."""
+
+    _wants_layer = True
+
+    def __init__(self, quant_bits: int = 8, quant_axis=None, layer=None):
+        super().__init__()
+        self._quant_bits = int(quant_bits)
+        if quant_axis is None:
+            from paddle_tpu import nn
+            if layer is not None and isinstance(
+                    layer, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+                quant_axis = 0
+            else:
+                quant_axis = -1
+        self._quant_axis = int(quant_axis)
+        # concrete zero buffer when the channel count is known (from the
+        # wrapped layer's weight): a None buffer would vanish from
+        # state_dict and silently break checkpoint round-trips
+        n_ch = 0
+        if layer is not None and hasattr(layer, "weight"):
+            wshape = tuple(layer.weight.shape)
+            n_ch = int(wshape[self._quant_axis % len(wshape)])
+        self.register_buffer(
+            "_scale", pt.to_tensor(np.zeros(n_ch, np.float32))
+            if n_ch else None)
+
+    def forward(self, x):
+        from .quanters import fake_quant_ste
+        axis = self._quant_axis % x.data.ndim
+        if self.training and x.data.size:
+            arr = np.abs(np.asarray(x.data))
+            reduce_axes = tuple(i for i in range(arr.ndim) if i != axis)
+            cur = arr.max(axis=reduce_axes) if reduce_axes \
+                else arr.astype(np.float32)
+            if self._scale is not None and \
+                    self._scale.data.size == cur.size:
+                cur = np.maximum(cur, np.asarray(self._scale.numpy()))
+            elif self._scale is not None and self._scale.data.size and \
+                    np.asarray(self._scale.numpy()).any():
+                raise ValueError(
+                    f"per-channel observer saw {cur.size} channels after "
+                    f"calibrating {self._scale.data.size} — the observed "
+                    "tensor's channel axis changed")
+            # else: the zeros buffer was sized from the layer's WEIGHT;
+            # when observing an activation instead, adopt its channel count
+            self._scale = pt.to_tensor(cur.astype(np.float32))
+        if self._scale is None or \
+                not np.asarray(self._scale.numpy()).any():
+            return x  # uncalibrated: identity (same as the scalar observer)
+        from .base import bcast_shape
+        import jax.numpy as jnp
+        bcast = jnp.reshape(self._scale.data,
+                            bcast_shape(x.data.ndim, axis))
+        return fake_quant_ste(x, bcast, self._quant_bits)
+
+    def scales(self):
+        return self._scale
+
+    def quant_axis(self):
+        return self._quant_axis
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+PerChannelAbsmaxObserver = QuanterFactory(PerChannelAbsmaxObserverLayer)

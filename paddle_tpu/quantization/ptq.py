@@ -54,9 +54,16 @@ class PTQ(Quantization):
                     quanted.weight_quanter(observed.weight)
                     quanted.weight_quanter.eval()
                 if child._observer is not None:
+                    obs_scale = child._observer.scales()
+                    if obs_scale is not None and obs_scale.data.size > 1:
+                        raise ValueError(
+                            "PTQ activation observers must be per-tensor "
+                            f"(got {obs_scale.data.size} scales); "
+                            "per-channel quantization applies to weights "
+                            "(pass it as the weight= config)")
                     fq = FakeQuanterWithAbsMaxObserverLayer(
                         bit_length=child._observer.bit_length())
-                    fq._scale.data = child._observer.scales().data
+                    fq._scale.data = obs_scale.data
                     fq.eval()
                     quanted.activation_quanter = fq
                 model._sub_layers[name] = quanted

@@ -63,12 +63,20 @@ def _convert_in_place(model: Layer):
     for name, child in list(model._sub_layers.items()):
         if isinstance(child, _QuantedBase):
             plain = child._layer
-            if child.weight_quanter is not None:
-                scale = float(child.weight_quanter.scales().numpy())
-                bits = child.weight_quanter.bit_length()
-                if scale > 0:
-                    bound = float(2 ** (bits - 1) - 1)
+            wq = child.weight_quanter
+            if wq is not None and wq.scales() is not None:
+                # scalar (per-tensor) or [channels] vector (per-channel,
+                # broadcast along the quanter's channel axis)
+                scale = np.asarray(wq.scales().numpy(), np.float32)
+                bits = wq.bit_length()
+                if (scale > 0).any():
+                    from .base import bcast_shape, channel_axis_of
                     w = np.asarray(plain.weight.data)
+                    if scale.ndim:
+                        axis = channel_axis_of(wq, "weight quanter")
+                        scale = scale.reshape(bcast_shape(w.ndim, axis))
+                    scale = np.maximum(scale, 1e-9)
+                    bound = float(2 ** (bits - 1) - 1)
                     q = np.clip(np.round(w / scale * bound), -bound,
                                 bound) * scale / bound
                     plain.weight.data = q.astype(w.dtype)

@@ -179,3 +179,59 @@ def test_tensor_to_dtype_aliases_and_grad_flow():
     z = (x * 3.0).to("cpu")
     (z * z).backward()
     np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+
+# ---------------- round-4 advisor findings ------------------------------------
+def test_compiled_generate_cache_is_lru_capped(monkeypatch):
+    """A serving loop over varying prompt lengths must not retain one
+    executable per length forever (round-4 advisor finding)."""
+    from paddle_tpu.models import generation
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    monkeypatch.setattr(generation, "_COMPILED_CACHE_CAP", 2)
+    pt.seed(0)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True))
+    m.eval()
+    rng = np.random.RandomState(0)
+    for S in (4, 6, 8):
+        ids = pt.to_tensor(rng.randint(0, 64, (1, S)).astype(np.int64))
+        m.generate_compiled(ids, max_new_tokens=2, temperature=0.0)
+    cache = m.__dict__["_compiled_generate"]
+    assert len(cache) == 2
+    # the oldest signature (prompt len 4) was evicted, newest retained
+    lens = {sig[1] for sig in cache}
+    assert lens == {6, 8}
+    # a hit refreshes recency: touch len-6, add len-10, len-8 evicts
+    ids6 = pt.to_tensor(rng.randint(0, 64, (1, 6)).astype(np.int64))
+    m.generate_compiled(ids6, max_new_tokens=2, temperature=0.0)
+    ids10 = pt.to_tensor(rng.randint(0, 64, (1, 10)).astype(np.int64))
+    m.generate_compiled(ids10, max_new_tokens=2, temperature=0.0)
+    assert {sig[1] for sig in cache} == {6, 10}
+
+
+def test_autotune_measure_takes_min_of_two_slopes(monkeypatch):
+    """One noisy timing window must not crown a winner that persists via
+    PADDLE_AUTOTUNE_CACHE (round-4 advisor finding): _measure requires
+    >=2 positive slopes and returns their min."""
+    from paddle_tpu.ops.pallas import autotune as at
+
+    times = iter([0.0, 1.0,            # warm window
+                  0.0, 4.0, 4.0, 40.0,   # attempt 1: slope (36-4)/8 = 4.0
+                  0.0, 8.0, 8.0, 32.0,   # attempt 2: slope (24-8)/8 = 2.0
+                  ])
+    monkeypatch.setattr(at.time, "perf_counter", lambda: next(times))
+    got = at._measure(lambda: np.zeros(1), iters=4)
+    assert got == pytest.approx(2.0)
+
+
+def test_autotune_measure_rejects_unstable(monkeypatch):
+    from paddle_tpu.ops.pallas import autotune as at
+
+    # every window pair gives a non-positive slope -> unstable, raises
+    vals = iter([0.0, 1.0] + [0.0, 5.0, 5.0, 6.0] * 4)
+    monkeypatch.setattr(at.time, "perf_counter", lambda: next(vals))
+    with pytest.raises(RuntimeError, match="unstable"):
+        at._measure(lambda: np.zeros(1), iters=4)

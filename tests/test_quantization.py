@@ -450,6 +450,216 @@ class TestInt8Execution:
         got = int8_model(pt.to_tensor(x)).numpy()
         np.testing.assert_allclose(got, sim, rtol=1e-5, atol=1e-5)
 
+    def test_per_channel_observer_oracle(self):
+        """Per-channel weight observer (reference ptq_quantizer.py:137
+        PerChannelAbsmaxQuantizer): one scale per output channel, and the
+        fake-quant grid applies per channel."""
+        from paddle_tpu.quantization.observers import (
+            PerChannelAbsmaxObserverLayer)
+
+        q = PerChannelAbsmaxObserverLayer(quant_bits=8, quant_axis=-1)
+        q.train()
+        w = np.array([[1.0, 0.01], [-2.0, 0.005]], np.float32)
+        out = q(pt.to_tensor(w))
+        np.testing.assert_allclose(q.scales().numpy(), [2.0, 0.01],
+                                   rtol=1e-6)
+        # column 1's tiny weights survive on their OWN grid
+        expect = np.stack([np.round(w[:, 0] / 2.0 * 127) * 2.0 / 127,
+                           np.round(w[:, 1] / 0.01 * 127) * 0.01 / 127], 1)
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+        assert q.quant_axis() == -1
+
+    def test_per_channel_int8_linear_matches_simulation(self):
+        from paddle_tpu.quantization import (
+            convert_to_int8, PerChannelAbsmaxObserver, Int8Linear)
+
+        pt.seed(21)
+        rng = np.random.RandomState(21)
+        model = Net()
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=PerChannelAbsmaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        for _ in range(4):
+            observed(pt.to_tensor(rng.randn(16, 8).astype(np.float32)))
+        deployed = ptq.convert(observed)
+        int8_model = convert_to_int8(deployed)
+        assert isinstance(int8_model.fc1, Int8Linear)
+        assert np.asarray(int8_model.fc1.w_scale).shape == (16,)
+        x = rng.randn(16, 8).astype(np.float32)
+        sim = deployed(pt.to_tensor(x)).numpy()
+        got = int8_model(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, sim, rtol=1e-5, atol=1e-5)
+
+    def test_per_channel_beats_per_tensor_on_skewed_conv(self):
+        """The reference defaults PTQ weight quant to per-channel because
+        per-tensor costs accuracy on conv stacks: a hot filter inflates
+        every other filter's grid. Measure the delta."""
+        from paddle_tpu.quantization import (
+            convert_to_int8, PerChannelAbsmaxObserver, Int8Conv2D)
+
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 8, 3, padding=1)
+
+            def forward(self, x):
+                return self.conv(x)
+
+        def build(weight_factory, seed=23):
+            pt.seed(seed)
+            rng = np.random.RandomState(seed)
+            model = ConvNet()
+            # skew the filters: one hot filter, the rest tiny
+            w = np.asarray(model.conv.weight.data).copy()
+            w[0] *= 50.0
+            w[1:] *= 0.05
+            import jax.numpy as jnp
+            model.conv.weight.data = jnp.asarray(w)
+            cfg = QuantConfig(activation=AbsmaxObserver(),
+                              weight=weight_factory)
+            ptq = PTQ(cfg)
+            observed = ptq.quantize(model)
+            for _ in range(3):
+                observed(pt.to_tensor(rng.randn(2, 3, 8, 8)
+                                      .astype(np.float32)))
+            deployed = ptq.convert(observed)
+            x = rng.randn(4, 3, 8, 8).astype(np.float32)
+            ref = model(pt.to_tensor(x)).numpy()
+            got = convert_to_int8(deployed)(pt.to_tensor(x)).numpy()
+            # error on the TINY channels (the ones a shared grid crushes)
+            return np.abs(ref[:, 1:] - got[:, 1:]).mean() / \
+                np.abs(ref[:, 1:]).mean()
+
+        err_pt = build(FakeQuanterWithAbsMaxObserver())
+        err_pc = build(PerChannelAbsmaxObserver())
+        assert err_pc < err_pt * 0.2, (err_pc, err_pt)
+
+    def test_per_channel_conv_int8_layer(self):
+        from paddle_tpu.quantization import (
+            convert_to_int8, PerChannelAbsmaxObserver, Int8Conv2D)
+
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 3, padding=1)
+
+            def forward(self, x):
+                return self.conv(x)
+
+        pt.seed(25)
+        rng = np.random.RandomState(25)
+        model = ConvNet()
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=PerChannelAbsmaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        for _ in range(3):
+            observed(pt.to_tensor(rng.randn(2, 3, 8, 8)
+                                  .astype(np.float32)))
+        deployed = ptq.convert(observed)
+        int8_model = convert_to_int8(deployed)
+        assert isinstance(int8_model.conv, Int8Conv2D)
+        assert np.asarray(int8_model.conv.w_scale).shape == (4,)
+        assert int8_model.conv.w_axis == 0
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        sim = deployed(pt.to_tensor(x)).numpy()
+        got = int8_model(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, sim, rtol=1e-4, atol=1e-4)
+
+    def test_per_channel_activation_rejected(self):
+        """Activation quantization is per-tensor only; a per-channel
+        activation observer must fail LOUDLY at convert time, not crash
+        on the first forward of the converted model (review regression)."""
+        from paddle_tpu.quantization import PerChannelAbsmaxObserver
+
+        pt.seed(27)
+        rng = np.random.RandomState(27)
+        model = Net()
+        cfg = QuantConfig(activation=PerChannelAbsmaxObserver(),
+                          weight=FakeQuanterWithAbsMaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        observed(pt.to_tensor(rng.randn(4, 8).astype(np.float32)))
+        with pytest.raises(ValueError, match="per-tensor"):
+            ptq.convert(observed)
+
+    def test_per_channel_qat_convert_bakes_weights(self):
+        """QAT.convert must bake per-channel fake-quant grids (review
+        regression: the sibling convert path crashed on vector scales)."""
+        from paddle_tpu.quantization import PerChannelAbsmaxObserver
+
+        pt.seed(31)
+        model = Net()
+        cfg = QuantConfig(activation=None,
+                          weight=PerChannelAbsmaxObserver())
+        q = QAT(cfg)
+        qmodel = q.quantize(model)
+        qmodel.train()
+        qmodel(pt.to_tensor(np.random.RandomState(31)
+                            .randn(4, 8).astype(np.float32)))
+        deployed = q.convert(qmodel)
+        assert isinstance(deployed.fc1, nn.Linear)
+        w = np.asarray(deployed.fc1.weight.data)
+        scales = np.asarray(qmodel.fc1.weight_quanter.scales().numpy())
+        assert scales.shape == (16,)
+        grid = w / np.maximum(scales[None, :] / 127, 1e-12)
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-3)
+
+    def test_per_channel_scale_survives_state_dict(self):
+        """The observer's scale buffer must round-trip through
+        state_dict/set_state_dict (review regression: a None buffer
+        vanished from checkpoints)."""
+        from paddle_tpu.quantization import PerChannelAbsmaxObserver
+
+        pt.seed(33)
+        rng = np.random.RandomState(33)
+
+        def build():
+            pt.seed(33)
+            model = Net()
+            cfg = QuantConfig(activation=None,
+                              weight=PerChannelAbsmaxObserver())
+            return QAT(cfg).quantize(model)
+
+        qmodel = build()
+        qmodel.train()
+        qmodel(pt.to_tensor(rng.randn(4, 8).astype(np.float32)))
+        state = qmodel.state_dict()
+        fresh = build()
+        fresh.set_state_dict(state)
+        got = np.asarray(fresh.fc1.weight_quanter.scales().numpy())
+        want = np.asarray(qmodel.fc1.weight_quanter.scales().numpy())
+        np.testing.assert_allclose(got, want, rtol=1e-7)
+
+    def test_per_channel_pruned_channel_converts(self):
+        """An all-zero (pruned) output channel yields scale 0 for that
+        channel; conversion must clamp it, not reject the calibrated
+        model (review regression)."""
+        from paddle_tpu.quantization import (
+            convert_to_int8, PerChannelAbsmaxObserver)
+        import jax.numpy as jnp
+
+        pt.seed(35)
+        rng = np.random.RandomState(35)
+        model = Net()
+        w = np.asarray(model.fc1.weight.data).copy()
+        w[:, 0] = 0.0  # prune output channel 0
+        model.fc1.weight.data = jnp.asarray(w)
+        cfg = QuantConfig(activation=AbsmaxObserver(),
+                          weight=PerChannelAbsmaxObserver())
+        ptq = PTQ(cfg)
+        observed = ptq.quantize(model)
+        for _ in range(3):
+            observed(pt.to_tensor(rng.randn(8, 8).astype(np.float32)))
+        deployed = ptq.convert(observed)
+        int8_model = convert_to_int8(deployed)
+        x = rng.randn(8, 8).astype(np.float32)
+        out = int8_model(pt.to_tensor(x)).numpy()
+        assert np.isfinite(out).all()
+        sim = deployed(pt.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, sim, rtol=1e-4, atol=1e-4)
+
     def test_int8_conv_same_padding(self):
         """String padding ('SAME') passes through to lax (review
         regression)."""
