@@ -24,7 +24,11 @@ from .strategy import Strategy  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .converter import Converter  # noqa: F401
 from .cost_model import Cluster, CommCost, CostEstimator  # noqa: F401
+from .planner import (  # noqa: F401
+    ModelDesc, ParallelPlan, Planner, auto_shard_params,
+)
 
 __all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
            "reshard", "Strategy", "Engine", "Converter", "Cluster",
-           "CommCost", "CostEstimator"]
+           "CommCost", "CostEstimator", "ModelDesc", "ParallelPlan",
+           "Planner", "auto_shard_params"]

@@ -24,6 +24,7 @@ class Cluster:
 
     peak_flops: float = 459e12        # bf16 FLOP/s per chip
     hbm_bandwidth: float = 2765e9     # bytes/s
+    hbm_capacity: float = 95e9        # bytes per chip (v5p)
     ici_bandwidth: float = 90e9       # bytes/s per link direction
     ici_latency: float = 1e-6         # seconds per hop
     dcn_bandwidth: float = 25e9       # bytes/s per host

@@ -39,15 +39,75 @@ class Engine:
             self._mesh = dist.get_mesh() or dist.init_mesh()
         return self._mesh
 
-    def prepare(self, mesh=None, input_spec=None):
+    def prepare(self, mesh=None, input_spec=None, auto=False,
+                n_devices=None, model_desc=None, cluster=None,
+                batch_shape=None):
         """Fix the mesh (and batch sharding) ahead of fit; optional — fit
-        defaults to sharding batch dim 0 over the mesh's first axis."""
+        defaults to sharding batch dim 0 over the mesh's first axis.
+
+        ``auto=True`` runs the parallel-plan search instead (reference:
+        ``planner_v2.py`` Planner / ``tuner/parallel_tuner.py``): the
+        :class:`~.planner.Planner` enumerates mesh factorizations of
+        ``n_devices`` (default: all visible devices), scores them with the
+        cost model, and installs the winner — mesh, batch spec, generic
+        mp weight shardings, and ZeRO wrapping if the plan says so. The
+        batch shape comes from ``batch_shape`` now or from the first fit
+        batch (generic models without a ``model_desc`` always defer to
+        the first batch — measuring FLOPs needs real example inputs).
+        ``model_desc`` (a :class:`~.planner.ModelDesc`) overrides the
+        model introspection; ``cluster`` the hardware description."""
         import paddle_tpu.distributed as dist
+        if auto:
+            self._auto_cfg = {"n_devices": n_devices,
+                              "model_desc": model_desc, "cluster": cluster}
+            if batch_shape is not None:
+                self._run_planner(tuple(batch_shape))
+            return self
         if mesh is not None:
             self._mesh = mesh.to_jax() if hasattr(mesh, "to_jax") else mesh
             dist.set_mesh(self._mesh)
         self._input_spec = input_spec
         return self
+
+    @property
+    def plan(self):
+        """The winning :class:`~.planner.ParallelPlan` (auto mode only)."""
+        return getattr(self, "_plan", None)
+
+    def _run_planner(self, batch_shape, example_batch=None):
+        import jax
+
+        from .planner import ModelDesc, Planner, auto_shard_params
+
+        cfg = self._auto_cfg
+        desc = cfg["model_desc"]
+        if desc is None:
+            model_cfg = getattr(self._model, "cfg", None)
+            if model_cfg is not None and \
+                    type(model_cfg).__name__ == "LlamaConfig":
+                desc = ModelDesc.from_llama(model_cfg)
+            elif example_batch is not None:
+                desc = ModelDesc.from_model(self._model,
+                                            example_args=example_batch,
+                                            cluster=cfg["cluster"])
+            else:
+                # generic model, shape only: FLOPs need a real example
+                # batch — defer planning to the first fit batch
+                return None
+        n = cfg["n_devices"] or jax.device_count()
+        planner = Planner(desc, cluster=cfg["cluster"])
+        plan = planner.plan(n, batch_shape)
+        self._plan = plan
+        self._planner = planner
+        self._mesh = plan.build_mesh()
+        self._input_spec = plan.input_spec
+        if plan.mp > 1:
+            auto_shard_params(self._model, self._mesh)
+        if plan.zero:
+            import paddle_tpu.distributed as dist
+            self._model, self._optimizer, _ = dist.group_sharded_parallel(
+                self._model, self._optimizer, level=plan.zero, axis="dp")
+        return plan
 
     def _loss_fn(self):
         loss_layer = self._loss
@@ -94,14 +154,21 @@ class Engine:
     def fit(self, train_data, epochs: int = 1, batch_size: int = 1,
             steps_per_epoch: Optional[int] = None, log_freq: int = 10,
             verbose: int = 0):
-        if self._train_step is None:
-            self._build_step()
         loader = self._loader(train_data, batch_size, drop_last=True)
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
                     break
-                loss = self._train_step(*self._to_tensors(batch))
+                tensors = self._to_tensors(batch)
+                if self._train_step is None:
+                    if getattr(self, "_auto_cfg", None) is not None \
+                            and self.plan is None:
+                        inputs = tensors[:-1] if self._loss is not None \
+                            and len(tensors) > 1 else tensors
+                        self._run_planner(tuple(inputs[0].shape),
+                                          example_batch=inputs)
+                    self._build_step()
+                loss = self._train_step(*tensors)
                 val = float(loss.numpy())
                 self.history["loss"].append(val)
                 if verbose and step % log_freq == 0:

@@ -1,0 +1,330 @@
+"""Automatic parallel-plan search — the framework picks the parallelism.
+
+Reference surface: ``python/paddle/distributed/auto_parallel/planner_v2.py:21``
+(``Planner`` — complete dist attrs, then search) and
+``tuner/parallel_tuner.py:36`` (``ParallelTuner`` — enumerate candidate
+dist-attr combinations over the cluster, score each with the cost model,
+install the winner).
+
+TPU-native redesign: XLA GSPMD already performs the per-op part of the
+reference's search (the Completer/Partitioner/Resharder propagate any
+consistent annotation), so the space that still needs SEARCH collapses to
+the level where a user currently guesses by hand:
+
+  * how to factor N devices into named mesh axes (``dp`` x ``mp``),
+  * whether to ZeRO-shard optimizer state/grads/params over ``dp``,
+  * where the batch dimension goes.
+
+A plan is scored with the existing :class:`CostEstimator` machinery —
+analytic compute/HBM roofline (XLA ``cost_analysis`` numbers or the model
+family's closed-form FLOPs) plus the alpha-beta ring model for exactly the
+collectives each axis implies:
+
+  dp    -> one gradient all-reduce of the (mp-sharded) parameter bytes,
+  zero  -> reduce-scatter + all-gather instead (same wire bytes, lower
+           memory), plus a parameter all-gather each step for ``p_g_os``,
+  mp    -> 4 activation all-reduces per layer per step (Megatron count:
+           2 forward + 2 backward, column->row pairs),
+
+and checked for HBM feasibility (weights + grads + optimizer state +
+activation working set per device must fit).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .cost_model import Cluster, CommCost, CostEstimator
+
+__all__ = ["ModelDesc", "ParallelPlan", "Planner", "auto_shard_params"]
+
+
+@dataclass
+class ModelDesc:
+    """What the plan search needs to know about a model — either built
+    from a zoo config (:meth:`from_llama`) or measured from any model
+    (:meth:`from_model`, XLA ``cost_analysis`` via CostEstimator)."""
+
+    param_bytes: float            # trainable parameter bytes (model dtype)
+    flops_per_token: float        # forward FLOPs per token (2*MAC)
+    num_layers: int               # trunk depth (mp collective count)
+    hidden_size: int              # activation width at layer boundaries
+    dtype_bytes: int = 2          # activation/param dtype width (bf16)
+    max_mp: int = 1               # largest legal tensor-parallel degree
+    act_multiplier: float = 8.0   # live activation copies per layer (rough;
+    #                               ~2 with full recompute)
+    seq_in_batch: bool = True     # inputs are [B, S, ...] (tokens = B*S)
+
+    def tokens_of(self, batch_shape) -> int:
+        """Token count of one global batch given the leading input's
+        shape: [B, S, ...] for sequence models, [B, ...] otherwise."""
+        if self.seq_in_batch and len(batch_shape) >= 2:
+            return int(batch_shape[0]) * int(batch_shape[1])
+        return int(batch_shape[0])
+
+    def mp_legal(self, mp: int) -> bool:
+        return mp <= self.max_mp and self.max_mp % mp == 0
+
+    # -- constructors ---------------------------------------------------------
+    @staticmethod
+    def from_llama(cfg, dtype_bytes: int = 2) -> "ModelDesc":
+        """Closed-form description of the zoo Llama family
+        (``models/llama.py``); mp must divide heads, kv-heads, ffn and
+        vocab (the mpu layers' shard dims)."""
+        d, f, L = cfg.hidden_size, cfg.intermediate_size, \
+            cfg.num_hidden_layers
+        hd = d // cfg.num_attention_heads
+        kv = cfg.num_key_value_heads * hd
+        per_layer = d * (d + 2 * kv + d) + 3 * d * f + 2 * d
+        n_params = L * per_layer + d + cfg.vocab_size * d
+        if not cfg.tie_word_embeddings:
+            n_params += cfg.vocab_size * d
+        max_mp = 1
+        while (cfg.num_attention_heads % (2 * max_mp) == 0
+               and cfg.num_key_value_heads % (2 * max_mp) == 0
+               and f % (2 * max_mp) == 0
+               and cfg.vocab_size % (2 * max_mp) == 0):
+            max_mp *= 2
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        return ModelDesc(
+            param_bytes=float(n_params) * dtype_bytes,
+            flops_per_token=LlamaForCausalLM.flops_per_token(cfg),
+            num_layers=L, hidden_size=d, dtype_bytes=dtype_bytes,
+            max_mp=max_mp)
+
+    @staticmethod
+    def from_model(model, example_args=None, flops_per_token=None,
+                   num_layers: Optional[int] = None,
+                   hidden_size: Optional[int] = None,
+                   max_mp: int = 1, seq_in_batch: bool = False,
+                   cluster: Optional[Cluster] = None) -> "ModelDesc":
+        """Generic description: parameter bytes from the model; forward
+        FLOPs measured by compiling the model once single-device and
+        reading XLA's own cost analysis (``CostEstimator.analyze`` — the
+        round-2 leaf utility, now a planner input)."""
+        import numpy as np
+
+        params = list(model.parameters())
+        if not params:
+            raise ValueError("model has no trainable parameters to plan")
+        dtype_bytes = int(np.dtype(str(params[0].data.dtype)).itemsize)
+        param_bytes = float(sum(
+            int(np.prod(p.shape)) * np.dtype(str(p.data.dtype)).itemsize
+            for p in params))
+        if flops_per_token is None:
+            if example_args is None:
+                raise ValueError(
+                    "pass example_args (to measure forward FLOPs via XLA "
+                    "cost_analysis) or flops_per_token")
+            from paddle_tpu.jit.functional import functional_state, \
+                swap_state
+            from paddle_tpu.core.tensor import Tensor
+            from paddle_tpu.core.autograd import no_grad
+
+            train, frozen, buffers = functional_state(model)
+            st = {**train, **frozen, **buffers}
+            args = [a.data if isinstance(a, Tensor) else np.asarray(a)
+                    for a in example_args]
+
+            def fwd(stt, *xs):
+                with no_grad(), swap_state(model, stt,
+                                           collect_buffers=False):
+                    out = model(*[Tensor(x) for x in xs])
+                return out.data if isinstance(out, Tensor) else out
+
+            est = CostEstimator(cluster)
+            got = est.analyze(fwd, st, *args)
+            shape = args[0].shape if args else (1,)
+            n_tokens = int(shape[0]) * (int(shape[1])
+                                        if seq_in_batch and len(shape) >= 2
+                                        else 1)
+            flops_per_token = got["flops"] / max(n_tokens, 1)
+        if hidden_size is None:
+            hidden_size = max(int(p.shape[-1]) for p in params)
+        return ModelDesc(
+            param_bytes=param_bytes, flops_per_token=float(flops_per_token),
+            num_layers=num_layers or 1, hidden_size=int(hidden_size),
+            dtype_bytes=dtype_bytes, max_mp=max_mp,
+            seq_in_batch=seq_in_batch)
+
+
+@dataclass
+class ParallelPlan:
+    """One point in the search space: a mesh factorization + ZeRO level
+    (+ the batch axis), with its predicted cost after scoring."""
+
+    mesh_shape: Dict[str, int]
+    batch_axis: str = "dp"
+    zero: Optional[str] = None          # None | "p_g_os"
+    cost: Dict[str, float] = field(default_factory=dict)
+    feasible: bool = True
+
+    @property
+    def dp(self) -> int:
+        return self.mesh_shape.get("dp", 1)
+
+    @property
+    def mp(self) -> int:
+        return self.mesh_shape.get("mp", 1)
+
+    @property
+    def input_spec(self):
+        from jax.sharding import PartitionSpec
+        return PartitionSpec(self.batch_axis)
+
+    def build_mesh(self):
+        """Install this plan's mesh as the process default (size-1 axes
+        kept — the batch axis must exist even in a pure-mp plan). Plans
+        smaller than the visible device count take a device-list prefix
+        (planning for a sub-slice of the host)."""
+        import jax
+        import numpy as np
+
+        import paddle_tpu.distributed as dist
+        n = int(np.prod(list(self.mesh_shape.values())))
+        devices = jax.devices()
+        if n > len(devices):
+            raise ValueError(
+                f"plan needs {n} devices, {len(devices)} visible")
+        return dist.init_mesh(dict(self.mesh_shape), devices=devices[:n])
+
+    def describe(self) -> str:
+        axes = "x".join(f"{k}{v}" for k, v in self.mesh_shape.items()
+                        if v > 1) or "single"
+        z = f"+zero({self.zero})" if self.zero else ""
+        t = self.cost.get("seconds")
+        cost = f" {t * 1e3:.3f}ms/step" if t is not None else ""
+        feas = "" if self.feasible else " [OOM]"
+        return f"{axes}{z}{cost}{feas}"
+
+
+def auto_shard_params(model, mesh, mp_axis: str = "mp") -> int:
+    """Generic weight-sharding rule for a chosen mp degree: annotate every
+    still-unannotated >=2-D parameter with its LARGEST axis-divisible dim
+    sharded over ``mp_axis`` (mpu layers that already annotated keep their
+    placements). Sharding annotations never change semantics under GSPMD —
+    they pick layouts and XLA inserts the collectives — so this is always
+    correct; the planner's cost model decides when it is also fast.
+    Returns the number of parameters annotated."""
+    from jax.sharding import PartitionSpec
+
+    from ..sharding_api import shard_tensor
+
+    size = mesh.shape[mp_axis] if mp_axis in mesh.axis_names else 1
+    if size <= 1:
+        return 0
+    count = 0
+    for _, p in model.named_parameters():
+        if getattr(p, "_sharding_spec", None) is not None \
+                or len(p.shape) < 2:
+            continue
+        for dim in sorted(range(len(p.shape)), key=lambda i: -p.shape[i]):
+            if p.shape[dim] % size == 0:
+                spec = [None] * len(p.shape)
+                spec[dim] = mp_axis
+                shard_tensor(p, mesh, spec=PartitionSpec(*spec))
+                count += 1
+                break
+    return count
+
+
+class Planner:
+    """Enumerate mesh factorizations, score each with the cost model,
+    return them best-first (reference: ``planner_v2.py`` Planner +
+    ``parallel_tuner.py`` ParallelTuner collapsed into one search over
+    the GSPMD-era plan space)."""
+
+    def __init__(self, desc: ModelDesc, cluster: Optional[Cluster] = None,
+                 allow_zero: bool = True):
+        self.desc = desc
+        self.cluster = cluster or Cluster()
+        self.comm = CommCost(self.cluster)
+        self.allow_zero = allow_zero
+
+    # -- plan space -----------------------------------------------------------
+    def candidates(self, n_devices: int) -> List[ParallelPlan]:
+        plans = []
+        for mp in range(1, n_devices + 1):
+            if n_devices % mp:
+                continue
+            if mp > 1 and not self.desc.mp_legal(mp):
+                continue
+            dp = n_devices // mp
+            plans.append(ParallelPlan({"dp": dp, "mp": mp}))
+            if self.allow_zero and dp > 1:
+                plans.append(ParallelPlan({"dp": dp, "mp": mp},
+                                          zero="p_g_os"))
+        return plans
+
+    # -- scoring --------------------------------------------------------------
+    def estimate(self, plan: ParallelPlan, batch_shape) -> Dict[str, float]:
+        """Predicted step time (seconds) and its terms for one global
+        batch of ``batch_shape``; also fills HBM feasibility."""
+        d = self.desc
+        c = self.cluster
+        tokens = d.tokens_of(batch_shape)
+        dp, mp = plan.dp, plan.mp
+        n = dp * mp
+
+        # compute + HBM roofline: fwd + 2x bwd FLOPs; weights stream from
+        # HBM ~3x per step (fwd, dgrad, wgrad)
+        t_compute = 3.0 * d.flops_per_token * tokens / n / c.peak_flops
+        t_hbm = 3.0 * (d.param_bytes / mp) / c.hbm_bandwidth
+        # dp gradient sync: all-reduce of the local param shard's grads
+        # (ZeRO: reduce-scatter + all-gather — same ring bytes — plus the
+        # p_g_os parameter re-gather each step)
+        grad_bytes = d.param_bytes / mp
+        t_dp = self.comm.all_reduce(grad_bytes, dp)
+        if plan.zero == "p_g_os":
+            t_dp += self.comm.all_gather(grad_bytes, dp)
+        # mp activation sync: Megatron count — 4 all-reduces per layer of
+        # the per-dp-shard activation [tokens/dp, hidden]
+        act_bytes = tokens / dp * d.hidden_size * d.dtype_bytes
+        t_mp = 4 * d.num_layers * self.comm.all_reduce(act_bytes, mp) \
+            if mp > 1 else 0.0
+        seconds = max(t_compute, t_hbm) + t_dp + t_mp
+
+        # feasibility: params + grads (model dtype) + f32 master+moments
+        # (Adam-class: 3 f32 copies) + activation working set; p_g_os
+        # shards ALL persistent state over dp (params re-gather per step)
+        state_shards = dp if plan.zero == "p_g_os" else 1
+        weight_bytes = d.param_bytes / mp / state_shards
+        opt_bytes = (d.param_bytes / d.dtype_bytes) * 12 / mp / state_shards
+        act_work = tokens / dp * d.hidden_size * d.dtype_bytes \
+            * d.num_layers * d.act_multiplier / mp
+        hbm_used = weight_bytes * 2 + opt_bytes + act_work
+        cost = {
+            "seconds": seconds, "compute_seconds": t_compute,
+            "hbm_seconds": t_hbm, "dp_comm_seconds": t_dp,
+            "mp_comm_seconds": t_mp, "tokens_per_second":
+                tokens / max(seconds, 1e-12),
+            "hbm_bytes_per_device": hbm_used,
+        }
+        plan.cost = cost
+        plan.feasible = hbm_used <= c.hbm_capacity
+        return cost
+
+    def ranked(self, n_devices: int, batch_shape) -> List[ParallelPlan]:
+        """All candidate plans, scored, feasible-first then fastest."""
+        plans = self.candidates(n_devices)
+        if not plans:
+            raise ValueError(f"no legal plan for {n_devices} devices "
+                             f"(max_mp={self.desc.max_mp})")
+        for p in plans:
+            self.estimate(p, batch_shape)
+        plans.sort(key=lambda p: (not p.feasible, p.cost["seconds"]))
+        return plans
+
+    def plan(self, n_devices: int, batch_shape) -> ParallelPlan:
+        """The winning plan. Raises if nothing fits in HBM — the honest
+        answer is a bigger mesh, not a silently-OOM plan."""
+        best = self.ranked(n_devices, batch_shape)[0]
+        if not best.feasible:
+            gb = best.cost["hbm_bytes_per_device"] / 1e9
+            raise ValueError(
+                f"no plan fits: best candidate ({best.describe()}) needs "
+                f"{gb:.1f} GB/device vs {self.cluster.hbm_capacity / 1e9:.1f}"
+                " GB HBM — add devices, enable recompute (lower "
+                "act_multiplier), or shrink the batch")
+        return best

@@ -123,3 +123,215 @@ class TestEngine:
         s = Strategy()
         assert not s.amp.enable and not s.sharding.enable
         assert s.pipeline.schedule_mode == "1F1B"
+
+
+class TestPlanner:
+    """Automatic parallel-plan search (reference: planner_v2.py:21 Planner
+    + tuner/parallel_tuner.py:36 ParallelTuner): enumerate mesh
+    factorizations, score with the cost model, install the winner."""
+
+    def test_llama_big_model_prefers_mp_over_pure_dp(self):
+        """8B-class Llama: the 16 GB gradient all-reduce makes pure dp
+        lose to a dp x mp split (the 'framework helps on a v5p-64' case)."""
+        from paddle_tpu.distributed.auto_parallel import Planner, ModelDesc
+        from paddle_tpu.models.llama import LlamaConfig
+
+        desc = ModelDesc.from_llama(LlamaConfig())  # 8B
+        planner = Planner(desc)
+        best = planner.plan(64, (16, 8192))
+        assert best.mp > 1, best.describe()
+        ranked = planner.ranked(64, (16, 8192))
+        pure_dp = next(p for p in ranked
+                       if p.mp == 1 and p.zero is None)
+        assert pure_dp.cost["seconds"] > best.cost["seconds"]
+
+    def test_llama_tiny_prefers_pure_dp(self):
+        """Small model, small vocab: mp's activation all-reduces buy
+        nothing — pure dp wins."""
+        from paddle_tpu.distributed.auto_parallel import Planner, ModelDesc
+        from paddle_tpu.models.llama import LlamaConfig
+
+        desc = ModelDesc.from_llama(LlamaConfig.tiny())
+        best = Planner(desc).plan(8, (8, 32))
+        assert best.mp == 1 and best.dp == 8, best.describe()
+
+    def test_big_vocab_small_trunk_prefers_mp(self):
+        """Embedding-dominated model (big tied vocab, thin trunk): the
+        param all-reduce dwarfs compute, mp shards it away."""
+        from paddle_tpu.distributed.auto_parallel import Planner, ModelDesc
+        from paddle_tpu.models.llama import LlamaConfig
+
+        desc = ModelDesc.from_llama(LlamaConfig(
+            vocab_size=128256, hidden_size=1024, intermediate_size=2048,
+            num_hidden_layers=2, num_attention_heads=8,
+            num_key_value_heads=8, tie_word_embeddings=True))
+        best = Planner(desc).plan(8, (8, 256))
+        assert best.mp == 8, best.describe()
+
+    def test_mp_respects_model_divisibility(self):
+        from paddle_tpu.distributed.auto_parallel import Planner, ModelDesc
+        from paddle_tpu.models.llama import LlamaConfig
+
+        desc = ModelDesc.from_llama(LlamaConfig.tiny())  # kv_heads=2
+        assert desc.max_mp == 2
+        plans = Planner(desc).candidates(8)
+        assert {p.mp for p in plans} == {1, 2}
+
+    def test_infeasible_raises_with_guidance(self):
+        from paddle_tpu.distributed.auto_parallel import (
+            Planner, ModelDesc, Cluster)
+        from paddle_tpu.models.llama import LlamaConfig
+
+        desc = ModelDesc.from_llama(LlamaConfig())  # 8B: 16 GB params
+        tiny_hbm = Cluster(hbm_capacity=1e9)
+        with pytest.raises(ValueError, match="no plan fits"):
+            Planner(desc, cluster=tiny_hbm).plan(4, (16, 8192))
+
+    def test_zero_plan_reduces_memory_footprint(self):
+        from paddle_tpu.distributed.auto_parallel import Planner, ModelDesc
+        from paddle_tpu.models.llama import LlamaConfig
+
+        desc = ModelDesc.from_llama(LlamaConfig())
+        planner = Planner(desc)
+        ranked = planner.ranked(64, (16, 8192))
+        plain = next(p for p in ranked if p.mp == 1 and p.zero is None)
+        zero = next(p for p in ranked if p.mp == 1 and p.zero == "p_g_os")
+        assert zero.cost["hbm_bytes_per_device"] < \
+            0.2 * plain.cost["hbm_bytes_per_device"]
+
+    def _skewed_mlp(self, seed=0):
+        """Param-heavy, compute-light: the dp gradient all-reduce is the
+        dominant cost, so plan ordering is robustly measurable even on
+        the CPU virtual mesh (collectives are real memory traffic)."""
+        pt.seed(seed)
+        return nn.Sequential(nn.Linear(1024, 4096), nn.ReLU(),
+                             nn.Linear(4096, 1024))
+
+    def test_predicted_order_matches_measured_order(self):
+        """VERDICT r4 'done' bar: predicted cost ORDER matches measured
+        step-time order across >=3 single-axis plan variants."""
+        import time
+        from paddle_tpu.distributed.auto_parallel import (
+            Planner, ModelDesc, ParallelPlan, auto_shard_params)
+
+        desc = ModelDesc.from_model(
+            self._skewed_mlp(), flops_per_token=2 * (1024 * 4096 * 2),
+            num_layers=2, hidden_size=4096, max_mp=8)
+        planner = Planner(desc, allow_zero=False)
+        plans = [ParallelPlan({"dp": 8, "mp": 1}),
+                 ParallelPlan({"dp": 2, "mp": 4}),
+                 ParallelPlan({"dp": 1, "mp": 8})]
+        batch = np.random.RandomState(0).randn(16, 1024).astype(np.float32)
+        target = np.random.RandomState(1).randn(16, 1024).astype(np.float32)
+        mse = nn.MSELoss()
+
+        measured, predicted = {}, {}
+        for plan in plans:
+            planner.estimate(plan, batch.shape)
+            predicted[plan.describe().split()[0]] = plan.cost["seconds"]
+            mesh = plan.build_mesh()
+            model = self._skewed_mlp()
+            auto_shard_params(model, mesh)
+            o = opt.SGD(learning_rate=0.0, parameters=model.parameters())
+            step = pt.jit.TrainStep(model, lambda m, a, b: mse(m(a), b),
+                                    o, mesh=mesh,
+                                    input_spec=plan.input_spec)
+            xb, yb = pt.to_tensor(batch), pt.to_tensor(target)
+            step(xb, yb)  # compile + warm
+            times = []
+            for _ in range(7):
+                t0 = time.perf_counter()
+                float(step(xb, yb).numpy())
+                times.append(time.perf_counter() - t0)
+            measured[plan.describe().split()[0]] = min(times)
+
+        pred_order = sorted(predicted, key=predicted.get)
+        meas_order = sorted(measured, key=measured.get)
+        # the extremes must agree (middle rank may tie within noise)
+        assert pred_order[0] == meas_order[0], (predicted, measured)
+        assert pred_order[-1] == meas_order[-1], (predicted, measured)
+
+    def test_engine_auto_end_to_end(self):
+        """Engine.prepare(auto=True): the planner picks the mesh from the
+        first batch and fit trains through the planned TrainStep."""
+        from paddle_tpu.distributed.auto_parallel import ModelDesc
+
+        ds = RandomDataset(n=64, din=8, dout=4, seed=7)
+        model = make_model(seed=7)
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        engine = Engine(model=model, loss=nn.MSELoss(), optimizer=o)
+        desc = ModelDesc.from_model(
+            model, flops_per_token=2 * (8 * 16 + 16 * 4),
+            num_layers=2, hidden_size=16)
+        engine.prepare(auto=True, model_desc=desc)
+        history = engine.fit(ds, epochs=2, batch_size=16)
+        assert engine.plan is not None
+        assert engine.plan.dp * engine.plan.mp == 8
+        losses = history["loss"]
+        assert np.isfinite(losses).all() and losses[-1] < losses[0] * 0.7
+
+    def test_engine_auto_matches_single_device(self):
+        """Auto-planned training must still be EXACT training: loss curve
+        equals the single-device eager run (the plan only moves data)."""
+        ds = RandomDataset(seed=9)
+        ref_model = make_model(seed=9)
+        ref_opt = opt.SGD(learning_rate=0.1,
+                          parameters=ref_model.parameters())
+        mse = nn.MSELoss()
+        ref_losses = []
+        for i in range(0, 64, 16):
+            loss = mse(ref_model(pt.to_tensor(ds.x[i:i + 16])),
+                       pt.to_tensor(ds.y[i:i + 16]))
+            loss.backward()
+            ref_opt.step()
+            ref_opt.clear_grad()
+            ref_losses.append(float(loss.numpy()))
+
+        from paddle_tpu.distributed.auto_parallel import ModelDesc
+        model = make_model(seed=9)
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        engine = Engine(model=model, loss=nn.MSELoss(), optimizer=o)
+        engine.prepare(auto=True, model_desc=ModelDesc.from_model(
+            model, flops_per_token=2 * (8 * 16 + 16 * 4), num_layers=2,
+            hidden_size=16))
+        history = engine.fit(ds, epochs=1, batch_size=16)
+        np.testing.assert_allclose(history["loss"], ref_losses, rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_plan_submesh_of_visible_devices(self):
+        """Planning for fewer devices than visible takes a device-list
+        prefix (review regression: build_mesh crashed on sub-meshes)."""
+        from paddle_tpu.distributed.auto_parallel import Planner, ModelDesc
+        from paddle_tpu.models.llama import LlamaConfig
+
+        desc = ModelDesc.from_llama(LlamaConfig.tiny())
+        plan = Planner(desc).plan(4, (8, 32))
+        mesh = plan.build_mesh()
+        assert mesh.devices.size == 4
+
+    def test_engine_auto_batch_shape_defers_for_generic_model(self):
+        """prepare(auto=True, batch_shape=...) on a generic model (no
+        desc, no Llama config) defers planning to the first fit batch
+        instead of raising (review regression)."""
+        ds = RandomDataset(seed=13)
+        model = make_model(seed=13)
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        engine = Engine(model=model, loss=nn.MSELoss(), optimizer=o)
+        engine.prepare(auto=True, batch_shape=(16, 8))
+        assert engine.plan is None  # deferred, not crashed
+        history = engine.fit(ds, epochs=1, batch_size=16)
+        assert engine.plan is not None
+        assert np.isfinite(history["loss"]).all()
+
+    def test_from_model_measures_flops_via_xla(self):
+        """ModelDesc.from_model closes the CostEstimator loop: forward
+        FLOPs come from XLA's own cost analysis."""
+        from paddle_tpu.distributed.auto_parallel import ModelDesc
+
+        model = make_model(seed=11)
+        x = np.zeros((4, 8), np.float32)
+        desc = ModelDesc.from_model(model, example_args=[pt.to_tensor(x)])
+        # linear stack: ~2*(8*16 + 16*4) flops per row = 384
+        per_row = 2 * (8 * 16 + 16 * 4)
+        assert 0.5 * per_row <= desc.flops_per_token <= 3 * per_row
+        assert desc.param_bytes == (8 * 16 + 16 + 16 * 4 + 4) * 4
