@@ -308,6 +308,32 @@ def bench_decode():
         print(json.dumps({f"B{B}": out[f"B{B}"]}), file=sys.stderr,
               flush=True)
         gc.collect()
+    # ragged batch: 8 unequal prompts (256..512) LEFT-padded to 512 —
+    # the standard serving shape, one compiled program, mask as input
+    B = 8
+    lens = np.linspace(256, S1, B).astype(int)
+    ids = np.zeros((B, S1), np.int32)
+    mask = np.zeros((B, S1), np.int32)
+    for b, n in enumerate(lens):
+        ids[b, S1 - n:] = rng.randint(0, cfg.vocab_size, n)
+        mask[b, S1 - n:] = 1
+    ids_t, mask_t = pt.to_tensor(ids), pt.to_tensor(mask)
+
+    def t_ragged(mnt):
+        call = lambda: model.generate_compiled(  # noqa: E731
+            ids_t, max_new_tokens=mnt, temperature=0.0,
+            attention_mask=mask_t)
+        return _time_steps(call, 2, 1, lambda r: r.numpy())
+
+    t1, t2 = t_ragged(m1), t_ragged(m2)
+    per_tok = (t2 - t1) / (m2 - m1)
+    out["B8_ragged"] = {
+        "prompt_lens": f"{lens[0]}..{lens[-1]}",
+        "decode_ms_per_tok": round(per_tok * 1e3, 3),
+        "decode_tok_per_s": round(B / per_tok, 1),
+    }
+    print(json.dumps({"B8_ragged": out["B8_ragged"]}), file=sys.stderr,
+          flush=True)
     out["config"] = {"prompt": S1, "d": cfg.hidden_size,
                      "layers": cfg.num_hidden_layers,
                      "vocab": cfg.vocab_size, "dtype": "bf16"}

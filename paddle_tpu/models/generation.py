@@ -69,7 +69,8 @@ def generate_loop(prefill, decode, input_ids, max_new_tokens: int = 32,
 def compiled_generate(model, input_ids, max_new_tokens: int = 32,
                       temperature: float = 0.0, top_k: int = 0,
                       top_p: float = 1.0, eos_token_id=None,
-                      prefill_chunk: int = 0) -> Tensor:
+                      prefill_chunk: int = 0,
+                      attention_mask=None) -> Tensor:
     """The WHOLE generate loop as one compiled program.
 
     Prefill + ``max_new_tokens`` decode steps run inside a single jit:
@@ -94,6 +95,17 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
     from O(S·L) scores to O(chunk·L) — the long-prompt serving shape. The
     prompt length must divide evenly; outputs are identical to one-shot
     prefill.
+
+    ``attention_mask`` ([B, S], 1 real / 0 pad) serves a batch of UNEQUAL
+    prompts — the standard serving shape. Prompts must be LEFT-padded
+    (pads then tokens; validated eagerly): rows stay right-aligned so
+    every row appends generated tokens at the same buffer index, per-row
+    RoPE offsets put each row's first real token at position 0, and a
+    key-liveness mask keeps pads out of every attention window
+    (reference mask threading: ``nn/layer/transformer.py:84``
+    ``_convert_attention_mask``). Each row's output is token-for-token
+    equal to generating its prompt alone. The mask is a traced INPUT:
+    serving batches with different pad patterns reuse one executable.
     """
     from paddle_tpu.jit.functional import functional_state, swap_state
 
@@ -125,10 +137,27 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
     else:
         project = model.lm_head
 
-    def run_model(stt, toks, caches):
+    ragged = attention_mask is not None
+    if ragged:
+        am_arr = np.asarray(attention_mask.data
+                            if isinstance(attention_mask, Tensor)
+                            else attention_mask).astype(bool)
+        if am_arr.shape != (B, S):
+            raise ValueError(
+                f"attention_mask shape {am_arr.shape} != ids {(B, S)}")
+        if not am_arr[:, -1].all() or \
+                (np.diff(am_arr.astype(np.int8), axis=1) < 0).any():
+            raise ValueError(
+                "attention_mask must be LEFT-padded (0s then 1s per row, "
+                "last column all real) — right-align the prompts")
+        pad_counts = (S - am_arr.sum(1)).astype(np.int32)
+
+    def run_model(stt, toks, caches, km=None, po=None):
         tens = [tuple(Tensor(a) for a in c) for c in caches]
+        kw = {} if km is None else {
+            "attention_mask": Tensor(km), "pos_offsets": Tensor(po)}
         with no_grad(), swap_state(model, stt, collect_buffers=False):
-            h, new_c = backbone(Tensor(toks), caches=tens)
+            h, new_c = backbone(Tensor(toks), caches=tens, **kw)
             logits = project(h[:, -1:, :])
         return logits.data, [tuple(t.data for t in c) for c in new_c]
 
@@ -149,10 +178,18 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
         if prefill_chunk >= S:
             prefill_chunk = 0  # one-shot: share that executable
 
-    def whole(stt, ids, key):
+    def whole(stt, ids, key, *rag):
         caches = [(jnp.zeros((B, L, n_kv, hd), dtype),
                    jnp.zeros((B, L, n_kv, hd), dtype),
                    jnp.zeros((), jnp.int32)) for _ in range(nl)]
+        if ragged:
+            am, po = rag
+            # key-liveness over the WHOLE buffer: prompt pads stay dead
+            # forever; generated slots turn live as they are written
+            km = jnp.concatenate([am.astype(bool),
+                                  jnp.zeros((B, mnt), bool)], 1)
+        else:
+            km = po = None
         if prefill_chunk:
             # chunked prefill: same static cache, offset-causal per chunk
             # (scan keeps the program O(1) in chunk count)
@@ -161,13 +198,13 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
                 ids.reshape(B, n_chunks, prefill_chunk), 0, 1)
 
             def pre(cc, chunk):
-                lg, cc = run_model(stt, chunk, cc)
+                lg, cc = run_model(stt, chunk, cc, km, po)
                 return cc, lg
 
             caches, lgs = jax.lax.scan(pre, caches, chunks)
             logits = lgs[-1]
         else:
-            logits, caches = run_model(stt, ids, caches)
+            logits, caches = run_model(stt, ids, caches, km, po)
         key, sub = jax.random.split(key)
         finished = jnp.zeros((B,), bool)
         tok, finished = pick(logits, finished, sub)
@@ -175,21 +212,26 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
         out = jax.lax.dynamic_update_slice(out, tok[:, None], (0, 0))
 
         def body(carry, i):
-            caches, tok, finished, key, out = carry
-            logits, caches = run_model(stt, tok[:, None], caches)
+            caches, tok, finished, key, out, km = carry
+            if ragged:
+                # the token decoded at step i-1 was written to buffer
+                # index S+i-1: it becomes a live key for this step
+                km = jax.lax.dynamic_update_slice(
+                    km, jnp.ones((B, 1), bool), (0, S + i - 1))
+            logits, caches = run_model(stt, tok[:, None], caches, km, po)
             key, sub = jax.random.split(key)
             nxt, finished = pick(logits, finished, sub)
             out = jax.lax.dynamic_update_slice(out, nxt[:, None], (0, i))
-            return (caches, nxt, finished, key, out), None
+            return (caches, nxt, finished, key, out, km), None
 
         if mnt > 1:
-            (caches, tok, finished, key, out), _ = jax.lax.scan(
-                body, (caches, tok, finished, key, out),
+            (caches, tok, finished, key, out, km), _ = jax.lax.scan(
+                body, (caches, tok, finished, key, out, km),
                 jnp.arange(1, mnt))
         return jnp.concatenate([ids, out], axis=1)
 
     sig = (B, S, mnt, float(temperature), int(top_k), float(top_p),
-           eos_token_id, str(dtype), int(prefill_chunk),
+           eos_token_id, str(dtype), int(prefill_chunk), ragged,
            tuple(sorted(st)))
     # LRU-capped executable cache: a serving loop over naturally varying
     # prompt lengths would otherwise retain one executable per length for
@@ -207,5 +249,7 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
     # greedy decoding draws nothing: leave the global RNG stream untouched
     # (eager generate doesn't advance it either — pipeline reproducibility)
     key = jax.random.PRNGKey(0) if temperature == 0 else G.next_key()
-    seq = cache[sig](st, ids_arr, key)
+    rag_args = (jnp.asarray(am_arr), jnp.asarray(pad_counts)) if ragged \
+        else ()
+    seq = cache[sig](st, ids_arr, key, *rag_args)
     return Tensor(seq)

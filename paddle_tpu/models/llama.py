@@ -140,7 +140,7 @@ class LlamaAttention(nn.Layer):
         self.o_proj = _make_linear(cfg, self.n_heads * self.head_dim,
                                    cfg.hidden_size, "row")
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, attention_mask=None, pos_offsets=None):
         """``cache=(k, v)`` ([B, P, n_kv, hd] each, P may be 0) switches to
         the incremental-decode path: returns (out, (k', v')). A
         ``cache=(k_buf, v_buf, pos)`` triple ([B, L, n_kv, hd] preallocated
@@ -148,18 +148,47 @@ class LlamaAttention(nn.Layer):
         every decode step has identical shapes, which is what lets the
         whole generate loop compile into one program
         (``generation.compiled_generate``). Without a cache, plain causal
-        flash attention returns just ``out``."""
+        flash attention returns just ``out``.
+
+        ``attention_mask`` (reference mask threading:
+        ``python/paddle/nn/layer/transformer.py:84 _convert_attention_mask``
+        + ``fused_attention_op.cc`` arbitrary masks):
+          * cacheless path — [B, S] 1/0 padding mask routed into the flash
+            kernel's segment-id path (pad tokens attend nothing real);
+          * static-cache path — [B, L] KEY-liveness mask over the whole
+            buffer (False = never attend: pads and unwritten slots ahead
+            are excluded by it and by the causal bound).
+        ``pos_offsets`` ([B] int32, static path) shifts RoPE positions per
+        row — a LEFT-padded row with ``pad`` pads has its first real token
+        at position 0, not ``pad`` (the ragged-serving shape)."""
         if cache is not None and len(cache) == 3:
-            return self._static_forward(x, cache)
+            return self._static_forward(x, cache, attention_mask,
+                                        pos_offsets)
+        if cache is not None and (attention_mask is not None
+                                  or pos_offsets is not None):
+            raise NotImplementedError(
+                "attention_mask/pos_offsets are supported on the "
+                "cacheless (training) and static-cache (compiled "
+                "generation) paths; the eager growing-cache path has no "
+                "ragged support — use generate_compiled(attention_mask=…)")
         B, S = x.shape[0], x.shape[1]
         q = ops.reshape(self.q_proj(x), [B, S, self.n_heads, self.head_dim])
         k = ops.reshape(self.k_proj(x), [B, S, self.n_kv, self.head_dim])
         v = ops.reshape(self.v_proj(x), [B, S, self.n_kv, self.head_dim])
         if cache is None:
             q, k = apply_rotary(q, k, self.cfg.rope_theta)
-            # GQA served natively by the attention kernel: KV stay at n_kv
-            # heads end-to-end (no replication in HBM)
-            out = F.flash_attention(q, k, v, causal=True)
+            if attention_mask is not None:
+                # padding -> segment ids (real tokens segment 1, pads 0):
+                # the flash kernel's varlen form — pads never mix with
+                # real tokens in either direction
+                seg = ops.cast(attention_mask, "int32")
+                out = F.flash_attention(q, k, v, causal=True,
+                                        q_segment_ids=seg,
+                                        kv_segment_ids=seg)
+            else:
+                # GQA served natively by the attention kernel: KV stay at
+                # n_kv heads end-to-end (no replication in HBM)
+                out = F.flash_attention(q, k, v, causal=True)
             return self.o_proj(ops.reshape(out, [B, S, -1]))
         past_k, past_v = cache
         P = 0 if past_k is None else past_k.shape[1]
@@ -177,11 +206,17 @@ class LlamaAttention(nn.Layer):
         out = F.scaled_dot_product_attention(q, k_all, v_all, is_causal=True)
         return self.o_proj(ops.reshape(out, [B, S, -1])), (k_all, v_all)
 
-    def _static_forward(self, x, cache):
+    def _static_forward(self, x, cache, key_mask=None, pos_offsets=None):
         """Fixed-shape KV-cached attention: rotary at a TRACED position,
         dynamic_update_slice into the preallocated buffers, masked
         attention over the whole buffer (keys past ``pos+S`` masked out).
-        One tape node; S_q is 1 in decode, the prompt length in prefill."""
+        One tape node; S_q is 1 in decode, the prompt length in prefill.
+
+        Ragged batches: ``key_mask`` [B, L] marks attendable buffer slots
+        (pads False), ``pos_offsets`` [B] shifts each row's RoPE positions
+        so a left-padded row's first REAL token sits at position 0 —
+        buffer INDEX space stays row-independent (every row writes at
+        ``pos``..``pos+S``), only position space is per-row."""
         import jax
         import jax.numpy as jnp
 
@@ -195,14 +230,28 @@ class LlamaAttention(nn.Layer):
         grp = self.n_heads // self.n_kv
         theta = self.cfg.rope_theta
         scale = 1.0 / math.sqrt(hd)
+        ragged = key_mask is not None or pos_offsets is not None
+        if ragged:
+            if pos_offsets is None:
+                pos_offsets = ops.zeros([B], dtype="int32")
+            if key_mask is None:
+                key_mask = ops.ones([B, L], dtype="bool")
 
-        def f(qa, ka, va, kb, vb, p):
+        def f(qa, ka, va, kb, vb, p, *extra):
             p = jnp.reshape(p, ()).astype(jnp.int32)
             cos_np, sin_np = _rope_cache(L, hd, theta, str(qa.dtype))
-            cos = jax.lax.dynamic_slice_in_dim(
-                jnp.asarray(cos_np), p, S)[None, :, None, :]
-            sin = jax.lax.dynamic_slice_in_dim(
-                jnp.asarray(sin_np), p, S)[None, :, None, :]
+            if ragged:
+                po, km = extra
+                # per-row positions: row b, query j -> p + j - pad_b
+                pidx = jnp.clip(p + jnp.arange(S)[None, :]
+                                - po[:, None].astype(jnp.int32), 0, L - 1)
+                cos = jnp.asarray(cos_np)[pidx][:, :, None, :]  # [B,S,1,·]
+                sin = jnp.asarray(sin_np)[pidx][:, :, None, :]
+            else:
+                cos = jax.lax.dynamic_slice_in_dim(
+                    jnp.asarray(cos_np), p, S)[None, :, None, :]
+                sin = jax.lax.dynamic_slice_in_dim(
+                    jnp.asarray(sin_np), p, S)[None, :, None, :]
 
             def rot(t):
                 t1, t2 = t[..., 0::2], t[..., 1::2]
@@ -217,14 +266,20 @@ class LlamaAttention(nn.Layer):
             s = jnp.einsum("bskgh,blkh->bskgl", qg.astype(jnp.float32),
                            kb.astype(jnp.float32)) * scale
             q_pos = p + jnp.arange(S)
-            live = jnp.arange(L)[None, :] <= q_pos[:, None]  # [S, L]
-            s = jnp.where(live[None, :, None, None, :], s,
-                          jnp.finfo(jnp.float32).min)
+            causal = jnp.arange(L)[None, :] <= q_pos[:, None]  # [S, L]
+            if ragged:
+                live = causal[None, :, :] & km[:, None, :]     # [B, S, L]
+                s = jnp.where(live[:, :, None, None, :], s,
+                              jnp.finfo(jnp.float32).min)
+            else:
+                s = jnp.where(causal[None, :, None, None, :], s,
+                              jnp.finfo(jnp.float32).min)
             w = jax.nn.softmax(s, axis=-1).astype(va.dtype)
             out = jnp.einsum("bskgl,blkh->bskgh", w, vb)
             return out.reshape(B, S, self.n_heads * hd), kb, vb
 
-        out, kb2, vb2 = apply_op(f, q, k, v, k_buf, v_buf, pos,
+        extra = (pos_offsets, key_mask) if ragged else ()
+        out, kb2, vb2 = apply_op(f, q, k, v, k_buf, v_buf, pos, *extra,
                                  op_name="static_kv_attention")
         return self.o_proj(out), (kb2, vb2, pos + S)
 
@@ -254,13 +309,16 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, attention_mask=None, pos_offsets=None):
         if cache is None:
-            x = ops.add(x, self.self_attn(self.input_layernorm(x)))
+            x = ops.add(x, self.self_attn(self.input_layernorm(x),
+                                          attention_mask=attention_mask))
             x = ops.add(x, self.mlp(self.post_attention_layernorm(x)))
             return x
         attn_out, new_cache = self.self_attn(self.input_layernorm(x),
-                                             cache=cache)
+                                             cache=cache,
+                                             attention_mask=attention_mask,
+                                             pos_offsets=pos_offsets)
         x = ops.add(x, attn_out)
         x = ops.add(x, self.mlp(self.post_attention_layernorm(x)))
         return x, new_cache
@@ -280,15 +338,25 @@ class LlamaModel(nn.Layer):
                                     for _ in range(cfg.num_hidden_layers)])
         self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
 
-    def forward(self, input_ids, caches=None):
+    def forward(self, input_ids, caches=None, attention_mask=None,
+                pos_offsets=None):
+        """``attention_mask``: [B, S] 1/0 padding mask (cacheless path,
+        flash segment ids) or [B, L] buffer key-liveness mask (static-
+        cache path); ``pos_offsets``: [B] per-row RoPE shift for
+        left-padded ragged batches (static path only). Reference mask
+        threading: ``nn/layer/transformer.py:84``."""
         x = self.embed_tokens(input_ids)
         if caches is None:
             for layer in self.layers:
                 if self.cfg.recompute and self.training:
                     from paddle_tpu.distributed.fleet import recompute
-                    x = recompute(layer, x)
+                    if attention_mask is None:
+                        x = recompute(layer, x)
+                    else:
+                        x = recompute(layer, x,
+                                      attention_mask=attention_mask)
                 else:
-                    x = layer(x)
+                    x = layer(x, attention_mask=attention_mask)
             return self.norm(x)
         if len(caches) != len(self.layers):
             raise ValueError(
@@ -296,7 +364,8 @@ class LlamaModel(nn.Layer):
                 f"{len(self.layers)} layers")
         new_caches = []
         for layer, c in zip(self.layers, caches):
-            x, nc = layer(x, cache=c)
+            x, nc = layer(x, cache=c, attention_mask=attention_mask,
+                          pos_offsets=pos_offsets)
             new_caches.append(nc)
         return self.norm(x), new_caches
 
@@ -329,8 +398,11 @@ class LlamaForCausalLM(nn.Layer):
     # available to callers)
     _FUSED_CE_MIN_VOCAB = 32768
 
-    def forward(self, input_ids, labels=None):
-        h = self.model(input_ids)
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        """``attention_mask`` [B, S] (1 real / 0 pad) masks padded tokens
+        out of attention (flash segment ids); set padded label positions
+        to -100 so the loss ignores them too."""
+        h = self.model(input_ids, attention_mask=attention_mask)
         if labels is not None and labels.shape[1] < 2:
             raise ValueError(
                 "causal-LM loss needs sequences of length >= 2 (the "
@@ -392,15 +464,17 @@ class LlamaForCausalLM(nn.Layer):
     def generate_compiled(self, input_ids, max_new_tokens: int = 32,
                           temperature: float = 0.0, top_k: int = 0,
                           top_p: float = 1.0, eos_token_id=None,
-                          prefill_chunk: int = 0):
+                          prefill_chunk: int = 0, attention_mask=None):
         """Whole-loop compiled generation: prefill + every decode step in
         ONE jitted program over static KV buffers (see
         ``generation.compiled_generate``). Greedy output is token-for-token
-        equal to ``generate``."""
+        equal to ``generate``; ``attention_mask`` serves a LEFT-padded
+        batch of unequal prompts, each row equal to its solo run."""
         from .generation import compiled_generate
         return compiled_generate(self, input_ids, max_new_tokens,
                                  temperature, top_k, top_p, eos_token_id,
-                                 prefill_chunk=prefill_chunk)
+                                 prefill_chunk=prefill_chunk,
+                                 attention_mask=attention_mask)
 
     @staticmethod
     def flops_per_token(cfg: LlamaConfig) -> float:

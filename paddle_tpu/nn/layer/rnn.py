@@ -252,7 +252,11 @@ def _scan_rnn(cell, inputs, initial_states, sequence_length=None,
                 out = jnp.where(keep, out, jnp.zeros_like(out))
             return new_tuple, out
 
-        init = tuple(s for s in st)
+        # inside a shard_map manual region (SPMD hetero pipeline stages)
+        # the inputs may be device-varying while the fresh zero states are
+        # not; the scan carry must type-match its output's varying axes
+        from paddle_tpu.distributed.fleet.utils import match_vma
+        init = tuple(match_vma(s, xt) for s in st)
         carry, outs = jax.lax.scan(body, init,
                                    (jnp.arange(T), xt))
         if is_reverse:

@@ -156,3 +156,114 @@ def test_chunked_prefill_matches_one_shot():
     np.testing.assert_array_equal(chunked.numpy(), one.numpy())
     with pytest.raises(ValueError, match="divide"):
         m.generate_compiled(ids, max_new_tokens=4, prefill_chunk=5)
+
+
+# ---------------- ragged (unequal-prompt) batches -----------------------------
+def _pad_left(prompts, pad_id=0):
+    """Right-align a list of 1-D token arrays; returns (ids, mask)."""
+    S = max(len(p) for p in prompts)
+    B = len(prompts)
+    ids = np.full((B, S), pad_id, np.int64)
+    mask = np.zeros((B, S), np.int64)
+    for b, p in enumerate(prompts):
+        ids[b, S - len(p):] = p
+        mask[b, S - len(p):] = 1
+    return ids, mask
+
+
+def test_ragged_batch_matches_solo_runs():
+    """VERDICT r4 item 2 'done' bar: a ragged batch generates each row
+    token-for-token equal to running that prompt alone."""
+    m = _tiny(11)
+    m.eval()
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 128, n).astype(np.int64)
+               for n in (5, 9, 12)]
+    ids, mask = _pad_left(prompts)
+    out = m.generate_compiled(pt.to_tensor(ids), max_new_tokens=8,
+                              temperature=0.0,
+                              attention_mask=pt.to_tensor(mask)).numpy()
+    S = ids.shape[1]
+    for b, p in enumerate(prompts):
+        solo = m.generate_compiled(pt.to_tensor(p[None, :]),
+                                   max_new_tokens=8,
+                                   temperature=0.0).numpy()[0]
+        np.testing.assert_array_equal(
+            out[b, S:], solo[len(p):],
+            err_msg=f"row {b} (prompt len {len(p)}) diverges from solo")
+
+
+def test_ragged_equal_lengths_match_unmasked():
+    """A full mask (no pads) must reproduce the maskless path exactly."""
+    m = _tiny(12)
+    m.eval()
+    ids = np.random.RandomState(12).randint(0, 128, (3, 7)).astype(np.int64)
+    mask = np.ones_like(ids)
+    got = m.generate_compiled(pt.to_tensor(ids), max_new_tokens=6,
+                              temperature=0.0,
+                              attention_mask=pt.to_tensor(mask)).numpy()
+    want = m.generate_compiled(pt.to_tensor(ids), max_new_tokens=6,
+                               temperature=0.0).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ragged_executable_reused_across_pad_patterns():
+    """The mask is a traced input: two batches with different pad
+    patterns share one compiled executable."""
+    m = _tiny(13)
+    m.eval()
+    rng = np.random.RandomState(13)
+    for lens in [(3, 6), (6, 4)]:
+        prompts = [rng.randint(1, 128, n).astype(np.int64) for n in lens]
+        ids, mask = _pad_left(prompts)
+        m.generate_compiled(pt.to_tensor(ids), max_new_tokens=3,
+                            temperature=0.0,
+                            attention_mask=pt.to_tensor(mask))
+    assert len(m.__dict__["_compiled_generate"]) == 1
+
+
+def test_ragged_rejects_right_padding():
+    m = _tiny(14)
+    m.eval()
+    ids = np.random.RandomState(14).randint(1, 128, (2, 6)).astype(np.int64)
+    mask = np.ones((2, 6), np.int64)
+    mask[0, 4:] = 0  # right padding
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        m.generate_compiled(pt.to_tensor(ids), max_new_tokens=2,
+                            temperature=0.0,
+                            attention_mask=pt.to_tensor(mask))
+
+
+def test_ragged_with_chunked_prefill():
+    """Ragged + chunked prefill compose (both ride the same static
+    cache/key-mask machinery)."""
+    m = _tiny(15)
+    m.eval()
+    rng = np.random.RandomState(15)
+    prompts = [rng.randint(1, 128, n).astype(np.int64) for n in (4, 8)]
+    ids, mask = _pad_left(prompts)  # S = 8, chunk 4 divides
+    want = m.generate_compiled(pt.to_tensor(ids), max_new_tokens=5,
+                               temperature=0.0,
+                               attention_mask=pt.to_tensor(mask)).numpy()
+    got = m.generate_compiled(pt.to_tensor(ids), max_new_tokens=5,
+                              temperature=0.0, prefill_chunk=4,
+                              attention_mask=pt.to_tensor(mask)).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_padded_training_forward_matches_solo():
+    """Cacheless path: attention_mask -> flash segment ids. A padded row's
+    REAL positions produce the same hidden states as the solo run
+    (right-padding, the training shape)."""
+    m = _tiny(16)
+    m.eval()
+    rng = np.random.RandomState(16)
+    solo = rng.randint(1, 128, (1, 5)).astype(np.int64)
+    ids = np.concatenate([solo, np.zeros((1, 3), np.int64)], 1)
+    mask = np.concatenate([np.ones((1, 5), np.int64),
+                           np.zeros((1, 3), np.int64)], 1)
+    logits_pad = m(pt.to_tensor(ids),
+                   attention_mask=pt.to_tensor(mask)).numpy()
+    logits_solo = m(pt.to_tensor(solo)).numpy()
+    np.testing.assert_allclose(logits_pad[:, :5], logits_solo,
+                               rtol=2e-4, atol=2e-5)
