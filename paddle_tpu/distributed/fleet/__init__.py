@@ -26,7 +26,7 @@ from .pipeline import (  # noqa: F401
 from . import spmd_pipeline  # noqa: F401
 from .spmd_pipeline import (  # noqa: F401
     pipeline_spmd, spmd_schedule_stats, SpmdPipelineLayer,
-    SpmdPipelineParallel,
+    SpmdPipelineParallel, pipeline_spmd_hetero, SpmdHeteroPipelineLayer,
 )
 from .mpu import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
@@ -39,7 +39,7 @@ __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
            "worker_num", "worker_index", "mpu", "ColumnParallelLinear",
            "RowParallelLinear", "VocabParallelEmbedding",
-           "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel", "pipeline_spmd", "spmd_schedule_stats", "SpmdPipelineLayer", "SpmdPipelineParallel", "MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ring_attention", "ulysses_attention", "scatter_sequence", "gather_sequence", "utils", "recompute"]
+           "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel", "pipeline_spmd", "spmd_schedule_stats", "SpmdPipelineLayer", "SpmdPipelineParallel", "pipeline_spmd_hetero", "SpmdHeteroPipelineLayer", "MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ring_attention", "ulysses_attention", "scatter_sequence", "gather_sequence", "utils", "recompute"]
 
 _state = {"hcg": None, "strategy": None}
 

