@@ -447,9 +447,32 @@ def pipeline_spmd_hetero(chunk_bodies, chunk_params, micro_inputs,
 
         def branch(vec, shared, x):
             return body(unflatten(c, vec), shared, x)
-        return jax.checkpoint(branch) if remat else branch
+        return branch
 
     branches = [make_branch(c) for c in range(C)]
+    return _hetero_schedule(branches, padded, shared_params, micro_inputs,
+                            mesh, axis, v, remat)
+
+
+def _hetero_schedule(branches, padded, shared_params, micro_inputs,
+                     mesh, axis, num_virtual_stages, remat=True):
+    """Schedule core over the ALREADY padded-stacked [v, S, Lmax] param
+    array: ``branches[c](vec, shared, x)`` unflattens its own chunk's
+    slice via static metadata. Split out so SpmdHeteroPipelineLayer can
+    feed its stored stacked Parameter directly — routing a per-step
+    slice/re-pad/re-stack round trip over the whole trunk through the
+    public list-of-pytrees API wasted HBM bandwidth every step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    v = num_virtual_stages
+    S = mesh.shape[axis]
+    C = v * S
+    if remat:
+        branches = [jax.checkpoint(b) for b in branches]
+    if shared_params is None:
+        shared_params = {}
 
     leaves = jax.tree_util.tree_leaves(micro_inputs)
     M = leaves[0].shape[0]
@@ -612,10 +635,18 @@ class SpmdHeteroPipelineLayer(Layer):
         v, S = num_virtual_stages, self.num_stages
         flats = []
         dtype = None
-        for b in blocks:
+        for c, b in enumerate(blocks):
             ps = [p.data for _, p in b.named_parameters()]
             for p in ps:
-                dtype = dtype or p.dtype
+                if dtype is None:
+                    dtype = p.dtype
+                elif p.dtype != dtype:
+                    # same contract the function API enforces — a silent
+                    # concatenate would promote everything to the widest
+                    # dtype (wrong memory footprint, no error)
+                    raise ValueError(
+                        "hetero pipeline blocks must share one param "
+                        f"dtype (chunk {c} mixes {p.dtype} with {dtype})")
             flat = jnp.concatenate([p.reshape(-1) for p in ps]) if ps \
                 else jnp.zeros((0,), dtype or jnp.float32)
             flats.append(jnp.pad(flat, (0, Lmax - flat.size)))
@@ -684,18 +715,25 @@ class SpmdHeteroPipelineLayer(Layer):
 
         def f(xs, flat, *shared_leaves):
             shared_p = dict(zip(shared_keys, shared_leaves))
-            vecs = flat.reshape(C, -1)
-            chunk_params = []
-            for c in range(C):
-                out, off = {}, 0
-                for name, shp in zip(nm[c], shapes[c]):
-                    n = int(np.prod(shp)) if shp else 1
-                    out[name] = vecs[c, off:off + n].reshape(shp)
-                    off += n
-                chunk_params.append(out)
-            return pipeline_spmd_hetero(
-                bodies, chunk_params, xs, mesh=mesh, axis=axis,
-                num_virtual_stages=v, shared_params=shared_p, remat=remat)
+
+            def make_branch(c):
+                body = bodies[c]
+
+                def branch(vec, shared, x):
+                    # unflatten THIS chunk's slice of the stacked padded
+                    # param (static recipe); the stacked array feeds the
+                    # schedule directly — no per-step re-pad/re-stack
+                    out, off = {}, 0
+                    for name, shp in zip(nm[c], shapes[c]):
+                        n = int(np.prod(shp)) if shp else 1
+                        out[name] = vec[off:off + n].reshape(shp)
+                        off += n
+                    return body(out, shared, x)
+                return branch
+
+            return _hetero_schedule(
+                [make_branch(c) for c in range(C)], flat, shared_p, xs,
+                mesh, axis, v, remat)
 
         return apply_op(f, micro_x, self.trunk_flat,
                         *[shared_named[k] for k in shared_keys],

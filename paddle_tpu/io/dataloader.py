@@ -131,7 +131,12 @@ class _ProcessPool:
         self._nw = num_workers
         self._inflight_cap = max(prefetch_factor, 1) * num_workers
         self._index_queues = [ctx.SimpleQueue() for _ in range(num_workers)]
-        self._result_queue = ctx.SimpleQueue()
+        # a real Queue (not SimpleQueue): its timeout-get lets the
+        # consumer notice a DEAD worker (OOM-kill/segfault in a C
+        # extension) instead of blocking forever on a batch that will
+        # never arrive — the reference dataloader watches worker
+        # sentinels for exactly this
+        self._result_queue = ctx.Queue()
         self._procs = [
             ctx.Process(target=_process_worker,
                         args=(dataset, collate_fn, worker_init_fn, w,
@@ -161,7 +166,18 @@ class _ProcessPool:
                 if inflight == 0:
                     return
                 while next_yield not in done:
-                    bidx, batch = self._result_queue.get()
+                    import queue as _q
+                    try:
+                        bidx, batch = self._result_queue.get(timeout=5.0)
+                    except _q.Empty:
+                        dead = [w for w, p in enumerate(self._procs)
+                                if not p.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) {dead} died "
+                                "without delivering their batch (killed "
+                                "or crashed in __getitem__)")
+                        continue
                     done[bidx] = batch
                 batch = done.pop(next_yield)
                 next_yield += 1

@@ -413,18 +413,10 @@ class LlamaForCausalLM(nn.Layer):
             # logits never materialize (ops/fused_ce.py). Returns
             # (None, loss): producing logits would rebuild the tensor the
             # fusion exists to avoid.
-            from paddle_tpu.ops.fused_ce import matmul_cross_entropy
+            from paddle_tpu.ops.fused_ce import causal_lm_loss
             w = self.model.embed_tokens.weight
-
-            def f(ha, wa, lab):
-                tgt = lab[:, 1:].reshape(-1)
-                per_tok = matmul_cross_entropy(
-                    ha[:, :-1, :].reshape(-1, ha.shape[-1]), wa, tgt)
-                # masked mean over non-ignored tokens, matching the
-                # reference cross_entropy(reduction='mean') semantics
-                valid = (tgt != -100).astype(per_tok.dtype)
-                return per_tok.sum() / jnp.maximum(valid.sum(), 1.0)
-            loss = apply_op(f, h, w, labels, op_name="fused_causal_ce")
+            loss = apply_op(causal_lm_loss, h, w, labels,
+                            op_name="fused_causal_ce")
             return None, loss
         logits = self._logits(h)
         if labels is None:

@@ -1293,7 +1293,8 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             new = new + emit
             return new, new
 
-        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:],
+                                 unroll=min(int(lp.shape[0] - 1), 8))
         # [T, B, S] alpha per timestep; read each sample's alpha at its own
         # final frame t = input_lengths[b] - 1 (padded frames past the true
         # length must not contribute — warpctc honors per-sample lengths).

@@ -23,7 +23,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["matmul_cross_entropy"]
+__all__ = ["matmul_cross_entropy", "causal_lm_loss"]
 
 _DEF_CHUNKS = 8
 
@@ -167,3 +167,17 @@ def matmul_cross_entropy(h, w_vd, labels, ignore_index: int = -100,
         n_chunks = 1
     loss = _mce(h2, w_vd, lab, valid, n_chunks)
     return loss.reshape(lead)
+
+
+def causal_lm_loss(h, w_vd, labels, ignore_index: int = -100):
+    """Masked-mean causal-LM loss over the fused chunked matmul-CE —
+    the ONE definition shared by the zoo's tied/untied LMs (position t
+    predicts token t+1, the HF shift; ``ignore_index`` positions
+    contribute zero loss and zero denominator). ``h`` [B, S, d] raw
+    arrays, ``w_vd`` [V, d]."""
+    tgt = labels[:, 1:].reshape(-1)
+    per_tok = matmul_cross_entropy(
+        h[:, :-1, :].reshape(-1, h.shape[-1]), w_vd, tgt,
+        ignore_index=ignore_index)
+    valid = (tgt != ignore_index).astype(per_tok.dtype)
+    return per_tok.sum() / jnp.maximum(valid.sum(), 1.0)

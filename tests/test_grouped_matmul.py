@@ -1,0 +1,114 @@
+"""Grouped-matmul Pallas kernel (the cutlass moe_kernel.cu analog,
+ops/pallas/grouped_matmul.py): forward + custom_vjp parity vs per-group
+numpy/jax oracles, in interpret mode on CPU (the kernels compile for TPU
+on chip). Includes empty groups, non-divisible row counts, and the
+bm-aligned mask-free fast path."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.grouped_matmul import gmm, gmm_aligned, tgmm
+
+E, M, H, BM = 4, 64, 32, 8
+
+
+@pytest.mark.parametrize("sizes", [[5, 0, 11, 3], [8, 8, 8, 8],
+                                   [0, 0, 30, 2], [1, 1, 1, 1]])
+def test_gmm_forward_and_grads_match_oracle(sizes):
+    rng = np.random.RandomState(sum(sizes))
+    R = 40
+    gs = np.array(sizes, np.int32)
+    lhs = rng.randn(R, M).astype(np.float32)
+    rhs = rng.randn(E, M, H).astype(np.float32)
+    out = gmm(jnp.asarray(lhs), jnp.asarray(rhs), jnp.asarray(gs), bm=BM)
+    want = np.zeros((R, H), np.float32)
+    off = 0
+    for e in range(E):
+        want[off:off + gs[e]] = lhs[off:off + gs[e]] @ rhs[e]
+        off += gs[e]
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+
+    def loss(l, r):
+        return (gmm(l, r, jnp.asarray(gs), bm=BM) ** 2).sum()
+
+    gl, gr = jax.grad(loss, argnums=(0, 1))(jnp.asarray(lhs),
+                                            jnp.asarray(rhs))
+
+    def loss_ref(l, r):
+        outs, o = [], 0
+        for e in range(E):
+            n = int(gs[e])
+            outs.append(l[o:o + n] @ r[e])
+            o += n
+        outs.append(jnp.zeros((R - o, H)))
+        return (jnp.concatenate(outs) ** 2).sum()
+
+    gl2, gr2 = jax.grad(loss_ref, argnums=(0, 1))(jnp.asarray(lhs),
+                                                  jnp.asarray(rhs))
+    np.testing.assert_allclose(np.asarray(gl), np.asarray(gl2), atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gr), np.asarray(gr2), atol=1e-3)
+
+
+def test_tgmm_matches_oracle():
+    rng = np.random.RandomState(7)
+    gs = np.array([5, 0, 11, 3], np.int32)
+    lhs = rng.randn(40, M).astype(np.float32)
+    g = rng.randn(40, H).astype(np.float32)
+    out = tgmm(jnp.asarray(lhs), jnp.asarray(g), jnp.asarray(gs), E, bm=BM)
+    want = np.zeros((E, M, H), np.float32)
+    off = 0
+    for e in range(E):
+        want[e] = lhs[off:off + gs[e]].T @ g[off:off + gs[e]]
+        off += gs[e]
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-3)
+
+
+@pytest.mark.parametrize("sizes", [[16, 0, 24, 8], [8, 8, 8, 8],
+                                   [0, 0, 40, 0]])
+def test_gmm_aligned_forward_and_grads(sizes):
+    """bm-aligned groups: the mask-free fast path; pad rows must be zero
+    and produce zeros, empty experts get zero d_rhs (not garbage)."""
+    rng = np.random.RandomState(sum(sizes) + 1)
+    gs = np.array(sizes, np.int32)
+    R = 48
+    lhs = rng.randn(R, M).astype(np.float32)
+    lhs[gs.sum():] = 0
+    rhs = rng.randn(E, M, H).astype(np.float32)
+    out = gmm_aligned(jnp.asarray(lhs), jnp.asarray(rhs), jnp.asarray(gs),
+                      bm=BM)
+    off = 0
+    want = np.zeros((R, H), np.float32)
+    for e in range(E):
+        want[off:off + gs[e]] = lhs[off:off + gs[e]] @ rhs[e]
+        off += gs[e]
+    np.testing.assert_allclose(np.asarray(out)[:off], want[:off],
+                               atol=1e-4)
+
+    def loss(l, r):
+        return (gmm_aligned(l, r, jnp.asarray(gs), bm=BM)[:off] ** 2).sum()
+
+    gl, gr = jax.grad(loss, argnums=(0, 1))(jnp.asarray(lhs),
+                                            jnp.asarray(rhs))
+
+    def loss_ref(l, r):
+        outs, o = [], 0
+        for e in range(E):
+            n = int(gs[e])
+            outs.append(l[o:o + n] @ r[e])
+            o += n
+        return (jnp.concatenate(outs) ** 2).sum()
+
+    gl2, gr2 = jax.grad(loss_ref, argnums=(0, 1))(jnp.asarray(lhs),
+                                                  jnp.asarray(rhs))
+    np.testing.assert_allclose(np.asarray(gl)[:off], np.asarray(gl2)[:off],
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gr), np.asarray(gr2), atol=1e-3)
+    assert np.isfinite(np.asarray(gr)).all()
+
+
+def test_gmm_rejects_undivisible_rows():
+    with pytest.raises(ValueError, match="divide"):
+        gmm(jnp.zeros((10, M)), jnp.zeros((E, M, H)),
+            jnp.asarray(np.array([10, 0, 0, 0], np.int32)), bm=BM)

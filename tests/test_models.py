@@ -94,7 +94,12 @@ def test_moe_forward_backward_with_aux():
     ids = pt.to_tensor(np.random.RandomState(2).randint(
         0, cfg.vocab_size, (2, 8)).astype(np.int64))
     logits, loss = model(ids, labels=ids)
-    assert list(logits.shape) == [2, 8, cfg.vocab_size]
+    # the labeled path is loss-only (logits=None, like the fused-CE
+    # branch): the loss never reads the last position's logits and the
+    # head matmul over it profiled at ~1.2 ms/step of pure copies
+    assert logits is None
+    infer = model(ids)
+    assert list(infer.shape) == [2, 8, cfg.vocab_size]
     # layer 0 dense (first_k_dense_replace=1), layer 1 MoE with aux loss
     assert model.layers[0].is_dense and not model.layers[1].is_dense
     assert model.aux_loss() is not None
