@@ -34,19 +34,42 @@ import numpy as np
 
 
 def peak_flops(device) -> float:
-    """bf16 peak per chip by device kind (public TPU specs)."""
-    kind = getattr(device, "device_kind", "").lower()
-    table = [
-        ("v6e", 918e12), ("trillium", 918e12),
-        ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
-        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-    ]
-    for key, val in table:
-        if key in kind:
-            return val
-    if "tpu" in kind:
-        return 275e12  # conservative default for unknown TPU
-    return 0.0  # CPU: MFU not meaningful
+    """bf16 peak per chip (shared with the telemetry layer's MFU gauge)."""
+    from paddle_tpu.observability.step_timer import peak_flops as pf
+    return pf(device)
+
+
+def emit_metrics(payload: dict, path: str):
+    """Write ``payload``'s numeric leaves through the observability
+    metrics registry as labeled ``bench_result`` gauges and dump the
+    registry's JSON exposition to ``path`` — so BENCH_*.json rounds,
+    ad-hoc runs, and live training scrapes all share one schema."""
+    from paddle_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    g = reg.gauge("bench_result", "benchmark scalar results by key path")
+
+    def walk(prefix, obj):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(f"{prefix}.{k}" if prefix else str(k), v)
+        elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+            g.set(float(obj), key=prefix)
+
+    walk("", payload)
+    with open(path, "w") as f:
+        json.dump(reg.to_json(), f, indent=1)
+    print(f"metrics written to {path}", file=sys.stderr)
+
+
+def _metrics_out_path():
+    """--emit-metrics PATH (or BENCH_EMIT_METRICS env)."""
+    if "--emit-metrics" in sys.argv:
+        i = sys.argv.index("--emit-metrics")
+        if i + 1 >= len(sys.argv):
+            raise SystemExit("--emit-metrics requires an output path")
+        return sys.argv[i + 1]
+    return os.environ.get("BENCH_EMIT_METRICS")
 
 
 def _time_steps(fn, steps, warmup, ready, reps=3):
@@ -424,16 +447,27 @@ def bench_eager():
 def main():
     import jax
 
+    metrics_out = _metrics_out_path()
+
     if "--suite" in sys.argv or os.environ.get("BENCH_SUITE"):
-        print(json.dumps({"suite": bench_suite()}))
+        suite = bench_suite()
+        print(json.dumps({"suite": suite}))
+        if metrics_out:
+            emit_metrics({"suite": suite}, metrics_out)
         return
 
     if "--decode" in sys.argv:
-        print(json.dumps({"decode": bench_decode()}))
+        decode = bench_decode()
+        print(json.dumps({"decode": decode}))
+        if metrics_out:
+            emit_metrics({"decode": decode}, metrics_out)
         return
 
     if "--eager" in sys.argv:
-        print(json.dumps({"eager": bench_eager()}))
+        eager = bench_eager()
+        print(json.dumps({"eager": eager}))
+        if metrics_out:
+            emit_metrics({"eager": eager}, metrics_out)
         return
 
     on_tpu = jax.default_backend() == "tpu"
@@ -460,6 +494,8 @@ def main():
                   "vs_baseline": 0.0}
     print(json.dumps(result))
     print(json.dumps(extras), file=sys.stderr)
+    if metrics_out:
+        emit_metrics({"headline": result, "detail": extras}, metrics_out)
 
 
 

@@ -23,6 +23,18 @@
 //                                 ring keeps the first `capacity`)
 //   ht_read(i, ...)               copy out event i (fails on unpublished)
 //   ht_stop()                     quiesce writers + free the ring
+//
+// A second, independent ring backs the crash flight recorder
+// (paddle_tpu/observability/flight_recorder.py): unlike the profiler ring
+// it WRAPS — it always holds the most recent `capacity` events — and each
+// slot carries a seqlock so a postmortem reader racing a writer skips the
+// torn slot instead of reporting garbage:
+//   fr_start(capacity)                       allocate + reset
+//   fr_record(kind,name,start,end,tid,aux)   append, overwriting oldest
+//   fr_count()                               total events ever recorded
+//   fr_read(i, ...)                          event i of the retained
+//                                            window, oldest first
+//   fr_stop()                                quiesce + free
 #include <atomic>
 #include <cstdint>
 #include <cstring>
@@ -119,6 +131,151 @@ void ht_stop() {
   g_ready = nullptr;
   g_capacity = 0;
   g_count.store(0, std::memory_order_relaxed);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Flight-recorder ring: wrapping, per-slot seqlock.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct FrEvent {
+  char name[64];
+  uint64_t start_ns;
+  uint64_t end_ns;
+  uint64_t tid;
+  uint64_t aux;  // payload bytes for collectives, samples for steps
+  uint32_t kind;  // 0=op 1=comm 2=step 3=user
+};
+
+FrEvent* g_fr_ring = nullptr;
+std::atomic<uint64_t>* g_fr_seq = nullptr;  // odd while a write is in flight
+uint64_t g_fr_capacity = 0;
+std::atomic<uint64_t> g_fr_count{0};
+std::atomic<bool> g_fr_enabled{false};
+std::atomic<uint64_t> g_fr_writers{0};
+
+}  // namespace
+
+extern "C" {
+
+int fr_start(uint64_t capacity) {
+  if (capacity == 0 || g_fr_enabled.load(std::memory_order_acquire))
+    return -1;
+  delete[] g_fr_ring;
+  delete[] g_fr_seq;
+  g_fr_ring = new (std::nothrow) FrEvent[capacity];
+  g_fr_seq = new (std::nothrow) std::atomic<uint64_t>[capacity];
+  if (!g_fr_ring || !g_fr_seq) {
+    delete[] g_fr_ring;
+    delete[] g_fr_seq;
+    g_fr_ring = nullptr;
+    g_fr_seq = nullptr;
+    return -1;
+  }
+  for (uint64_t i = 0; i < capacity; ++i)
+    g_fr_seq[i].store(0, std::memory_order_relaxed);
+  g_fr_capacity = capacity;
+  g_fr_count.store(0, std::memory_order_relaxed);
+  g_fr_enabled.store(true, std::memory_order_release);
+  return 0;
+}
+
+void fr_record(uint32_t kind, const char* name, uint64_t start_ns,
+               uint64_t end_ns, uint64_t tid, uint64_t aux) {
+  g_fr_writers.fetch_add(1, std::memory_order_seq_cst);
+  // same seq_cst pairing as ht_record/ht_stop: either we see
+  // enabled==false and skip, or fr_stop sees our increment and waits
+  if (g_fr_enabled.load(std::memory_order_seq_cst)) {
+    uint64_t idx = g_fr_count.fetch_add(1, std::memory_order_relaxed);
+    uint64_t slot = idx % g_fr_capacity;
+    // seqlock write: CAS even->odd acquires the slot, so seq is NEVER
+    // even while any writer is mid-write — a reader seeing an even,
+    // unchanged seq is guaranteed an untorn copy. A writer that finds
+    // the slot odd has been lapped by a full ring wrap mid-write; it
+    // drops its (older) event rather than corrupt the newer one.
+    uint64_t s = g_fr_seq[slot].load(std::memory_order_relaxed);
+    bool acquired = false;
+    while (!(s & 1)) {
+      if (g_fr_seq[slot].compare_exchange_weak(
+              s, s + 1, std::memory_order_acq_rel,
+              std::memory_order_relaxed)) {
+        acquired = true;
+        break;
+      }
+    }
+    if (acquired) {
+      FrEvent& e = g_fr_ring[slot];
+      std::strncpy(e.name, name ? name : "", sizeof(e.name) - 1);
+      e.name[sizeof(e.name) - 1] = '\0';
+      e.start_ns = start_ns;
+      e.end_ns = end_ns;
+      e.tid = tid;
+      e.aux = aux;
+      e.kind = kind;
+      g_fr_seq[slot].store(s + 2, std::memory_order_release);
+    }
+  }
+  g_fr_writers.fetch_sub(1, std::memory_order_release);
+}
+
+uint64_t fr_count() { return g_fr_count.load(std::memory_order_relaxed); }
+
+uint64_t fr_capacity() { return g_fr_capacity; }
+
+// Read event i of the retained window (i in [0, min(count, capacity)),
+// oldest first). Returns -1 for out-of-range, torn, or mid-rewrite slots.
+// Known benign imprecision: a slot whose index was claimed but whose write
+// has not yet landed (or was dropped by a lapped writer) still holds the
+// previous lap's event, which is returned as-is — a crash dump may show
+// one capacity-old event where the newest would be. A strict lap check on
+// seq cannot distinguish this from the drop case (drops leave seq behind
+// forever), so postmortem readers tolerate it instead.
+int fr_read(uint64_t i, uint32_t* kind, char* name_out, uint64_t name_cap,
+            uint64_t* start_ns, uint64_t* end_ns, uint64_t* tid,
+            uint64_t* aux) {
+  // readers ride the same in-flight counter as writers so fr_stop cannot
+  // free the ring under a concurrent read (SIGUSR1 dump vs. disable())
+  struct Guard {
+    Guard() { g_fr_writers.fetch_add(1, std::memory_order_seq_cst); }
+    ~Guard() { g_fr_writers.fetch_sub(1, std::memory_order_release); }
+  } guard;
+  if (!g_fr_enabled.load(std::memory_order_seq_cst)) return -1;
+  if (!g_fr_ring || g_fr_capacity == 0 || name_cap == 0) return -1;
+  uint64_t total = g_fr_count.load(std::memory_order_acquire);
+  uint64_t n = total < g_fr_capacity ? total : g_fr_capacity;
+  if (i >= n) return -1;
+  uint64_t slot = (total - n + i) % g_fr_capacity;
+  uint64_t s0 = g_fr_seq[slot].load(std::memory_order_acquire);
+  if (s0 == 0 || (s0 & 1)) return -1;  // unwritten or write in flight
+  const FrEvent e = g_fr_ring[slot];   // copy out, then validate
+  // order the (non-atomic) field loads before the revalidating seq load —
+  // without the fence a weakly-ordered CPU may satisfy them afterwards
+  // and a torn copy would pass the unchanged-seq check
+  std::atomic_thread_fence(std::memory_order_acquire);
+  if (g_fr_seq[slot].load(std::memory_order_relaxed) != s0) return -1;
+  std::strncpy(name_out, e.name, name_cap - 1);
+  name_out[name_cap - 1] = '\0';
+  *kind = e.kind;
+  *start_ns = e.start_ns;
+  *end_ns = e.end_ns;
+  *tid = e.tid;
+  *aux = e.aux;
+  return 0;
+}
+
+void fr_stop() {
+  g_fr_enabled.store(false, std::memory_order_seq_cst);
+  while (g_fr_writers.load(std::memory_order_seq_cst) != 0) {
+  }
+  delete[] g_fr_ring;
+  delete[] g_fr_seq;
+  g_fr_ring = nullptr;
+  g_fr_seq = nullptr;
+  g_fr_capacity = 0;
+  g_fr_count.store(0, std::memory_order_relaxed);
 }
 
 }  // extern "C"

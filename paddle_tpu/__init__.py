@@ -42,6 +42,7 @@ from paddle_tpu import hapi  # noqa: F401
 from paddle_tpu.hapi import Model  # noqa: F401
 from paddle_tpu import static  # noqa: F401
 from paddle_tpu import profiler  # noqa: F401
+from paddle_tpu import observability  # noqa: F401
 from paddle_tpu import vision  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import quantization  # noqa: F401

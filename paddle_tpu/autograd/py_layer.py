@@ -11,7 +11,8 @@ from typing import Any
 
 import jax.numpy as jnp
 
-from paddle_tpu.core.autograd import GradNode, is_grad_enabled
+from paddle_tpu.core.autograd import (GradNode, _record_op_event,
+                                      is_grad_enabled)
 from paddle_tpu.core.tensor import Tensor
 
 
@@ -59,9 +60,17 @@ class PyLayer:
             not t.stop_gradient for t in tensor_inputs)
 
         # forward runs detached; the PyLayer is a tape primitive, inner ops
-        # are not recorded (reference parity: pylayer grad node is opaque)
+        # are not recorded (reference parity: pylayer grad node is opaque).
+        # The boundary itself IS a dispatch site: span it like any op so
+        # profiler/flight-recorder coverage includes custom autograd ops.
         detached = [a.detach() if isinstance(a, Tensor) else a for a in args]
-        out = cls.forward(ctx, *detached, **kwargs)
+        _ev = _record_op_event(f"pylayer::{cls.__name__}",
+                               [t.data for t in tensor_inputs])
+        try:
+            out = cls.forward(ctx, *detached, **kwargs)
+        finally:
+            if _ev is not None:
+                _ev.end()
         multi = isinstance(out, (tuple, list))
         outs = list(out) if multi else [out]
         out_arrays = [o.data if isinstance(o, Tensor) else jnp.asarray(o)

@@ -1,8 +1,9 @@
 """paddle.callbacks parity (reference: ``python/paddle/callbacks.py`` —
 re-export of the hapi callback set)."""
 from paddle_tpu.hapi.model import (  # noqa: F401
-    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger, VisualDL,
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    StepTelemetry, VisualDL,
 )
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping", "VisualDL",
-           "LRScheduler"]
+           "LRScheduler", "StepTelemetry"]

@@ -161,8 +161,10 @@ def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
 
     # profiler instrumentation (reference: RecordEvent in every generated
     # forward, add_n_fwd_func.cc:27); None — and zero overhead — unless a
-    # Profiler is actively recording
-    _prof_ev = _record_op_event(op_name or getattr(fn, "__name__", "op"))
+    # Profiler is actively recording or the flight recorder is armed. The
+    # operand arrays ride along for Profiler(record_shapes=True).
+    _prof_ev = _record_op_event(op_name or getattr(fn, "__name__", "op"),
+                                arrays)
     try:
         if requires:
             out, vjp_fn = jax.vjp(pure, *arrays)
@@ -210,7 +212,7 @@ def apply_op(fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
 _record_op_hook = None
 
 
-def _record_op_event(name):
+def _record_op_event(name, inputs=None):
     global _record_op_hook
     if _record_op_hook is None:
         try:
@@ -220,7 +222,7 @@ def _record_op_event(name):
         _record_op_hook = record_op if record_op is not None else False
     if _record_op_hook is False:
         return None
-    return _record_op_hook(name)
+    return _record_op_hook(name, inputs)
 
 
 def _maybe_autocast(op_name, arrays):
@@ -382,7 +384,14 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
                 f"trying to backward through node '{node.name}' a second time "
                 "but the saved intermediates were freed; call backward/grad "
                 "with retain_graph=True the first time")
-        in_cots = node.vjp_fn(cots if _vjp_multi(node) else cots[0])
+        # backward dispatch is instrumented like forward dispatch (the
+        # reference spans every GradNode run in RunBackward)
+        _ev = _record_op_event(f"grad::{node.name}")
+        try:
+            in_cots = node.vjp_fn(cots if _vjp_multi(node) else cots[0])
+        finally:
+            if _ev is not None:
+                _ev.end()
         if not retain_graph:
             # free residuals AND replay metadata (fwd closes over the same
             # activations; keeping it would defeat the free)

@@ -27,6 +27,8 @@ import numpy as np
 
 from paddle_tpu.core.autograd import apply_op
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.observability.comm import (comm_event, comm_scope,
+                                           payload_bytes)
 from .mesh import get_mesh
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
@@ -126,13 +128,23 @@ def get_group(gid: int) -> Optional[Group]:
     return Group._registry.get(gid)
 
 
+def _axis_size(axis):
+    """Bound-axis size across jax versions: ``jax.lax.axis_size`` where it
+    exists, else the classic ``psum(1, axis)`` idiom (statically evaluated
+    for named axes; raises the same unbound-name NameError)."""
+    import jax
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    return jax.lax.psum(1, axis)
+
+
 def _linear_rank(axes):
     """Group-linear rank inside a mapped context (axes[0] major — the
     same flattening order jax collectives use for axis tuples)."""
     import jax
     idx = jax.lax.axis_index(axes[0])
     for a in axes[1:]:
-        idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        idx = idx * _axis_size(a) + jax.lax.axis_index(a)
     return idx
 
 
@@ -154,10 +166,9 @@ def _axes(group) -> Tuple[str, ...]:
 
 def _in_mapped_context(axes) -> bool:
     """True when the named axes are bound (i.e. we are inside shard_map)."""
-    import jax
     try:
         for a in axes:
-            jax.lax.axis_size(a)
+            _axis_size(a)
         return True
     except NameError:  # jax's unbound-axis-name error
         return False
@@ -191,7 +202,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     else:
         def f(x):
             return red[op](x, axes)
-    return _collective(f, tensor, f"all_reduce_{op}")
+    with comm_scope("all_reduce", axes, payload=tensor,
+                    extra={"reduce_op": op}):
+        return _collective(f, tensor, f"all_reduce_{op}")
 
 
 def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
@@ -216,7 +229,8 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
     else:
         def f(x):
             return jax.lax.all_gather(x, axes, axis=axis, tiled=True)
-        result = _collective(f, t, "all_gather")
+        with comm_scope("all_gather", axes, payload=t):
+            result = _collective(f, t, "all_gather")
         n = Group(axes).nranks
     if out_list is not None:
         from paddle_tpu import ops
@@ -315,12 +329,15 @@ def all_gather_object(object_list, obj, group=None):
     from .tcp_store import job_store
     store = job_store()
     key = _obj_key("ag", tag)
-    store.set(f"{key}/{rank}", pickle.dumps(obj))
-    for r in members:
-        object_list.append(pickle.loads(store.wait(f"{key}/{r}")))
-    # every member has read everything: safe to drop our slot
-    _reaped_barrier(store, key, len(members))
-    store.delete_key(f"{key}/{rank}")
+    blob = pickle.dumps(obj)
+    with comm_scope("all_gather_object", (), nbytes=len(blob),
+                    extra={"members": len(members)}):
+        store.set(f"{key}/{rank}", blob)
+        for r in members:
+            object_list.append(pickle.loads(store.wait(f"{key}/{r}")))
+        # every member has read everything: safe to drop our slot
+        _reaped_barrier(store, key, len(members))
+        store.delete_key(f"{key}/{rank}")
     return None
 
 
@@ -345,7 +362,9 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     def f(x):
         return jax.lax.psum_scatter(x, axes, scatter_dimension=axis,
                                     tiled=True)
-    return _collective(f, tensor, "reduce_scatter")
+    with comm_scope("reduce_scatter", axes, payload=tensor,
+                    extra={"reduce_op": op}):
+        return _collective(f, tensor, "reduce_scatter")
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
@@ -375,7 +394,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
             return jax.lax.psum(masked.astype(jnp.int8), axes).astype(
                 x.dtype)
         return jax.lax.psum(masked, axes)
-    return _collective(f, tensor, "broadcast")
+    with comm_scope("broadcast", axes, payload=tensor,
+                    extra={"src": src}):
+        return _collective(f, tensor, "broadcast")
 
 
 def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
@@ -395,7 +416,8 @@ def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
         def f(x):
             return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
                                       concat_axis=concat_axis, tiled=True)
-        return _collective(f, in_tensor_list, "all_to_all")
+        with comm_scope("all_to_all", axes, payload=in_tensor_list):
+            return _collective(f, in_tensor_list, "all_to_all")
     # list form: stack -> all_to_all -> unstack into out_tensor_list
     from paddle_tpu import ops
     stacked = ops.stack(list(in_tensor_list), axis=0)
@@ -403,7 +425,8 @@ def all_to_all(in_tensor_list, out_tensor_list=None, group=None,
     def f(x):
         return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
                                   tiled=False)
-    out = _collective(f, stacked, "all_to_all")
+    with comm_scope("all_to_all", axes, payload=stacked):
+        out = _collective(f, stacked, "all_to_all")
     outs = [out[i] for i in range(len(in_tensor_list))]
     if out_tensor_list is not None:
         out_tensor_list.extend(outs)
@@ -425,7 +448,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         # with peak memory 2x the tensor, not the world-size x of the old
         # all_gather+index formulation
         axis = axes[0] if len(axes) == 1 else axes
-        n = jax.lax.axis_size(axis)
+        n = _axis_size(axis)
         chunk = x.shape[0] // n
         recv = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
                                   tiled=True)
@@ -433,7 +456,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if tensor_list is not None:
         from paddle_tpu import ops
         tensor = ops.concat(list(tensor_list), axis=0)
-    return _collective(f, tensor, "scatter")
+    with comm_scope("scatter", axes, payload=tensor, extra={"src": src}):
+        return _collective(f, tensor, "scatter")
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -441,12 +465,16 @@ def send(tensor, dst=0, group=None, sync_op=True):
     destination; pair with :func:`recv` in the same spmd program. The
     reference's send_v2/recv_v2 (PP micro-batch transfer) maps to
     :func:`p2p_shift` which is what the pipeline engine uses."""
+    # record the attempt: a flight-recorder postmortem should show which
+    # rank tried an unsupported raw P2P before the crash
+    comm_event("send", (), payload=tensor, extra={"dst": dst})
     raise NotImplementedError(
         "raw send/recv have no XLA analog; use dist.p2p_shift (ppermute) "
         "inside an spmd region — the PP engine does")
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    comm_event("recv", (), payload=tensor, extra={"src": src})
     raise NotImplementedError(
         "raw send/recv have no XLA analog; use dist.p2p_shift (ppermute) "
         "inside an spmd region — the PP engine does")
@@ -461,10 +489,12 @@ def p2p_shift(tensor, group=None, shift: int = 1):
     axis = axes[0] if len(axes) == 1 else axes
 
     def f(x):
-        n = jax.lax.axis_size(axis)
+        n = _axis_size(axis)
         perm = [(i, (i + shift) % n) for i in range(n)]
         return jax.lax.ppermute(x, axis, perm)
-    return _collective(f, tensor, "p2p_shift")
+    with comm_scope("p2p_shift", axes, payload=tensor,
+                    extra={"shift": shift}):
+        return _collective(f, tensor, "p2p_shift")
 
 
 def barrier(group=None):
@@ -472,7 +502,9 @@ def barrier(group=None):
     synchronizes the host on outstanding work (paddle barrier blocks the
     host the same way)."""
     import jax
-    jax.effects_barrier()
+    axes = getattr(group, "axes", ()) if group is not None else ()
+    with comm_scope("barrier", axes):
+        jax.effects_barrier()
     return None
 
 
@@ -488,14 +520,14 @@ def shard_map(fn, mesh=None, in_specs=None, out_specs=None,
         return x.data if isinstance(x, Tensor) else x
 
     def run(*args):
-        import functools
-        inner = jax.shard_map(
+        # lazy: fleet.utils <-> collective would cycle at module scope
+        from .fleet.utils import shard_map_compat
+        inner = shard_map_compat(
             lambda *a: jax.tree_util.tree_map(
                 unwrap, fn(*[Tensor(x) if hasattr(x, "dtype") else x
                              for x in a]),
                 is_leaf=lambda v: isinstance(v, Tensor)),
-            mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=check_rep)
+            mesh, in_specs, out_specs, check_vma=check_rep)
         out = inner(*[unwrap(a) for a in args])
         return jax.tree_util.tree_map(
             lambda x: Tensor(x) if hasattr(x, "dtype") else x, out)

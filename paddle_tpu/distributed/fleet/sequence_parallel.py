@@ -116,8 +116,9 @@ def _ring_attention_arrays(q, k, v, mesh, axis, causal, sm_scale):
         return jnp.swapaxes(out, 1, 2).astype(ql.dtype)
 
     spec = P(None, axis, None, None)
-    return jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    from .utils import shard_map_compat
+    return shard_map_compat(per_rank, mesh, (spec, spec, spec),
+                            spec)(q, k, v)
 
 
 def _ring_flash_arrays(q, k, v, mesh, axis, causal, sm_scale):
@@ -281,8 +282,9 @@ def _ring_flash_arrays(q, k, v, mesh, axis, causal, sm_scale):
     spec = P(None, axis, None, None)
     # check_vma off: pallas_call's output avals carry no vma annotation,
     # which the checker (not the semantics) rejects inside shard_map
-    return jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from .utils import shard_map_compat
+    return shard_map_compat(per_rank, mesh, (spec, spec, spec), spec,
+                            check_vma=False)(q, k, v)
 
 
 def _ring_flash_tileable(S: int, n: int) -> bool:

@@ -182,9 +182,9 @@ def pipeline_spmd(body_fn: Callable, stacked_params, micro_inputs,
         lambda a: P(None, axis), stacked_params)
     xspec = jax.tree_util.tree_map(lambda a: P(), micro_inputs)
     ospec = jax.tree_util.tree_map(lambda a: P(), micro_inputs)
-    return jax.shard_map(per_stage, mesh=mesh,
-                         in_specs=(pspec, xspec), out_specs=ospec,
-                         axis_names={axis})(stacked_params, micro_inputs)
+    from .utils import shard_map_compat
+    return shard_map_compat(per_stage, mesh, (pspec, xspec), ospec,
+                            axis_names={axis})(stacked_params, micro_inputs)
 
 
 class SpmdPipelineLayer(Layer):
@@ -555,9 +555,9 @@ def _hetero_schedule(branches, padded, shared_params, micro_inputs,
     # over non-pp axes. Blocks whose forward builds fresh scan carries
     # (RNNs) must vma-match them to their inputs — see
     # ``fleet.utils.match_vma`` (nn.RNN does this natively).
-    return jax.shard_map(
-        per_stage, mesh=mesh,
-        in_specs=(P(None, axis, None), sspec, xspec), out_specs=xspec,
+    from .utils import shard_map_compat
+    return shard_map_compat(
+        per_stage, mesh, (P(None, axis, None), sspec, xspec), xspec,
         axis_names=set(mesh.axis_names))(padded, shared_params,
                                          micro_inputs)
 

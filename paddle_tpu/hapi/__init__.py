@@ -1,5 +1,5 @@
 """hapi high-level API (reference: ``python/paddle/hapi/``)."""
 from .model import (  # noqa: F401
     Model, Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, VisualDL,
-    LRScheduler,
+    LRScheduler, StepTelemetry,
 )

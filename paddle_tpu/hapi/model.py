@@ -16,7 +16,7 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer_base import Layer
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
-           "EarlyStopping", "LRScheduler"]
+           "EarlyStopping", "LRScheduler", "StepTelemetry"]
 
 
 class Callback:
@@ -35,6 +35,11 @@ class Callback:
         pass
 
     def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        """``logs`` carries ``data_time`` (seconds the fit loop spent
+        fetching this batch) and ``batch_size`` when determinable."""
         pass
 
     def on_train_batch_end(self, step, logs=None):
@@ -154,6 +159,59 @@ class VisualDL(Callback):
                 pass
 
 
+class StepTelemetry(Callback):
+    """Step telemetry callback (the observability layer's trainer hook,
+    docs/OBSERVABILITY.md): drives an ``observability.StepTimer`` from the
+    fit loop's batch hooks, recording per-step time decomposition
+    (data / compute / collective), samples-per-sec, optional tokens-per-sec
+    and an MFU estimate into the metrics registry — and injects the same
+    stats into the batch ``logs`` so ProgBarLogger/VisualDL surface them.
+
+    ``flops_per_sample``: training FLOPs per sample (fwd+bwd+update); when
+    omitted, a ``flops_per_sample`` attribute on the network is used if
+    present. ``peak`` overrides peak-FLOP/s detection (useful off-TPU).
+    Starting it also arms the env-gated metrics exporter — note that the
+    exporter serves the DEFAULT registry, so a custom ``registry`` here
+    (mostly a test convenience) keeps these metrics off the env-gated
+    scrape endpoint; serve it with ``MetricsExporter(port, registry)``."""
+
+    def __init__(self, flops_per_sample=None, tokens_per_sample=None,
+                 registry=None, peak=None):
+        self.flops_per_sample = flops_per_sample
+        self.tokens_per_sample = tokens_per_sample
+        self.registry = registry
+        self.peak = peak
+        self.timer = None
+        self.last_stats = None
+        self._batch_size = None
+
+    def on_train_begin(self, logs=None):
+        from paddle_tpu.observability import (StepTimer,
+                                              maybe_start_exporter)
+        maybe_start_exporter()
+        flops = self.flops_per_sample
+        if flops is None:
+            flops = getattr(self.model.network, "flops_per_sample", None)
+        self.timer = StepTimer(registry=self.registry,
+                               flops_per_sample=flops,
+                               tokens_per_sample=self.tokens_per_sample,
+                               peak=self.peak)
+
+    def on_train_batch_begin(self, step, logs=None):
+        logs = logs or {}
+        self._batch_size = logs.get("batch_size")
+        self.timer.begin_step(data_time=logs.get("data_time", 0.0))
+
+    def on_train_batch_end(self, step, logs=None):
+        stats = self.timer.end_step(samples=self._batch_size)
+        self.last_stats = stats
+        if logs is not None:
+            for k in ("step_time_s", "samples_per_sec", "tokens_per_sec",
+                      "mfu"):
+                if k in stats:
+                    logs[k] = stats[k]
+
+
 class LRScheduler(Callback):
     def __init__(self, by_step=True, by_epoch=False):
         self.by_step = by_step
@@ -259,14 +317,25 @@ class Model:
         history = {"loss": []}
         for cb in callbacks:
             cb.on_train_begin()
+        import time as _time
         step = 0
         for epoch in range(epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
             self.network.train()
             epoch_losses = []
+            t_fetch = _time.perf_counter()
             for batch in loader:
+                # loader-fetch time, handed to telemetry callbacks as the
+                # step's data component (StepTimer decomposition)
+                data_time = _time.perf_counter() - t_fetch
                 x, y = batch[0], batch[1]
+                first = x[0] if isinstance(x, (list, tuple)) else x
+                shape = getattr(first, "shape", None)
+                blogs = {"data_time": data_time,
+                         "batch_size": int(shape[0]) if shape else None}
+                for cb in callbacks:
+                    cb.on_train_batch_begin(step + 1, blogs)
                 loss = self.train_batch(x, y)[0]
                 epoch_losses.append(loss)
                 step += 1
@@ -275,6 +344,7 @@ class Model:
                     cb.on_train_batch_end(step, logs)
                 if num_iters is not None and step >= num_iters:
                     break
+                t_fetch = _time.perf_counter()
             logs = {"loss": float(np.mean(epoch_losses))}
             history["loss"].append(logs["loss"])
             if eval_data is not None and (epoch + 1) % eval_freq == 0:

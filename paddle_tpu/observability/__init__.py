@@ -1,0 +1,37 @@
+"""paddle_tpu.observability — production telemetry subsystem.
+
+Four pieces (see docs/OBSERVABILITY.md):
+
+- **metrics** — Counter/Gauge/Histogram registry with Prometheus-text and
+  JSON exposition; env-gated HTTP exporter (``PADDLE_TPU_METRICS_PORT``).
+- **step_timer** — per-step data/compute/collective decomposition,
+  samples-or-tokens/sec and an MFU estimate (surfaced by the hapi
+  ``StepTelemetry`` callback).
+- **comm** — collective-communication tracing: every collective emits a
+  tagged RecordEvent span (bytes + group axes), registry counters, and a
+  flight-recorder entry.
+- **flight_recorder** — always-on bounded ring of recent op/comm/step
+  events dumped as postmortem JSON on crash/SIGTERM/SIGUSR1
+  (``PADDLE_TPU_FLIGHT_RECORDER``).
+
+Importing this package applies the env gates (a no-op when the vars are
+unset), so ``import paddle_tpu`` alone arms the exporter/recorder in
+production jobs.
+"""
+from . import comm, flight_recorder, metrics, step_timer  # noqa: F401
+from .comm import comm_scope, comm_totals, payload_bytes  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, get_registry,
+    maybe_start_exporter, start_exporter,
+)
+from .step_timer import StepTimer, peak_flops  # noqa: F401
+
+__all__ = ["metrics", "step_timer", "comm", "flight_recorder",
+           "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "get_registry", "start_exporter", "maybe_start_exporter",
+           "StepTimer", "peak_flops", "comm_scope", "comm_totals",
+           "payload_bytes"]
+
+# env-gated side effects: both are no-ops unless their env var is set
+metrics.maybe_start_exporter()
+flight_recorder.maybe_enable_from_env()

@@ -1,0 +1,130 @@
+"""Collective-communication tracing.
+
+Every collective in ``distributed/collective.py`` runs under
+:func:`comm_scope`, which (1) emits a profiler RecordEvent span tagged with
+group axes and payload bytes (rendered as a dedicated "collectives" lane +
+counter events in the chrome-trace export), (2) bumps per-op registry
+counters (``comm_bytes_total`` / ``comm_calls_total`` /
+``comm_seconds_total``) that :class:`StepTimer` diffs into per-step comm
+volume, and (3) feeds the flight recorder's ring so a postmortem shows the
+last collectives in flight.
+
+The span measures *host-side* time: on the compiled path that is trace
+time (the collective itself is an XLA op fused into the step program);
+eager/shard_map re-traces record every call. Bytes are per-shard payload
+bytes — shape × itemsize of the local operand — which is the quantity a
+per-step comm-volume counter wants.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional, Sequence
+
+from . import flight_recorder
+from .metrics import get_registry
+
+__all__ = ["comm_scope", "comm_event", "payload_bytes", "comm_totals"]
+
+
+_metrics_cache = None
+
+
+def _metrics():
+    """The three per-collective counters, resolved once (they live in the
+    default registry for the process's lifetime — no reason to take the
+    registry lock on every collective)."""
+    global _metrics_cache
+    if _metrics_cache is None:
+        reg = get_registry()
+        _metrics_cache = (
+            reg.counter("comm_bytes_total",
+                        "payload bytes moved by collectives"),
+            reg.counter("comm_calls_total", "collective invocations"),
+            reg.counter("comm_seconds_total",
+                        "host-side seconds inside collectives"))
+    return _metrics_cache
+
+
+def payload_bytes(x) -> int:
+    """Per-shard payload bytes of a tensor / jax array / tracer / pytree
+    list; 0 when the size cannot be determined (object collectives pass an
+    explicit byte count instead)."""
+    if x is None:
+        return 0
+    if isinstance(x, (list, tuple)):
+        return sum(payload_bytes(e) for e in x)
+    data = getattr(x, "data", x)  # Tensor -> jax array
+    shape = getattr(data, "shape", None)
+    dtype = getattr(data, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for s in shape:
+        try:
+            n *= int(s)
+        except TypeError:
+            return 0  # symbolic dim
+    try:
+        import numpy as np
+        return n * int(np.dtype(dtype).itemsize)
+    except Exception:
+        return 0
+
+
+def _axes_label(axes: Sequence[str]) -> str:
+    axes = tuple(axes)
+    return "x".join(axes) if axes else "world"
+
+
+def _emit(op: str, axes_label: str, nbytes: int, t0: int, t1: int,
+          extra: Optional[dict] = None):
+    b, c, s = _metrics()
+    b.inc(nbytes, op=op, axes=axes_label)
+    c.inc(1, op=op, axes=axes_label)
+    s.inc((t1 - t0) / 1e9, op=op, axes=axes_label)
+    args = {"bytes": nbytes, "axes": axes_label}
+    if extra:
+        args.update(extra)
+    from paddle_tpu import profiler
+    profiler._emit_event(f"comm::{op}", t0, t1,
+                         tid=threading.get_ident(), args=args, cat="comm")
+    flight_recorder.record(flight_recorder.KIND_COMM, f"{op}@{axes_label}",
+                           t0, t1, tid=threading.get_ident(), aux=nbytes,
+                           args=args)
+
+
+@contextlib.contextmanager
+def comm_scope(op: str, axes: Sequence[str], payload=None,
+               nbytes: Optional[int] = None, extra: Optional[dict] = None):
+    """Span around one collective. Records even when the body raises — a
+    failed collective is exactly what the flight recorder must show."""
+    nbytes = payload_bytes(payload) if nbytes is None else int(nbytes)
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        _emit(op, _axes_label(axes), nbytes, t0, time.perf_counter_ns(),
+              extra)
+
+
+def comm_event(op: str, axes: Sequence[str], payload=None,
+               nbytes: Optional[int] = None, extra: Optional[dict] = None):
+    """Instantaneous comm record (for calls that fail fast, e.g. the
+    unsupported raw send/recv): counters + flight recorder, zero span."""
+    nbytes = payload_bytes(payload) if nbytes is None else int(nbytes)
+    t = time.perf_counter_ns()
+    _emit(op, _axes_label(axes), nbytes, t, t, extra)
+
+
+def comm_totals(registry=None) -> dict:
+    """(bytes, calls, seconds) summed over every op/axes label — the
+    snapshot StepTimer diffs per step."""
+    reg = registry or get_registry()
+    out = {}
+    for name in ("comm_bytes_total", "comm_calls_total",
+                 "comm_seconds_total"):
+        m = reg.get(name)
+        out[name] = m.total() if m is not None else 0.0
+    return out

@@ -1,0 +1,328 @@
+"""Crash flight recorder — an always-on bounded ring of recent events.
+
+Keeps the last N op / collective / step events with near-zero overhead and
+dumps a structured postmortem JSON (rank, world size, mesh topology, the
+events) on unhandled exception or ``SIGTERM``/``SIGUSR1``. The ring is the
+lock-free seqlock ring in ``native/host_tracer.cpp`` (``fr_*`` C ABI) when
+the toolchain is available, a lock-guarded pure-Python ring otherwise.
+
+Gating: ``PADDLE_TPU_FLIGHT_RECORDER`` — unset/``0`` keeps everything off
+(the per-op fast path is one module-attribute read); ``1`` enables with the
+default capacity; any other integer sets the capacity. Dumps land in
+``PADDLE_TPU_TRACE_DIR`` (default ``/tmp/paddle_tpu_trace``).
+
+Event sources: ``profiler.RecordEvent``/``record_op`` (ops), the
+collective-comm tracer (``observability.comm``), and ``StepTimer``
+(steps). Each records ``(kind, name, start_ns, end_ns, tid, aux)`` where
+``aux`` carries payload bytes for collectives and samples for steps.
+
+Fidelity note: the native ring stores only those fixed fields — rich
+``args`` dicts (step stats, comm axes/extras) survive only on the
+pure-Python ring. Dumps record which ring produced them
+(``"native_ring"``); comm events keep their axes in the name
+(``all_reduce@dp``) and their bytes in ``aux`` either way.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+__all__ = ["FlightRecorder", "enable", "disable", "active", "record",
+           "maybe_enable_from_env", "KIND_OP", "KIND_COMM", "KIND_STEP",
+           "KIND_USER"]
+
+KIND_OP = 0
+KIND_COMM = 1
+KIND_STEP = 2
+KIND_USER = 3
+_KIND_NAMES = {KIND_OP: "op", KIND_COMM: "comm", KIND_STEP: "step",
+               KIND_USER: "user"}
+
+DEFAULT_CAPACITY = 1024
+
+#: the active recorder — profiler.record_op reads this attribute on every
+#: op dispatch, so it must stay a plain module global (no function call)
+_active: Optional["FlightRecorder"] = None
+
+
+class _PyRing:
+    """Wrapping ring, lock-free: slot index comes from an atomic
+    ``itertools.count`` (C-level ``__next__``), each slot holds one tuple
+    assigned atomically, and readers order by the sequence number stored
+    inside the tuple. No lock anywhere means the crash/signal dump path
+    can never deadlock against an in-flight ``record`` on the same thread
+    (signal handlers run between bytecodes of their interruptee)."""
+
+    def __init__(self, capacity: int):
+        import itertools
+        self._cap = capacity
+        self._slots = [None] * capacity
+        self._counter = itertools.count()
+
+    def record(self, kind, name, start_ns, end_ns, tid, aux, args=None):
+        i = next(self._counter)
+        self._slots[i % self._cap] = (
+            i, kind, name, start_ns, end_ns, tid, aux, args)
+
+    def events(self):
+        slots = sorted(e for e in list(self._slots) if e is not None)
+        out = []
+        for _, kind, name, s, t, tid, aux, args in slots:
+            d = {"kind": _KIND_NAMES.get(kind, str(kind)), "name": name,
+                 "start_ns": s, "end_ns": t, "tid": tid, "aux": aux}
+            if args:
+                d["args"] = args
+            out.append(d)
+        return out
+
+    def close(self):
+        pass
+
+
+class _NativeRing:
+    """ctypes view of the ``fr_*`` seqlock ring in host_tracer.cpp."""
+
+    def __init__(self, lib, capacity: int):
+        if lib.fr_start(capacity) != 0:
+            raise OSError("fr_start failed")
+        self._lib = lib
+        self._cap = capacity
+
+    def record(self, kind, name, start_ns, end_ns, tid, aux, args=None):
+        self._lib.fr_record(kind, name.encode()[:63], int(start_ns),
+                            int(end_ns), int(tid), int(aux))
+
+    def events(self):
+        import ctypes
+        lib = self._lib
+        n = min(lib.fr_count(), self._cap)
+        buf = ctypes.create_string_buffer(64)
+        kind = ctypes.c_uint32()
+        s = ctypes.c_uint64()
+        e = ctypes.c_uint64()
+        tid = ctypes.c_uint64()
+        aux = ctypes.c_uint64()
+        out = []
+        for i in range(n):
+            if lib.fr_read(i, ctypes.byref(kind), buf, 64, ctypes.byref(s),
+                           ctypes.byref(e), ctypes.byref(tid),
+                           ctypes.byref(aux)) == 0:
+                out.append({
+                    "kind": _KIND_NAMES.get(kind.value, str(kind.value)),
+                    "name": buf.value.decode(errors="replace"),
+                    "start_ns": s.value, "end_ns": e.value,
+                    "tid": tid.value, "aux": aux.value})
+        return out
+
+    def close(self):
+        self._lib.fr_stop()
+
+
+def _load_native(capacity: int):
+    """The fr_* ring from the profiler's compiled library, or None (missing
+    toolchain, or a stale prebuilt .so without the fr_ symbols)."""
+    try:
+        from paddle_tpu.profiler import _NativeTracer
+        lib = _NativeTracer.load()
+        if lib is None or not hasattr(lib, "fr_start"):
+            return None
+        return _NativeRing(lib, capacity)
+    except Exception:
+        return None
+
+
+def _rank_topology() -> dict:
+    """Rank/world/mesh metadata for the postmortem header — read from the
+    launcher env contract first; jax is only consulted when it is already
+    imported (a crash dump must never initialize a backend)."""
+    info = {"pid": os.getpid(), "rank": 0, "world_size": 1}
+    rank = os.environ.get("PADDLE_TRAINER_ID")
+    world = os.environ.get("PADDLE_TRAINERS_NUM")
+    if rank is not None:
+        info["rank"] = int(rank)
+    if world is not None:
+        info["world_size"] = int(world)
+    if rank is None or world is None:
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                if rank is None:
+                    info["rank"] = jax.process_index()
+                if world is None:
+                    info["world_size"] = jax.process_count()
+            except Exception:
+                pass
+    try:
+        mesh_mod = sys.modules.get("paddle_tpu.distributed.mesh")
+        mesh = mesh_mod.get_mesh() if mesh_mod is not None else None
+        if mesh is not None:
+            info["topology"] = {a: int(s) for a, s in mesh.shape.items()}
+    except Exception:
+        pass
+    return info
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 use_native: bool = True):
+        self.capacity = capacity
+        self._ring = (_load_native(capacity) if use_native else None) \
+            or _PyRing(capacity)
+        self.native = isinstance(self._ring, _NativeRing)
+        self._dumped = None
+
+    def record(self, kind, name, start_ns, end_ns, tid=0, aux=0, args=None):
+        self._ring.record(kind, name, start_ns, end_ns, tid, aux, args)
+
+    def events(self):
+        return self._ring.events()
+
+    def dump(self, path: Optional[str] = None, reason: str = "manual") -> str:
+        """Write the postmortem JSON; returns the path written."""
+        info = _rank_topology()
+        if path is None:
+            d = os.environ.get("PADDLE_TPU_TRACE_DIR",
+                               "/tmp/paddle_tpu_trace")
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight_recorder_rank{info['rank']}_{os.getpid()}.json")
+        doc = {"reason": reason, "unix_time": time.time(), **info,
+               "capacity": self.capacity, "native_ring": self.native,
+               "events": self.events()}
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        self._dumped = path
+        return path
+
+    def close(self):
+        self._ring.close()
+
+
+_handlers = {"excepthook": None, "thread_hook": None, "signals": {}}
+
+
+def _dump_on_crash(reason: str):
+    rec = _active
+    if rec is not None:
+        try:
+            path = rec.dump(reason=reason)
+            print(f"[paddle_tpu] flight recorder dumped to {path} "
+                  f"({reason})", file=sys.stderr)
+        except Exception:
+            pass
+
+
+def _install_handlers():
+    prev_hook = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        _dump_on_crash(f"unhandled {exc_type.__name__}")
+        prev_hook(exc_type, exc, tb)
+
+    _handlers["excepthook"] = prev_hook
+    sys.excepthook = hook
+
+    # unhandled exceptions on spawned threads route through
+    # threading.excepthook, not sys.excepthook — data-loader workers and
+    # serving dispatchers crash there, so hook both
+    prev_thread_hook = threading.excepthook
+
+    def thread_hook(args):
+        if args.exc_type is not SystemExit:
+            _dump_on_crash(
+                f"unhandled {args.exc_type.__name__} in thread "
+                f"{getattr(args.thread, 'name', '?')}")
+        prev_thread_hook(args)
+
+    _handlers["thread_hook"] = prev_thread_hook
+    threading.excepthook = thread_hook
+
+    def handler(sn, frame):
+        _dump_on_crash(signal.Signals(sn).name)
+        prev = _handlers["signals"].get(sn)
+        if callable(prev):
+            # chain to the application's handler (checkpoint-on-preempt
+            # logic etc.) — the dump must not replace it
+            prev(sn, frame)
+        elif sn == signal.SIGTERM:
+            # dump, then die with the conventional termination status
+            signal.signal(sn, signal.SIG_DFL)
+            os.kill(os.getpid(), sn)
+        # SIGUSR1 with no prior handler is a live snapshot: keep running
+
+    if threading.current_thread() is threading.main_thread():
+        for signum in (signal.SIGTERM, signal.SIGUSR1):
+            try:
+                _handlers["signals"][signum] = signal.signal(signum, handler)
+            except (ValueError, OSError):
+                pass
+
+
+def _uninstall_handlers():
+    if _handlers["excepthook"] is not None:
+        sys.excepthook = _handlers["excepthook"]
+        _handlers["excepthook"] = None
+    if _handlers["thread_hook"] is not None:
+        threading.excepthook = _handlers["thread_hook"]
+        _handlers["thread_hook"] = None
+    for signum, old in _handlers["signals"].items():
+        try:
+            signal.signal(signum, old)
+        except (ValueError, OSError):
+            pass
+    _handlers["signals"].clear()
+
+
+def enable(capacity: int = DEFAULT_CAPACITY,
+           use_native: bool = True) -> FlightRecorder:
+    """Turn the recorder on (idempotent) and install crash handlers."""
+    global _active
+    if _active is not None:
+        return _active
+    _active = FlightRecorder(capacity, use_native=use_native)
+    _install_handlers()
+    return _active
+
+
+def disable():
+    global _active
+    if _active is None:
+        return
+    _uninstall_handlers()
+    rec, _active = _active, None
+    rec.close()
+
+
+def active() -> Optional[FlightRecorder]:
+    return _active
+
+
+def record(kind, name, start_ns, end_ns, tid=0, aux=0, args=None):
+    """Record one event iff the recorder is on (cheap no-op otherwise)."""
+    rec = _active
+    if rec is not None:
+        rec.record(kind, name, start_ns, end_ns, tid, aux, args)
+
+
+def maybe_enable_from_env() -> Optional[FlightRecorder]:
+    """``PADDLE_TPU_FLIGHT_RECORDER``: unset/0/false/off/no → off;
+    1/true/on/yes → default capacity; N > 1 → capacity N. Unrecognized
+    values stay OFF — this installs signal/excepthook handlers, so the
+    safe reading of a typo is "disabled"."""
+    val = os.environ.get("PADDLE_TPU_FLIGHT_RECORDER", "").strip().lower()
+    if val in ("", "0", "false", "off", "no"):
+        return _active
+    if val in ("1", "true", "on", "yes"):
+        return enable(DEFAULT_CAPACITY)
+    try:
+        n = int(val)
+    except ValueError:
+        return _active
+    if n <= 0:
+        return _active
+    return enable(DEFAULT_CAPACITY if n == 1 else n)

@@ -9,6 +9,13 @@ TPU mapping: the host side is rebuilt here (op dispatch emits RecordEvents
 when a Profiler is active — zero overhead otherwise); the device side
 delegates to jax.profiler's XPlane capture (libtpu's tracer — the CUPTI
 analog), written next to the host trace for TensorBoard/xprof.
+
+Observability hooks (docs/OBSERVABILITY.md): events carry an optional
+``args`` dict and a category — collective-comm spans (cat ``comm``, tagged
+with payload bytes + group axes by ``observability.comm``) render as a
+dedicated lane plus cumulative-bytes counter events in the chrome export;
+every span also feeds the crash flight recorder's ring when that is on,
+profiler active or not.
 """
 from __future__ import annotations
 
@@ -24,12 +31,30 @@ __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "make_scheduler",
 
 _state = {"active": None}
 
+#: synthetic chrome-trace lane for collective spans (thread_name metadata
+#: names it "collectives" in the viewer)
+_COMM_TID = 1 << 20
+
+
+def _flight():
+    """The flight-recorder module (lazy: observability imports profiler,
+    so this import must not run at module scope)."""
+    global _flight_mod
+    if _flight_mod is None:
+        from paddle_tpu.observability import flight_recorder
+        _flight_mod = flight_recorder
+    return _flight_mod
+
+
+_flight_mod = None
+
 
 class _NativeTracer:
     """ctypes binding to the C++ lock-free event ring
     (``native/host_tracer.cpp`` — the reference HostEventRecorder analog,
     ``platform/profiler/host_event_recorder.h``). Compiled on first use;
-    None when the toolchain is unavailable (pure-Python fallback)."""
+    None when the toolchain is unavailable (pure-Python fallback). The same
+    library exposes the flight recorder's wrapping seqlock ring (fr_*)."""
 
     _lib = None
     _failed = False
@@ -48,15 +73,32 @@ class _NativeTracer:
             build = os.path.join(os.path.dirname(src), "build")
             os.makedirs(build, exist_ok=True)
             so = os.path.join(build, "libhost_tracer.so")
-            if not os.path.exists(so) or \
-                    os.path.getmtime(so) < os.path.getmtime(src):
-                tmp = so + f".tmp{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src,
-                     "-o", tmp], check=True, capture_output=True)
-                os.replace(tmp, so)
+
+            def stale():
+                return not os.path.exists(so) or \
+                    os.path.getmtime(so) < os.path.getmtime(src)
+
+            if stale():
+                # serialize the rebuild across processes (parallel pytest):
+                # without the lock two workers can both see a stale mtime
+                # and race the compile + os.replace; with it, the second
+                # re-stats under the lock and finds the fresh .so
+                import fcntl
+                with open(so + ".lock", "w") as lf:
+                    fcntl.flock(lf, fcntl.LOCK_EX)
+                    try:
+                        if stale():
+                            tmp = so + f".tmp{os.getpid()}"
+                            subprocess.run(
+                                ["g++", "-O2", "-std=c++17", "-shared",
+                                 "-fPIC", src, "-o", tmp],
+                                check=True, capture_output=True)
+                            os.replace(tmp, so)
+                    finally:
+                        fcntl.flock(lf, fcntl.LOCK_UN)
             lib = ctypes.CDLL(so)
             u64 = ctypes.c_uint64
+            u32 = ctypes.c_uint32
             lib.ht_start.argtypes = [u64]
             lib.ht_start.restype = ctypes.c_int
             lib.ht_record.argtypes = [ctypes.c_char_p, u64, u64, u64]
@@ -66,6 +108,19 @@ class _NativeTracer:
                                     ctypes.POINTER(u64), ctypes.POINTER(u64),
                                     ctypes.POINTER(u64)]
             lib.ht_read.restype = ctypes.c_int
+            if hasattr(lib, "fr_start"):  # flight-recorder ring (fr_*)
+                lib.fr_start.argtypes = [u64]
+                lib.fr_start.restype = ctypes.c_int
+                lib.fr_record.argtypes = [u32, ctypes.c_char_p, u64, u64,
+                                          u64, u64]
+                lib.fr_count.restype = u64
+                lib.fr_read.argtypes = [u64, ctypes.POINTER(u32),
+                                        ctypes.c_char_p, u64,
+                                        ctypes.POINTER(u64),
+                                        ctypes.POINTER(u64),
+                                        ctypes.POINTER(u64),
+                                        ctypes.POINTER(u64)]
+                lib.fr_read.restype = ctypes.c_int
             cls._lib = lib
         except Exception:
             cls._failed = True
@@ -99,40 +154,65 @@ class ProfilerTarget:
 
 
 class _Event:
-    __slots__ = ("name", "start", "end", "tid", "args")
+    __slots__ = ("name", "start", "end", "tid", "args", "cat")
 
-    def __init__(self, name, start, end, tid, args=None):
+    def __init__(self, name, start, end, tid, args=None, cat="op"):
         self.name = name
         self.start = start
         self.end = end
         self.tid = tid
         self.args = args
+        self.cat = cat
+
+
+def _emit_event(name, start, end, tid=None, args=None, cat="op"):
+    """Append one finished span to the active profiler (used by the comm
+    tracer and any instrumentation that already has its timestamps).
+    Python path always: events with args/category bypass the native ring
+    (it stores only name/start/end/tid)."""
+    prof = _state["active"]
+    if prof is None:
+        return
+    prof._events.append(_Event(
+        name, start, end, tid if tid is not None else threading.get_ident(),
+        args, cat))
 
 
 class RecordEvent:
     """RAII host span (reference: ``paddle.profiler.RecordEvent``). Usable
-    as context manager or begin()/end() pair; no-op when no profiler runs."""
+    as context manager or begin()/end() pair; no-op when no profiler runs
+    AND the flight recorder is off."""
 
-    def __init__(self, name: str, event_type=None):
+    def __init__(self, name: str, event_type=None, args=None, cat="op"):
         self.name = name
+        self.args = args
+        self.cat = cat
         self._t0 = None
 
     def begin(self):
-        if _state["active"] is not None:
+        fr = _flight_mod or _flight()
+        if _state["active"] is not None or fr._active is not None:
             self._t0 = time.perf_counter_ns()
 
     def end(self):
+        if self._t0 is None:
+            return
+        t0, self._t0 = self._t0, None
+        t1 = time.perf_counter_ns()
         prof = _state["active"]
-        if prof is not None and self._t0 is not None:
-            if prof._native_lib is not None:
+        if prof is not None:
+            if prof._native_lib is not None and self.args is None and \
+                    self.cat == "op":
                 prof._native_lib.ht_record(
-                    self.name.encode(), self._t0, time.perf_counter_ns(),
-                    threading.get_ident())
+                    self.name.encode(), t0, t1, threading.get_ident())
             else:
                 prof._events.append(_Event(
-                    self.name, self._t0, time.perf_counter_ns(),
-                    threading.get_ident()))
-            self._t0 = None
+                    self.name, t0, t1, threading.get_ident(), self.args,
+                    self.cat))
+        fr = _flight_mod._active
+        if fr is not None:
+            fr.record(_flight_mod.KIND_OP, self.name, t0, t1,
+                      tid=threading.get_ident(), args=self.args)
 
     def __enter__(self):
         self.begin()
@@ -143,12 +223,24 @@ class RecordEvent:
         return False
 
 
-def record_op(name: str):
+def record_op(name: str, inputs=None):
     """Fast-path hook for the op dispatcher: returns a live RecordEvent or
-    None when profiling is off."""
-    if _state["active"] is None:
+    None when both the profiler and the flight recorder are off.
+
+    ``inputs`` (the op's operand arrays) feeds ``record_shapes``: with an
+    active ``Profiler(record_shapes=True)`` the span's ``args`` carries
+    each operand's shape."""
+    # hot path: two dict/attribute reads when everything is off (the
+    # _flight() call only happens once, to bind the module)
+    prof = _state["active"]
+    fr = _flight_mod or _flight()
+    if prof is None and fr._active is None:
         return None
-    ev = RecordEvent(name)
+    args = None
+    if prof is not None and prof._record_shapes and inputs is not None:
+        args = {"input_shapes": [list(getattr(a, "shape", ()))
+                                 for a in inputs]}
+    ev = RecordEvent(name, args=args)
     ev.begin()
     return ev
 
@@ -158,6 +250,10 @@ def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
                                                                      str]:
     """Reference: profiler.py:117 make_scheduler state machine
     (CLOSED/READY/RECORD cycling)."""
+    if record < 1:
+        raise ValueError("record period must be >= 1")
+    if min(closed, ready, repeat, skip_first) < 0:
+        raise ValueError("scheduler periods must be non-negative")
     period = closed + ready + record
 
     def schedule(step: int) -> str:
@@ -176,7 +272,12 @@ def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
 
 
 class Profiler:
-    """Reference: ``python/paddle/profiler/profiler.py:344``."""
+    """Reference: ``python/paddle/profiler/profiler.py:344``.
+
+    ``record_shapes`` attaches operand shapes to op spans (forces the
+    Python event path — the native ring stores no args). ``timer_only``
+    collects no events at all (no native ring, no op instrumentation) and
+    keeps only the per-step wall clock exposed by :meth:`step_info`."""
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
@@ -184,19 +285,24 @@ class Profiler:
         self._targets = targets or [ProfilerTarget.CPU]
         self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._record_shapes = record_shapes
         self._events: List[_Event] = []
         self._step = 0
         self._recording = False
         self._device_trace_dir: Optional[str] = None
         self._native_lib = None
+        self._step_marks: List[int] = []
 
     # -- lifecycle ------------------------------------------------------------
     def start(self):
         self._step = 0
+        self._step_marks = [time.perf_counter_ns()]
         self._apply_state()
         return self
 
     def stop(self):
+        self._step_marks.append(time.perf_counter_ns())
         self._stop_recording()
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
@@ -204,7 +310,20 @@ class Profiler:
 
     def step(self, num_samples=None):
         self._step += 1
+        self._step_marks.append(time.perf_counter_ns())
         self._apply_state()
+
+    def step_info(self, unit: str = "ms") -> dict:
+        """Per-step wall-clock stats from the step() marks — the whole
+        output when ``timer_only`` is set."""
+        scale = {"ms": 1e6, "us": 1e3, "s": 1e9}[unit]
+        durs = [(b - a) / scale for a, b in
+                zip(self._step_marks, self._step_marks[1:])]
+        if not durs:
+            return {"steps": 0}
+        return {"steps": len(durs),
+                f"avg_{unit}": sum(durs) / len(durs),
+                f"min_{unit}": min(durs), f"max_{unit}": max(durs)}
 
     def _apply_state(self):
         state = "record" if self._scheduler is None \
@@ -216,6 +335,8 @@ class Profiler:
 
     def _start_recording(self):
         self._recording = True
+        if self._timer_only:
+            return  # step timing only: no event capture, no native ring
         lib = _NativeTracer.load()
         if lib is not None and lib.ht_start(1 << 20) == 0:
             self._native_lib = lib
@@ -260,14 +381,31 @@ class Profiler:
         os.makedirs(dir_name, exist_ok=True)
         path = os.path.join(
             dir_name, f"{worker_name or 'host'}.pb.trace.json")
+        evs = sorted(self._events, key=lambda e: e.start)
         events = []
-        for e in self._events:
-            events.append({
-                "name": e.name, "ph": "X", "cat": "op",
+        if any(e.cat == "comm" for e in evs):
+            # name the dedicated collective lane in the viewer
+            events.append({"ph": "M", "name": "thread_name", "pid": 0,
+                           "tid": _COMM_TID,
+                           "args": {"name": "collectives"}})
+        comm_cum = 0
+        for e in evs:
+            d = {
+                "name": e.name, "ph": "X", "cat": e.cat or "op",
                 "ts": e.start / 1000.0,  # chrome wants microseconds
                 "dur": (e.end - e.start) / 1000.0,
-                "pid": 0, "tid": e.tid,
-            })
+                "pid": 0,
+                "tid": _COMM_TID if e.cat == "comm" else e.tid,
+            }
+            if e.args:
+                d["args"] = dict(e.args)
+            events.append(d)
+            if e.cat == "comm":
+                # cumulative comm-volume counter track next to the lane
+                comm_cum += int((e.args or {}).get("bytes", 0))
+                events.append({"name": "comm_bytes", "ph": "C", "pid": 0,
+                               "ts": e.start / 1000.0,
+                               "args": {"bytes": comm_cum}})
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)

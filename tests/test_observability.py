@@ -1,0 +1,405 @@
+"""Observability layer: metrics registry + exposition sinks, step
+telemetry through Model.fit, collective-comm tracing, flight recorder
+postmortems, bench.py metric emission (docs/OBSERVABILITY.md)."""
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.profiler as profiler
+from paddle_tpu.observability import (
+    MetricsRegistry, StepTimer, comm_totals, flight_recorder, get_registry,
+    payload_bytes,
+)
+from paddle_tpu.observability.metrics import MetricsExporter
+
+
+@pytest.fixture
+def recorder_off():
+    """Ensure the flight recorder never leaks across tests."""
+    flight_recorder.disable()
+    yield
+    flight_recorder.disable()
+
+
+class TestMetricsRegistry:
+    def test_counter_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests")
+        c.inc(2, route="/a")
+        c.inc(route="/a")
+        c.inc(5, route="/b")
+        assert c.value(route="/a") == 3
+        assert c.value(route="/b") == 5
+        assert c.total() == 8
+
+    def test_counter_monotonic(self):
+        c = MetricsRegistry().counter("x")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_gauge_set_inc(self):
+        g = MetricsRegistry().gauge("temp")
+        g.set(3.5, zone="hot")
+        g.inc(0.5, zone="hot")
+        g.dec(1.0, zone="hot")
+        assert g.value(zone="hot") == pytest.approx(3.0)
+
+    def test_histogram_buckets(self):
+        h = MetricsRegistry().histogram("lat", buckets=[0.1, 1.0, 10.0])
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        st = h.stats()
+        assert st["count"] == 4
+        assert st["sum"] == pytest.approx(55.55)
+
+    def test_kind_conflict(self):
+        reg = MetricsRegistry()
+        reg.counter("m")
+        with pytest.raises(ValueError):
+            reg.gauge("m")
+
+    def test_prometheus_text(self):
+        reg = MetricsRegistry()
+        reg.counter("hits_total", "hit count").inc(7, kind="a")
+        reg.gauge("depth").set(2.5)
+        reg.histogram("t", buckets=[1.0]).observe(0.5)
+        text = reg.prometheus_text()
+        assert "# TYPE hits_total counter" in text
+        assert 'hits_total{kind="a"} 7.0' in text
+        assert "depth 2.5" in text
+        assert 't_bucket{le="1.0"} 1' in text
+        assert 't_bucket{le="+Inf"} 1' in text
+        assert "t_sum 0.5" in text and "t_count 1" in text
+
+    def test_json_exposition(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc(3, op="x")
+        reg.histogram("h", buckets=[1.0]).observe(0.5)
+        doc = reg.to_json()
+        assert doc["c"]["type"] == "counter"
+        assert doc["c"]["samples"][0] == {"labels": {"op": "x"}, "value": 3.0}
+        assert doc["h"]["samples"][0]["count"] == 1
+        json.dumps(doc)  # fully serializable
+
+    def test_reset_keeps_registrations(self):
+        reg = MetricsRegistry()
+        c = reg.counter("c")
+        c.inc(3)
+        reg.reset()
+        assert c.value() == 0
+        assert reg.get("c") is c
+
+    def test_http_exporter(self):
+        reg = MetricsRegistry()
+        reg.gauge("scrape_me").set(42.0)
+        exp = MetricsExporter(0, reg)  # ephemeral port
+        try:
+            base = f"http://127.0.0.1:{exp.port}"
+            text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "scrape_me 42.0" in text
+            doc = json.loads(urllib.request.urlopen(
+                f"{base}/metrics.json").read().decode())
+            assert doc["scrape_me"]["samples"][0]["value"] == 42.0
+        finally:
+            exp.stop()
+
+
+class TestStepTimer:
+    def test_decomposition_and_rates(self):
+        reg = MetricsRegistry()
+        timer = StepTimer(registry=reg, flops_per_sample=1e6, peak=1e9)
+        timer.begin_step(data_time=0.25)
+        stats = timer.end_step(samples=10, tokens=1000)
+        assert stats["data_time_s"] == pytest.approx(0.25)
+        assert stats["step_time_s"] > 0.25
+        assert stats["compute_time_s"] >= 0
+        assert stats["collective_time_s"] == 0.0
+        assert stats["samples_per_sec"] == pytest.approx(
+            10 / stats["step_time_s"])
+        assert stats["tokens_per_sec"] == pytest.approx(
+            1000 / stats["step_time_s"])
+        assert stats["mfu"] == pytest.approx(
+            10 * 1e6 / stats["step_time_s"] / 1e9)
+        assert reg.counter("train_steps_total").value() == 1
+        assert reg.get("train_step_seconds").stats()["count"] == 1
+
+    def test_tokens_per_sample_hint(self):
+        timer = StepTimer(registry=MetricsRegistry(), tokens_per_sample=128,
+                          peak=0)
+        timer.begin_step()
+        stats = timer.end_step(samples=4)
+        assert stats["tokens_per_sec"] == pytest.approx(
+            4 * 128 / stats["step_time_s"])
+
+
+def _tiny_model():
+    model = pt.hapi.Model(nn.Sequential(
+        nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1)))
+    model.prepare(pt.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters()),
+                  nn.MSELoss())
+    return model
+
+
+def _tiny_data(n=4, bs=4):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(bs, 8).astype(np.float32),
+             rng.randn(bs, 1).astype(np.float32)) for _ in range(n)]
+
+
+class TestStepTelemetry:
+    def test_fit_records_and_scrapes(self):
+        """Acceptance: a 2-layer Model.fit on CPU with telemetry enabled
+        yields a Prometheus scrape with step-time and samples/sec, and a
+        train loop runs with the exporter active (tier-1 smoke)."""
+        reg = MetricsRegistry()
+        tel = pt.callbacks.StepTelemetry(flops_per_sample=1000.0,
+                                         registry=reg, peak=1e12)
+        exp = MetricsExporter(0, reg)  # exporter live during training
+        try:
+            _tiny_model().fit(_tiny_data(), epochs=1, verbose=0,
+                              callbacks=[tel])
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/metrics").read().decode()
+        finally:
+            exp.stop()
+        assert "train_step_seconds" in text
+        assert "train_samples_per_sec" in text
+        assert "train_steps_total 4.0" in text
+        stats = tel.last_stats
+        assert stats["samples_per_sec"] > 0
+        assert stats["mfu"] > 0
+        assert stats["step_time_s"] >= stats["data_time_s"]
+
+    def test_logs_injected_for_other_callbacks(self):
+        seen = {}
+
+        class Capture(pt.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.update(logs or {})
+
+        tel = pt.callbacks.StepTelemetry(registry=MetricsRegistry(), peak=0)
+        _tiny_model().fit(_tiny_data(n=2), epochs=1, verbose=0,
+                          callbacks=[tel, Capture()])
+        assert "loss" in seen
+        assert seen["samples_per_sec"] > 0
+        assert seen["step_time_s"] > 0
+
+    def test_flops_hint_from_network_attribute(self):
+        model = _tiny_model()
+        model.network.flops_per_sample = 500.0
+        tel = pt.callbacks.StepTelemetry(registry=MetricsRegistry(),
+                                         peak=1e12)
+        model.fit(_tiny_data(n=2), epochs=1, verbose=0, callbacks=[tel])
+        assert "mfu" in tel.last_stats
+
+
+class TestCommTracing:
+    def _mesh(self):
+        import paddle_tpu.distributed as dist
+        return dist.init_mesh({"dp": 8})
+
+    def test_all_reduce_span_bytes_axes(self, tmp_path):
+        """Acceptance: collective spans in the chrome trace carry bytes
+        and group-axis attributes, in a dedicated lane with counters."""
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import P
+        mesh = self._mesh()
+
+        @dist.spmd(mesh=mesh, in_specs=P("dp"), out_specs=P())
+        def global_sum(x):
+            return dist.all_reduce(x, group=dist.Group(("dp",)))
+
+        with profiler.Profiler() as prof:
+            out = global_sum(pt.to_tensor(np.ones((8, 4), np.float32)))
+        assert np.allclose(out.numpy(), 8.0)
+        comm = [e for e in prof.events if e.cat == "comm"]
+        assert comm, "collective emitted no comm span"
+        assert comm[0].args["bytes"] == 4 * 4  # per-shard (1,4) f32
+        assert comm[0].args["axes"] == "dp"
+
+        path = prof.export_chrome_tracing(str(tmp_path))
+        data = profiler.load_profiler_result(path)
+        spans = [e for e in data["traceEvents"]
+                 if e.get("cat") == "comm" and e.get("ph") == "X"]
+        assert spans and spans[0]["args"]["bytes"] == 16
+        assert spans[0]["args"]["axes"] == "dp"
+        counters = [e for e in data["traceEvents"] if e.get("ph") == "C"]
+        assert counters and counters[-1]["args"]["bytes"] >= 16
+        lanes = [e for e in data["traceEvents"]
+                 if e.get("ph") == "M" and
+                 e["args"].get("name") == "collectives"]
+        assert lanes, "comm lane metadata missing"
+
+    def test_counters_accumulate_per_op(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import P
+        mesh = self._mesh()
+        before = comm_totals()
+
+        @dist.spmd(mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        def ring(x):
+            y = dist.all_reduce(x, group=dist.Group(("dp",)))
+            return dist.p2p_shift(y, group=dist.Group(("dp",)))
+
+        ring(pt.to_tensor(np.ones((8, 2), np.float32)))
+        after = comm_totals()
+        assert after["comm_calls_total"] - before["comm_calls_total"] == 2
+        assert after["comm_bytes_total"] - before["comm_bytes_total"] == 16
+        reg = get_registry()
+        assert reg.get("comm_bytes_total").value(
+            op="p2p_shift", axes="dp") >= 8
+
+    def test_send_recorded_before_raise(self, recorder_off):
+        rec = flight_recorder.enable(capacity=8, use_native=False)
+        import paddle_tpu.distributed as dist
+        with pytest.raises(NotImplementedError):
+            dist.send(pt.to_tensor(np.zeros((4,), np.float32)))
+        names = [e["name"] for e in rec.events()]
+        assert any(n.startswith("send@") for n in names)
+
+    def test_payload_bytes(self):
+        t = pt.to_tensor(np.zeros((3, 5), np.float32))
+        assert payload_bytes(t) == 60
+        assert payload_bytes([t, t]) == 120
+        assert payload_bytes(None) == 0
+
+
+class TestFlightRecorder:
+    def test_ring_wraps_keeping_last(self, recorder_off):
+        rec = flight_recorder.enable(capacity=4, use_native=False)
+        for i in range(10):
+            rec.record(flight_recorder.KIND_USER, f"e{i}", i, i + 1)
+        names = [e["name"] for e in rec.events()]
+        assert names == ["e6", "e7", "e8", "e9"]
+
+    def test_native_ring_wraps(self, recorder_off):
+        lib = profiler._NativeTracer.load()
+        if lib is None or not hasattr(lib, "fr_start"):
+            pytest.skip("native toolchain unavailable")
+        rec = flight_recorder.enable(capacity=4, use_native=True)
+        assert rec.native
+        for i in range(10):
+            rec.record(flight_recorder.KIND_COMM, f"n{i}", i, i + 1,
+                       aux=i * 100)
+        evs = rec.events()
+        assert [e["name"] for e in evs] == ["n6", "n7", "n8", "n9"]
+        assert evs[-1]["aux"] == 900
+        assert evs[-1]["kind"] == "comm"
+
+    def test_dump_from_native_ring(self, recorder_off, tmp_path,
+                                   monkeypatch):
+        """The production (toolchain-present) configuration: dump content
+        comes out of the native fr_* ring, not the Python fallback."""
+        lib = profiler._NativeTracer.load()
+        if lib is None or not hasattr(lib, "fr_start"):
+            pytest.skip("native toolchain unavailable")
+        monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+        rec = flight_recorder.enable(capacity=16, use_native=True)
+        assert rec.native
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        pt.matmul(a, a)
+        doc = json.load(open(rec.dump(reason="native-dump")))
+        assert doc["native_ring"] is True
+        names = [e["name"] for e in doc["events"]]
+        assert "matmul" in names
+        assert all({"kind", "name", "start_ns", "end_ns", "tid",
+                    "aux"} <= set(e) for e in doc["events"])
+
+    def test_ops_feed_recorder_without_profiler(self, recorder_off):
+        rec = flight_recorder.enable(capacity=32, use_native=False)
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        pt.matmul(a, a)
+        names = [e["name"] for e in rec.events()]
+        assert "matmul" in names
+        assert profiler.Profiler().events == []  # profiler still untouched
+
+    def test_dump_on_exception_with_rank(self, recorder_off, tmp_path,
+                                         monkeypatch):
+        """Acceptance: induced exception produces a postmortem JSON with
+        the last recorded events and rank metadata."""
+        monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+        rec = flight_recorder.enable(capacity=16, use_native=False)
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        pt.add(a, a)
+        try:
+            raise ValueError("induced crash")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())  # what an uncaught exc triggers
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_recorder_rank3_")]
+        assert dumps, "no postmortem written"
+        doc = json.load(open(tmp_path / dumps[0]))
+        assert doc["rank"] == 3 and doc["world_size"] == 8
+        assert doc["reason"] == "unhandled ValueError"
+        assert any(e["name"] == "add" for e in doc["events"])
+        assert rec._dumped is not None
+
+    def test_sigusr1_snapshot(self, recorder_off, tmp_path, monkeypatch):
+        import signal
+        import time
+        monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+        flight_recorder.enable(capacity=8, use_native=False)
+        flight_recorder.record(flight_recorder.KIND_USER, "marker", 0, 1)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0)  # bytecode checkpoint so the handler runs
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_recorder_")]
+        assert dumps
+        doc = json.load(open(tmp_path / dumps[0]))
+        assert doc["reason"] == "SIGUSR1"
+        assert any(e["name"] == "marker" for e in doc["events"])
+
+    def test_disable_restores_hooks(self, recorder_off):
+        hook_before = sys.excepthook
+        flight_recorder.enable(capacity=4, use_native=False)
+        assert sys.excepthook is not hook_before
+        flight_recorder.disable()
+        assert sys.excepthook is hook_before
+        assert flight_recorder.active() is None
+
+    def test_env_gate(self, recorder_off, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_RECORDER", "0")
+        assert flight_recorder.maybe_enable_from_env() is None
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_RECORDER", "64")
+        rec = flight_recorder.maybe_enable_from_env()
+        assert rec is not None and rec.capacity == 64
+
+    def test_topology_in_dump(self, recorder_off, tmp_path, monkeypatch):
+        import paddle_tpu.distributed as dist
+        monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+        dist.init_mesh({"dp": 4, "mp": 2})
+        rec = flight_recorder.enable(capacity=4, use_native=False)
+        path = rec.dump(reason="topo")
+        doc = json.load(open(path))
+        assert doc["topology"] == {"dp": 4, "mp": 2}
+
+
+class TestBenchEmit:
+    def test_emit_metrics_schema(self, tmp_path):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        try:
+            import bench
+        finally:
+            sys.path.pop(0)
+        out = tmp_path / "m.json"
+        bench.emit_metrics(
+            {"headline": {"metric": "mfu", "value": 63.3, "unit": "pct"},
+             "detail": {"step_ms": 208.5, "config": {"layers": 8}}},
+            str(out))
+        doc = json.load(open(out))
+        samples = {s["labels"]["key"]: s["value"]
+                   for s in doc["bench_result"]["samples"]}
+        assert samples["headline.value"] == 63.3
+        assert samples["detail.step_ms"] == 208.5
+        assert samples["detail.config.layers"] == 8
+        assert "headline.metric" not in samples  # strings are not gauges

@@ -4,6 +4,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 import paddle_tpu.profiler as profiler
@@ -38,6 +39,42 @@ class TestRecordEvent:
         assert prof.events == []
 
 
+class TestRecordShapes:
+    def test_shapes_attached(self):
+        a = pt.to_tensor(np.ones((4, 8), np.float32))
+        b = pt.to_tensor(np.ones((8, 2), np.float32))
+        with profiler.Profiler(record_shapes=True) as prof:
+            pt.matmul(a, b)
+        evs = [e for e in prof.events if e.name == "matmul"]
+        assert evs and evs[0].args["input_shapes"] == [[4, 8], [8, 2]]
+
+    def test_shapes_off_by_default(self):
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        with profiler.Profiler() as prof:
+            pt.matmul(a, a)
+        evs = [e for e in prof.events if e.name == "matmul"]
+        assert evs and evs[0].args is None
+
+
+class TestTimerOnly:
+    def test_no_events_but_step_info(self):
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        prof = profiler.Profiler(timer_only=True).start()
+        pt.matmul(a, a)
+        prof.step()
+        pt.matmul(a, a)
+        prof.step()
+        prof.stop()
+        assert prof.events == []  # no op capture at all
+        info = prof.step_info()
+        assert info["steps"] == 3  # start->step, step->step, step->stop
+        assert info["avg_ms"] > 0
+
+    def test_timer_only_does_not_claim_active(self):
+        with profiler.Profiler(timer_only=True):
+            assert profiler.record_op("x") is None
+
+
 class TestScheduler:
     def test_state_machine(self):
         sched = profiler.make_scheduler(closed=1, ready=1, record=2,
@@ -45,6 +82,26 @@ class TestScheduler:
         states = [sched(i) for i in range(6)]
         assert states == ["closed", "closed", "ready", "record", "record",
                           "closed"]
+
+    def test_skip_first_repeat_wraparound(self):
+        # skip 2, then (closed 1, record 1) x 2 cycles, closed forever
+        sched = profiler.make_scheduler(closed=1, ready=0, record=1,
+                                        repeat=2, skip_first=2)
+        states = [sched(i) for i in range(8)]
+        assert states == ["closed", "closed",          # skip_first
+                          "closed", "record",          # cycle 1
+                          "closed", "record",          # cycle 2
+                          "closed", "closed"]          # repeat exhausted
+
+    def test_zero_closed_ready(self):
+        sched = profiler.make_scheduler(closed=0, ready=0, record=2)
+        assert [sched(i) for i in range(4)] == ["record"] * 4
+
+    def test_invalid_periods_raise(self):
+        with pytest.raises(ValueError):
+            profiler.make_scheduler(record=0)
+        with pytest.raises(ValueError):
+            profiler.make_scheduler(closed=-1)
 
     def test_profiler_honors_scheduler(self):
         a = pt.to_tensor(np.ones((2, 2), np.float32))
@@ -86,3 +143,49 @@ class TestSinks:
         agg = dict(rows)
         assert agg["matmul"][1] == 3  # 3 calls
         assert "matmul" in capsys.readouterr().out
+
+    def test_chrome_roundtrip_spans_and_counters(self, tmp_path):
+        """export -> load_profiler_result round-trip: op spans, tagged
+        comm spans, and counter events all survive serialization."""
+        a = pt.to_tensor(np.ones((2, 2), np.float32))
+        with profiler.Profiler() as prof:
+            pt.matmul(a, a)
+            # a comm span the way observability.comm emits one
+            profiler._emit_event("comm::all_reduce", 100, 200, tid=1,
+                                 args={"bytes": 64, "axes": "dp"},
+                                 cat="comm")
+        path = prof.export_chrome_tracing(str(tmp_path), "w0")
+        data = profiler.load_profiler_result(path)
+        evs = data["traceEvents"]
+        ops = [e for e in evs if e.get("cat") == "op" and e["ph"] == "X"]
+        comm = [e for e in evs if e.get("cat") == "comm" and e["ph"] == "X"]
+        ctrs = [e for e in evs if e["ph"] == "C"]
+        assert any(e["name"] == "matmul" for e in ops)
+        assert comm[0]["args"] == {"bytes": 64, "axes": "dp"}
+        assert ctrs and ctrs[0]["name"] == "comm_bytes"
+        assert ctrs[0]["args"]["bytes"] == 64
+        # loaded doc is exactly what was exported
+        assert data == json.load(open(path))
+
+
+class TestNativeRebuildLock:
+    def test_stale_so_rebuilds_under_lock(self):
+        """A stale .so triggers a locked recompile; the lock file exists
+        and the fresh library still exposes both rings' symbols."""
+        import shutil
+        if shutil.which("g++") is None:
+            pytest.skip("no toolchain")
+        tracer = profiler._NativeTracer
+        here = os.path.dirname(os.path.dirname(os.path.abspath(
+            profiler.__file__)))
+        src = os.path.join(os.path.dirname(here), "native",
+                           "host_tracer.cpp")
+        so = os.path.join(os.path.dirname(src), "build",
+                          "libhost_tracer.so")
+        os.utime(src)  # make the .so stale
+        tracer._lib, tracer._failed = None, False
+        lib = tracer.load()
+        assert lib is not None
+        assert os.path.getmtime(so) >= os.path.getmtime(src)
+        assert os.path.exists(so + ".lock")
+        assert hasattr(lib, "ht_start") and hasattr(lib, "fr_start")
