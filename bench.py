@@ -363,6 +363,92 @@ def bench_decode():
     return out
 
 
+def bench_serve():
+    """Continuous-batching serving bench (--serve): drive the
+    ``serving.ServingEngine`` with a synthetic Poisson arrival trace and
+    report p50/p99 TTFT and aggregate generated tokens/sec — the numbers
+    future serving-perf rounds (ragged paged attention kernels,
+    speculative decoding) must move. On TPU the model is the headline
+    0.7B bf16 Llama config; elsewhere a smoke config keeps the bench
+    runnable anywhere. Results ride the ``--emit-metrics`` JSON schema.
+    """
+    import time as _time
+
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=128256, hidden_size=2048, intermediate_size=7168,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=4096,
+            tie_word_embeddings=True)
+        n_req, mean_gap = 32, 0.05
+        p_lo, p_hi, g_lo, g_hi = 64, 512, 16, 96
+        eng_kw = dict(max_batch=8, max_blocks=512, block_size=16,
+                      prefill_chunk=128)
+    else:
+        cfg = LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            tie_word_embeddings=True)
+        n_req, mean_gap = 12, 0.02
+        p_lo, p_hi, g_lo, g_hi = 8, 32, 8, 24
+        eng_kw = dict(max_batch=4, max_blocks=64, block_size=8,
+                      prefill_chunk=16)
+
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    if on_tpu:
+        model.bfloat16()
+    engine = ServingEngine(model, **eng_kw)
+    engine.start()
+
+    rng = np.random.RandomState(0)
+    # warmup request compiles both executables outside the timed trace
+    engine.submit(rng.randint(1, cfg.vocab_size, 8),
+                  max_new_tokens=4).result(timeout=600)
+
+    gaps = rng.exponential(mean_gap, n_req)  # Poisson arrival process
+    plens = rng.randint(p_lo, p_hi + 1, n_req)
+    gens = rng.randint(g_lo, g_hi + 1, n_req)
+    handles = []
+    t0 = _time.perf_counter()
+    for gap, pl, gn in zip(gaps, plens, gens):
+        _time.sleep(gap)
+        handles.append(engine.submit(
+            rng.randint(1, cfg.vocab_size, pl), max_new_tokens=int(gn)))
+    engine.drain(timeout=600)
+    elapsed = _time.perf_counter() - t0
+    engine.shutdown()
+
+    results = [h.result(timeout=1) for h in handles]
+    ttfts = np.array([r["ttft_s"] for r in results])
+    lats = np.array([r["latency_s"] for r in results])
+    gen_tokens = int(sum(r["num_generated"] for r in results))
+    stats = engine.stats()
+    return {
+        "requests": n_req,
+        "mean_arrival_gap_s": mean_gap,
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 2),
+        "latency_p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 2),
+        "latency_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2),
+        "generated_tokens": gen_tokens,
+        "tokens_per_sec": round(gen_tokens / elapsed, 1),
+        "elapsed_s": round(elapsed, 2),
+        "preemptions": stats["preemptions"],
+        "decode_compiles": stats["decode_compiles"],
+        "config": {"d": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+                   "vocab": cfg.vocab_size, **eng_kw},
+    }
+
+
 def bench_eager():
     """Eager-dispatch overhead — SURVEY §7's #1 risk ('per-op eager
     dispatch is untenable'), finally measured (reference ships the
@@ -468,6 +554,13 @@ def main():
         print(json.dumps({"eager": eager}))
         if metrics_out:
             emit_metrics({"eager": eager}, metrics_out)
+        return
+
+    if "--serve" in sys.argv:
+        serve = bench_serve()
+        print(json.dumps({"serve": serve}))
+        if metrics_out:
+            emit_metrics({"serve": serve}, metrics_out)
         return
 
     on_tpu = jax.default_backend() == "tpu"

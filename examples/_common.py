@@ -1,0 +1,23 @@
+"""Shared example plumbing (not a demo itself — running it is a no-op).
+
+Every example that needs a small, fast causal LM builds it here, so the
+model-construction recipe lives in one place.
+"""
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def build_tiny_llama(seed: int = 0, **config_overrides) -> LlamaForCausalLM:
+    """Deterministic tiny Llama in eval mode (runs in <1s on CPU).
+
+    ``config_overrides`` land on :meth:`LlamaConfig.tiny` — e.g.
+    ``num_hidden_layers=1`` for the export demo's minimal artifact.
+    """
+    paddle.seed(seed)
+    model = LlamaForCausalLM(LlamaConfig.tiny(**config_overrides))
+    model.eval()
+    return model
+
+
+if __name__ == "__main__":
+    print("helper module; see serve_llama.py / export_and_serve.py")

@@ -195,8 +195,16 @@ class MoELayer(Layer):
                         axis, *([None] * (len(w.shape) - 1))))
         self.l_aux = None
 
-    def forward(self, x):
-        """x: [..., d_model] -> same shape; stores self.l_aux."""
+    def forward(self, x, token_mask=None):
+        """x: [..., d_model] -> same shape; stores self.l_aux.
+
+        ``token_mask`` (optional, broadcastable to x's leading dims,
+        True = real token) excludes padding from routing: masked tokens
+        are assigned a sentinel expert id, so they claim no capacity
+        positions, no bincount share, and no aux-loss weight — the
+        serving engine's inactive decode slots and padded prefill-chunk
+        tails must not steal expert capacity from (or perturb the drop
+        pattern of) real tokens."""
         import jax
         import jax.numpy as jnp
 
@@ -209,11 +217,15 @@ class MoELayer(Layer):
 
         ragged = self.dispatch_mode == "ragged"
 
-        def f(xa, gw, w1, b1, w2, b2):
+        def f(xa, gw, w1, b1, w2, b2, *rest):
             lead = xa.shape[:-1]
             xt = xa.reshape(-1, xa.shape[-1])  # [T, M]
             T, M = xt.shape
             C = max(int(cap_f * T * K / E), 1)
+            vm = None
+            if rest:
+                vm = jnp.broadcast_to(rest[0].astype(bool),
+                                      lead).reshape(T)
 
             logits = xt @ gw  # [T, E]
             probs = jax.nn.softmax(logits, axis=-1)
@@ -227,9 +239,18 @@ class MoELayer(Layer):
             # faster on chip than the [K*T, E] one-hot cumsum these
             # replaced (same positions, so capacity drops stay
             # bit-identical).
-            me = probs.mean(axis=0)  # mean gate prob per expert
+            if vm is None:
+                me = probs.mean(axis=0)  # mean gate prob per expert
+            else:
+                n_real = jnp.maximum(vm.sum(), 1).astype(probs.dtype)
+                me = (probs * vm[:, None].astype(probs.dtype)).sum(0) \
+                    / n_real
             gate_k, idx_k = jax.lax.top_k(probs, K)  # [T, K] descending
             e_flat = jnp.swapaxes(idx_k, 0, 1).reshape(K * T)
+            if vm is not None:
+                # padding routes to sentinel expert E: sorts into its own
+                # trailing segment, takes no positions/counts below
+                e_flat = jnp.where(jnp.tile(vm, K), e_flat, E)
             order = jnp.argsort(e_flat, stable=True)
             e_sorted = e_flat[order]
             ar = jnp.arange(K * T, dtype=jnp.int32)
@@ -240,10 +261,15 @@ class MoELayer(Layer):
             pos_flat = jnp.zeros((K * T,), jnp.int32).at[order].set(
                 ar - seg_start)
             pos_km = pos_flat.reshape(K, T)
-            counts = jnp.bincount(e_flat, length=E)
-            ce_acc = counts.astype(probs.dtype) / T
-            picks = [(idx_k[:, k], gate_k[:, k], pos_km[k],
-                      pos_km[k] < C) for k in range(K)]
+            counts = jnp.bincount(e_flat, length=E)  # sentinel E excluded
+            if vm is None:
+                ce_acc = counts.astype(probs.dtype) / T
+                picks = [(idx_k[:, k], gate_k[:, k], pos_km[k],
+                          pos_km[k] < C) for k in range(K)]
+            else:
+                ce_acc = counts.astype(probs.dtype) / n_real
+                picks = [(idx_k[:, k], gate_k[:, k], pos_km[k],
+                          (pos_km[k] < C) & vm) for k in range(K)]
 
             # renormalize gates over the KEPT assignments (dense path
             # normalized the combine tensor — same entries)
@@ -313,7 +339,8 @@ class MoELayer(Layer):
                 aux = jnp.zeros((), xt.dtype)
             return out.reshape(*lead, xa.shape[-1]), aux
 
+        extra = () if token_mask is None else (token_mask,)
         out, aux = apply_op(f, x, self.gate.weight, self.w1, self.b1,
-                            self.w2, self.b2, op_name="moe_layer")
+                            self.w2, self.b2, *extra, op_name="moe_layer")
         self.l_aux = aux
         return out

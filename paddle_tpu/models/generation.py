@@ -15,7 +15,25 @@ from paddle_tpu.core import generator as G
 from paddle_tpu.core.autograd import no_grad
 from paddle_tpu.core.tensor import Tensor
 
-__all__ = ["sample_token", "generate_loop", "compiled_generate"]
+__all__ = ["sample_token", "generate_loop", "compiled_generate",
+           "decode_surfaces"]
+
+
+def decode_surfaces(model, state):
+    """The zoo family seam shared by every compiled decode path
+    (``compiled_generate`` and ``serving.ServingEngine``): returns
+    ``(backbone, project, dtype)``. Llama keeps the trunk at
+    ``model.model`` plus a ``_logits`` projector; the MoE LM's cached
+    forward lives on the top Layer with an ``lm_head``. ``dtype`` is
+    sniffed from the embedding weight (the KV-cache dtype)."""
+    embed_name = next(n for n in state if "embed_tokens" in n
+                      and n.endswith("weight"))
+    dtype = state[embed_name].dtype
+    backbone = getattr(model, "model", None)
+    if backbone is None or not callable(backbone):
+        backbone = model
+    project = model._logits if hasattr(model, "_logits") else model.lm_head
+    return backbone, project, dtype
 
 # max live compiled_generate executables per model (LRU-evicted)
 _COMPILED_CACHE_CAP = 16
@@ -45,7 +63,14 @@ def sample_token(step_logits, temperature: float, top_k: int,
 def generate_loop(prefill, decode, input_ids, max_new_tokens: int = 32,
                   temperature: float = 1.0, top_k: int = 0,
                   top_p: float = 1.0, eos_token_id=None) -> Tensor:
-    """Returns the full sequence [B, S + new] including the prompt."""
+    """Returns the full sequence [B, S + new] including the prompt.
+
+    The loop EXITS EARLY once every row has emitted ``eos_token_id`` —
+    ``new`` is then the step count actually taken, not the full budget,
+    and no decode forward runs past the last useful step (rows that
+    finish first keep padding with eos until the stragglers catch up;
+    guarded by tests/test_serving.py::test_generate_loop_breaks_on_all_eos).
+    """
     with no_grad():
         logits, caches = prefill(input_ids)
         out_np = np.asarray(input_ids.data)
@@ -122,20 +147,7 @@ def compiled_generate(model, input_ids, max_new_tokens: int = 32,
     nl = cfg.num_hidden_layers
     n_kv = cfg.num_key_value_heads
     hd = cfg.hidden_size // cfg.num_attention_heads
-    embed_name = next(n for n in st if "embed_tokens" in n
-                      and n.endswith("weight"))
-    dtype = st[embed_name].dtype
-
-    # family seam: llama keeps the trunk at model.model + a _logits
-    # projector; the MoE LM's cached forward lives on the top Layer with
-    # an lm_head — serve both through the same compiled loop
-    backbone = getattr(model, "model", None)
-    if backbone is None or not callable(backbone):
-        backbone = model
-    if hasattr(model, "_logits"):
-        project = model._logits
-    else:
-        project = model.lm_head
+    backbone, project, dtype = decode_surfaces(model, st)
 
     ragged = attention_mask is not None
     if ragged:

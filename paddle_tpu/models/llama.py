@@ -28,6 +28,7 @@ from paddle_tpu.core.autograd import apply_op
 from paddle_tpu import ops
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
+from paddle_tpu.ops.paged_attention import PagedLayerCache
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]
 
@@ -83,6 +84,24 @@ def _rope_cache(seq_len: int, dim: int, theta: float, dtype_name: str):
     return (np.cos(freqs).astype(to), np.sin(freqs).astype(to))
 
 
+def _rot_interleaved(t, cos, sin):
+    """THE rotation convention (even/odd lane pairs, re-interleaved) —
+    the single definition every path (eager, static-cache, paged
+    serving) must share so their numerics can never desynchronize.
+    ``cos``/``sin`` broadcast against ``t`` [..., S, H, D/2]."""
+    t1, t2 = t[..., 0::2], t[..., 1::2]
+    return jnp.stack([t1 * cos - t2 * sin, t2 * cos + t1 * sin],
+                     axis=-1).reshape(t.shape)
+
+
+def _gather_rope(pidx, dim, theta, dtype_name, table_len):
+    """cos/sin [B, S, 1, dim/2] at PER-ROW absolute positions ``pidx``
+    [B, S] (already clipped to the table) from the cached table."""
+    cos_np, sin_np = _rope_cache(table_len, dim, theta, dtype_name)
+    return (jnp.asarray(cos_np)[pidx][:, :, None, :],
+            jnp.asarray(sin_np)[pidx][:, :, None, :])
+
+
 def apply_rotary(q, k, theta: float = 500000.0, pos_offset: int = 0,
                  table_len: int = 0):
     """Rotate q,k ([B,S,H,D]) by absolute position (``pos_offset`` shifts
@@ -96,14 +115,8 @@ def apply_rotary(q, k, theta: float = 500000.0, pos_offset: int = 0,
         cos, sin = _rope_cache(n, d, theta, str(qa.dtype))
         cos = jnp.asarray(cos)[None, pos_offset:pos_offset + s, None, :]
         sin = jnp.asarray(sin)[None, pos_offset:pos_offset + s, None, :]
-
-        def rot(x):
-            x1, x2 = x[..., 0::2], x[..., 1::2]
-            r1 = x1 * cos - x2 * sin
-            r2 = x2 * cos + x1 * sin
-            # re-interleave even/odd lanes
-            return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
-        return rot(qa), rot(ka)
+        return (_rot_interleaved(qa, cos, sin),
+                _rot_interleaved(ka, cos, sin))
     return apply_op(f, q, k, op_name="rotary_embedding")
 
 
@@ -160,7 +173,20 @@ class LlamaAttention(nn.Layer):
             are excluded by it and by the causal bound).
         ``pos_offsets`` ([B] int32, static path) shifts RoPE positions per
         row — a LEFT-padded row with ``pad`` pads has its first real token
-        at position 0, not ``pad`` (the ragged-serving shape)."""
+        at position 0, not ``pad`` (the ragged-serving shape).
+
+        A :class:`~paddle_tpu.ops.paged_attention.PagedLayerCache` takes
+        the BLOCK-PAGED path (the continuous-batching serving engine's
+        cache form): per-row positions from ``context_lens``, scatter into
+        the shared block pools, gather-based attention over each row's
+        block table."""
+        if isinstance(cache, PagedLayerCache):
+            if attention_mask is not None or pos_offsets is not None:
+                raise NotImplementedError(
+                    "the paged path derives per-row positions and key "
+                    "liveness from the cache itself; attention_mask/"
+                    "pos_offsets do not apply")
+            return self._paged_forward(x, cache)
         if cache is not None and len(cache) == 3:
             return self._static_forward(x, cache, attention_mask,
                                         pos_offsets)
@@ -239,27 +265,21 @@ class LlamaAttention(nn.Layer):
 
         def f(qa, ka, va, kb, vb, p, *extra):
             p = jnp.reshape(p, ()).astype(jnp.int32)
-            cos_np, sin_np = _rope_cache(L, hd, theta, str(qa.dtype))
             if ragged:
                 po, km = extra
                 # per-row positions: row b, query j -> p + j - pad_b
                 pidx = jnp.clip(p + jnp.arange(S)[None, :]
                                 - po[:, None].astype(jnp.int32), 0, L - 1)
-                cos = jnp.asarray(cos_np)[pidx][:, :, None, :]  # [B,S,1,·]
-                sin = jnp.asarray(sin_np)[pidx][:, :, None, :]
+                cos, sin = _gather_rope(pidx, hd, theta, str(qa.dtype), L)
             else:
+                cos_np, sin_np = _rope_cache(L, hd, theta, str(qa.dtype))
                 cos = jax.lax.dynamic_slice_in_dim(
                     jnp.asarray(cos_np), p, S)[None, :, None, :]
                 sin = jax.lax.dynamic_slice_in_dim(
                     jnp.asarray(sin_np), p, S)[None, :, None, :]
 
-            def rot(t):
-                t1, t2 = t[..., 0::2], t[..., 1::2]
-                return jnp.stack([t1 * cos - t2 * sin,
-                                  t2 * cos + t1 * sin],
-                                 axis=-1).reshape(t.shape)
-
-            qr, kr = rot(qa), rot(ka)
+            qr = _rot_interleaved(qa, cos, sin)
+            kr = _rot_interleaved(ka, cos, sin)
             kb = jax.lax.dynamic_update_slice(kb, kr, (0, p, 0, 0))
             vb = jax.lax.dynamic_update_slice(vb, va, (0, p, 0, 0))
             qg = qr.reshape(B, S, self.n_kv, grp, hd)
@@ -282,6 +302,44 @@ class LlamaAttention(nn.Layer):
         out, kb2, vb2 = apply_op(f, q, k, v, k_buf, v_buf, pos, *extra,
                                  op_name="static_kv_attention")
         return self.o_proj(out), (kb2, vb2, pos + S)
+
+    def _paged_forward(self, x, cache):
+        """Block-paged KV attention (the ``serving.ServingEngine`` path):
+        RoPE at per-row traced positions (``context_lens``), scatter the
+        new K/V into the shared block pools, masked gather-attention over
+        each row's block table (ops/paged_attention.py). Shapes are
+        independent of any sequence's length, so one executable serves
+        every mix of requests. Cache position is HOST-managed: the
+        returned cache carries the same ``context_lens`` — the engine
+        advances them after harvesting valid tokens."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import paged_attention as pa
+
+        B, S = x.shape[0], x.shape[1]
+        q = ops.reshape(self.q_proj(x), [B, S, self.n_heads, self.head_dim])
+        k = ops.reshape(self.k_proj(x), [B, S, self.n_kv, self.head_dim])
+        v = ops.reshape(self.v_proj(x), [B, S, self.n_kv, self.head_dim])
+        hd = self.head_dim
+        theta = self.cfg.rope_theta
+        table_len = self.cfg.max_position_embeddings
+        scale = 1.0 / math.sqrt(hd)
+
+        def f(qa, ka, va, kp, vp, bt, ctx, nlen):
+            pos = ctx[:, None].astype(jnp.int32) + \
+                jnp.arange(S, dtype=jnp.int32)[None, :]
+            cos, sin = _gather_rope(jnp.clip(pos, 0, table_len - 1), hd,
+                                    theta, str(qa.dtype), table_len)
+            return pa.paged_attention_step(
+                _rot_interleaved(qa, cos, sin),
+                _rot_interleaved(ka, cos, sin), va, kp, vp,
+                bt, ctx, nlen, scale=scale)
+
+        out, kp2, vp2 = apply_op(
+            f, q, k, v, cache.k_pool, cache.v_pool, cache.block_tables,
+            cache.context_lens, cache.new_lens, op_name="paged_kv_attention")
+        return self.o_proj(out), pa.PagedLayerCache(
+            kp2, vp2, cache.block_tables, cache.context_lens, cache.new_lens)
 
 
 class LlamaMLP(nn.Layer):

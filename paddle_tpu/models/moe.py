@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from paddle_tpu import ops
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
+from paddle_tpu.ops.paged_attention import PagedLayerCache
 from .llama import LlamaAttention, LlamaConfig, LlamaMLP
 
 __all__ = ["MoeConfig", "MoeDecoderLayer", "MoeForCausalLM"]
@@ -121,7 +122,16 @@ class MoeDecoderLayer(nn.Layer):
         if self.is_dense:
             out = ops.add(x, self.mlp(h))
         else:
-            routed = self.mlp(h)
+            # paged serving: padded prefill tails and inactive decode
+            # slots must not steal expert capacity from real tokens —
+            # derive a token-validity mask from the cache's new_lens
+            kw = {}
+            if isinstance(cache, PagedLayerCache):
+                S = x.shape[1]
+                kw["token_mask"] = ops.less_than(
+                    ops.reshape(ops.arange(0, S, 1, "int32"), [1, S]),
+                    ops.reshape(cache.new_lens, [-1, 1]))
+            routed = self.mlp(h, **kw)
             if self.shared_expert is not None:
                 routed = ops.add(routed, self.shared_expert(h))
             out = ops.add(x, routed)
@@ -156,6 +166,16 @@ class MoeForCausalLM(nn.Layer):
             if la is not None:
                 total = la if total is None else ops.add(total, la)
         return total
+
+    def clear_decode_side_effects(self):
+        """Drop per-layer gate side state (``l_aux``) left behind by a
+        TRACED forward. Any compiled decode path — ``generate_compiled``
+        and the ``serving.ServingEngine`` step — must call this after
+        tracing so a later ``aux_loss()`` can't touch an escaped tracer
+        (the balance loss only means something in training forwards)."""
+        for layer in self.layers:
+            if hasattr(layer.mlp, "l_aux"):
+                layer.mlp.l_aux = None
 
     def forward(self, input_ids, labels=None, caches=None):
         x = self.embed_tokens(input_ids)
@@ -245,10 +265,7 @@ class MoeForCausalLM(nn.Layer):
         out = compiled_generate(self, input_ids, max_new_tokens,
                                 temperature, top_k, top_p, eos_token_id,
                                 prefill_chunk=prefill_chunk)
-        # tracing the loop stored TRACERS in every MoE layer's l_aux (the
-        # balance loss only means something in training forward passes);
+        # tracing the loop stored TRACERS in every MoE layer's l_aux;
         # clear them so a later aux_loss() can't touch an escaped tracer
-        for layer in self.layers:
-            if hasattr(layer.mlp, "l_aux"):
-                layer.mlp.l_aux = None
+        self.clear_decode_side_effects()
         return out

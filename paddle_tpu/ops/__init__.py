@@ -19,6 +19,7 @@ from .array import (  # noqa: F401
     TensorArray, array_length, array_read, array_write, create_array,
 )
 from .extras import *  # noqa: F401,F403
+from . import paged_attention  # noqa: F401
 
 from . import math as _math
 from . import creation as _creation

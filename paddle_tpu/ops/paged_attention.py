@@ -1,0 +1,126 @@
+"""Block-paged KV-cache attention — the gather-based XLA read path.
+
+The serving engine (``paddle_tpu.serving``) stores each layer's KV cache
+as a pool of fixed-size token blocks instead of one contiguous
+``[B, L, n_kv, hd]`` buffer per batch:
+
+    k_pool / v_pool : [num_blocks + 1, block_size, n_kv, hd]
+                      (row 0 is the reserved null block; allocatable
+                      block ids run 1..num_blocks)
+    block_tables    : [B, max_blocks_per_seq] int32 — logical block i of
+                      row b lives in physical block ``block_tables[b, i]``
+    context_lens    : [B] int32 — tokens already cached per row
+    new_lens        : [B] int32 — valid tokens in this call's input
+                      (rows may carry right-padding: a partial prefill
+                      chunk, or an inactive decode slot with new_len 0)
+
+Physical **block 0 is reserved as the null block**: padded block-table
+entries point at it and every invalid token's write is redirected into
+it, so padding can never clobber a live sequence's cache. The allocator
+(``serving.kv_cache``) never hands block 0 out.
+
+This mirrors the vLLM / Ragged-Paged-Attention layout (see
+``/opt/skills/guides/boom_attention_tricks.md`` §8: per-sequence
+``page_indices`` over non-contiguous pages). Here the read path is a
+plain XLA gather (``pool[block_tables]``) + masked softmax — correct on
+every backend and the seam where a Pallas kernel with async per-page DMA
+slots in later without touching the serving layer above it.
+"""
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedLayerCache", "write_to_pool", "gather_pool",
+           "paged_attention_step"]
+
+
+class PagedLayerCache(NamedTuple):
+    """One layer's view of the paged KV state.
+
+    Threaded through ``LlamaModel.forward(caches=[...])`` exactly like
+    the ``(k, v)`` / ``(k_buf, v_buf, pos)`` cache forms; the attention
+    layer dispatches on this type. ``block_tables`` / ``context_lens`` /
+    ``new_lens`` are shared across layers (one table per sequence), the
+    pools are per-layer.
+    """
+    k_pool: object        # [num_blocks + 1, block_size, n_kv, hd]
+    v_pool: object        # [num_blocks + 1, block_size, n_kv, hd]
+    block_tables: object  # [B, max_blocks_per_seq] int32
+    context_lens: object  # [B] int32
+    new_lens: object      # [B] int32
+
+
+def _scatter_indices(block_tables, positions, valid, block_size):
+    """(phys_block [B,S], slot [B,S]) for logical ``positions`` [B,S];
+    invalid tokens are redirected to (null block 0, slot 0)."""
+    nblk = block_tables.shape[1]
+    blk = jnp.clip(positions // block_size, 0, nblk - 1)
+    phys = jnp.take_along_axis(block_tables, blk, axis=1)
+    slot = positions % block_size
+    phys = jnp.where(valid, phys, 0)
+    slot = jnp.where(valid, slot, 0)
+    return phys, slot
+
+
+def write_to_pool(pool, new, block_tables, positions, valid):
+    """Scatter ``new`` [B, S, n_kv, hd] into ``pool`` at logical
+    ``positions`` [B, S] through ``block_tables``; tokens with
+    ``valid == False`` land in the null block."""
+    phys, slot = _scatter_indices(block_tables, positions, valid,
+                                  pool.shape[1])
+    return pool.at[phys, slot].set(new.astype(pool.dtype))
+
+
+def gather_pool(pool, block_tables):
+    """[B, max_blocks_per_seq * block_size, n_kv, hd] contiguous view of
+    each row's paged context (the XLA-gather read path)."""
+    g = pool[block_tables]  # [B, nblk, bs, n_kv, hd]
+    B, nblk, bs = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(B, nblk * bs, *pool.shape[2:])
+
+
+def paged_attention_step(q, k, v, k_pool, v_pool, block_tables,
+                         context_lens, new_lens, *, scale=None):
+    """One attention step over a block-paged cache.
+
+    ``q`` [B, S, n_heads, hd] and ``k``/``v`` [B, S, n_kv, hd] are the
+    (already position-encoded) projections of this call's ``S`` input
+    tokens per row — ``S`` is the prefill chunk length, or 1 in decode.
+    Writes the new K/V into the pools (invalid tokens to the null
+    block), gathers each row's whole paged context, and runs masked
+    GQA attention: key at logical position ``l`` is visible to row
+    ``b``'s query ``i`` iff ``l <= context_lens[b] + i`` — that one
+    bound covers prior context, in-chunk causality, and (together with
+    null-block redirection) keeps padding invisible.
+
+    Returns ``(out [B, S, n_heads*hd], k_pool', v_pool')``. Outputs at
+    padded query positions (``i >= new_lens[b]``) are garbage by
+    construction and must be discarded by the caller.
+    """
+    B, S, n_kv, hd = k.shape
+    n_heads = q.shape[2]
+    grp = n_heads // n_kv
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    pos = context_lens[:, None].astype(jnp.int32) + \
+        jnp.arange(S, dtype=jnp.int32)[None, :]                 # [B, S]
+    valid = jnp.arange(S, dtype=jnp.int32)[None, :] < \
+        new_lens[:, None].astype(jnp.int32)
+    k_pool = write_to_pool(k_pool, k, block_tables, pos, valid)
+    v_pool = write_to_pool(v_pool, v, block_tables, pos, valid)
+    keys = gather_pool(k_pool, block_tables)                    # [B, L, ...]
+    vals = gather_pool(v_pool, block_tables)
+    L = keys.shape[1]
+    qg = q.reshape(B, S, n_kv, grp, hd)
+    s = jnp.einsum("bskgh,blkh->bskgl", qg.astype(jnp.float32),
+                   keys.astype(jnp.float32)) * scale
+    visible = jnp.arange(L)[None, None, :] <= pos[:, :, None]   # [B, S, L]
+    s = jnp.where(visible[:, :, None, None, :], s,
+                  jnp.finfo(jnp.float32).min)
+    w = jax.nn.softmax(s, axis=-1).astype(vals.dtype)
+    out = jnp.einsum("bskgl,blkh->bskgh", w, vals)
+    return out.reshape(B, S, n_heads * hd), k_pool, v_pool

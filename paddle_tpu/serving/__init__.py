@@ -1,0 +1,32 @@
+"""paddle_tpu.serving — continuous-batching LLM inference runtime.
+
+The request-level serving subsystem (docs/SERVING.md) above the model
+zoo's ``generate`` surface and below an HTTP front-end:
+
+- **kv_cache** — block-paged KV-cache manager: fixed-size token blocks,
+  per-sequence block tables, refcounted alloc/free, per-layer device
+  pools threaded functionally through the compiled step.
+- **scheduler** — FCFS continuous batching: chunked-prefill/decode
+  interleaving, slot swapping between steps, preemption-by-recompute
+  when the block pool runs dry.
+- **engine** — :class:`ServingEngine`: ONE compiled prefill executable +
+  ONE compiled decode executable over a fixed batch-slot layout,
+  streaming token callbacks, drain/graceful shutdown, serving_*
+  metrics through ``observability.metrics``.
+- **server** — stdlib HTTP front-end: ``POST /generate`` (optionally
+  chunked streaming), ``GET /healthz``, ``GET /metrics[.json]``.
+
+The attention read path is the gather-based paged attention in
+``ops/paged_attention.py`` — the seam a Ragged-Paged-Attention Pallas
+kernel (PAPERS.md, arxiv 2604.15464) later replaces without touching
+this layer.
+"""
+from . import engine, kv_cache, scheduler, server  # noqa: F401
+from .engine import RequestHandle, ServingEngine  # noqa: F401
+from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .scheduler import Request, RequestState, Scheduler  # noqa: F401
+from .server import Server  # noqa: F401
+
+__all__ = ["ServingEngine", "RequestHandle", "Server", "Scheduler",
+           "Request", "RequestState", "PagedKVCache", "BlockAllocator",
+           "engine", "kv_cache", "scheduler", "server"]

@@ -1,0 +1,484 @@
+"""Continuous-batching serving engine over a block-paged KV cache.
+
+``ServingEngine`` is the request-level runtime between the model zoo's
+``generate`` surface and an HTTP front-end (``serving.server``). Where
+``compiled_generate`` runs one fixed batch to completion (a straggler
+stalls everyone, KV memory is worst-case), the engine keeps a FIXED
+``max_batch``-slot decode layout and swaps finished slots for queued
+requests between steps — so the decode step is compiled EXACTLY ONCE and
+requests enter/leave the batch continuously.
+
+Two executables, both traced a single time:
+
+* **prefill step** — ``[1, prefill_chunk]`` tokens of one sequence
+  (chunked prefill: long prompts advance one chunk per engine iteration,
+  interleaved with decode so they never starve running requests);
+* **decode step** — ``[max_batch, 1]`` tokens, one per active slot
+  (inactive slots run on the null block and their outputs are ignored).
+
+Both thread the per-layer block pools functionally (pools in → pools
+out), with per-row positions and block tables as traced inputs — no
+shape ever changes, so recompilation is structurally impossible; the
+``prefill_traces`` / ``decode_traces`` counters (incremented at trace
+time) make that checkable from tests.
+
+Telemetry goes through ``observability.metrics`` (queue depth,
+running/waiting gauges, TTFT and inter-token-latency histograms,
+token/preemption counters — names in docs/SERVING.md).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kv_cache import PagedKVCache
+from .scheduler import Request, RequestState, Scheduler
+
+__all__ = ["ServingEngine", "RequestHandle"]
+
+
+class RequestHandle:
+    """Caller-side view of a submitted request (thread-safe wait)."""
+
+    def __init__(self, req: Request):
+        self._req = req
+        self._done = threading.Event()
+
+    @property
+    def req_id(self) -> int:
+        return self._req.req_id
+
+    @property
+    def token_ids(self) -> List[int]:
+        return list(self._req.generated)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """Block until finished; raises on request failure/timeout."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self._req.req_id} not finished in {timeout}s")
+        r = self._req
+        if r.state is RequestState.FAILED:
+            raise RuntimeError(f"request {r.req_id} failed: {r.error}")
+        return {
+            "request_id": r.req_id,
+            "token_ids": list(r.generated),
+            "num_generated": len(r.generated),
+            "prompt_len": len(r.prompt_tokens),
+            "finish_reason": r.finish_reason,
+            "preemptions": r.preemptions,
+            "ttft_s": r.ttft(),
+            "latency_s": r.latency(),
+        }
+
+
+class ServingEngine:
+    """Continuous-batching inference over any zoo causal LM that speaks
+    the ``caches=`` protocol (Llama, MoE — the ``compiled_generate``
+    family seam)."""
+
+    def __init__(self, model, max_batch: int = 8, max_blocks: int = 64,
+                 block_size: int = 16, prefill_chunk: int = 16,
+                 max_blocks_per_seq: Optional[int] = None):
+        from paddle_tpu.jit.functional import functional_state
+        from paddle_tpu.models.generation import decode_surfaces
+
+        model.eval()
+        self.model = model
+        cfg = model.cfg
+        train, frozen, buffers = functional_state(model)
+        self._st = {**train, **frozen, **buffers}
+        self._backbone, self._project, dtype = decode_surfaces(
+            model, self._st)
+
+        nl = cfg.num_hidden_layers
+        n_kv = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        # position cap = the attention layers' RoPE table length.
+        # MoeConfig carries no cap of its own — its attention blocks are
+        # built from _attn_cfg(), so read the cap from there (falling
+        # back to pool capacity only if a family defines neither)
+        max_pos = getattr(cfg, "max_position_embeddings", None)
+        if max_pos is None and hasattr(cfg, "_attn_cfg"):
+            max_pos = cfg._attn_cfg().max_position_embeddings
+        if max_pos is None:
+            max_pos = max_blocks * block_size
+        if max_blocks_per_seq is None:
+            max_blocks_per_seq = min(max_blocks, -(-max_pos // block_size))
+        self.cache = PagedKVCache(nl, max_blocks, block_size, n_kv, hd,
+                                  max_blocks_per_seq, dtype)
+        self.max_model_len = min(self.cache.max_seq_len, max_pos)
+        self.max_batch = int(max_batch)
+        self.prefill_chunk = int(prefill_chunk)
+        self.scheduler = Scheduler(self.cache, self.max_batch,
+                                   self.prefill_chunk)
+
+        #: executable-compilation counters — incremented at TRACE time,
+        #: so each equals the number of compiles of that step
+        self.prefill_traces = 0
+        self.decode_traces = 0
+        self._prefill_step, self._decode_step = self._build_steps()
+
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._shutdown = False
+        self._handles = {}  # req_id -> RequestHandle
+        self._published_preemptions = 0
+        self._init_metrics()
+
+    # -- compiled steps ----------------------------------------------------
+    def _build_steps(self):
+        from paddle_tpu.core.autograd import no_grad
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.jit.functional import swap_state
+        from paddle_tpu.ops.paged_attention import PagedLayerCache
+
+        model, backbone, project = self.model, self._backbone, self._project
+        nl = self.model.cfg.num_hidden_layers
+
+        def make(counter_name):
+            def step(stt, tokens, k_pools, v_pools, bt, ctx, nlen):
+                # executes at trace time only — counts compiles
+                setattr(self, counter_name,
+                        getattr(self, counter_name) + 1)
+                caches = [PagedLayerCache(Tensor(k_pools[i]),
+                                          Tensor(v_pools[i]), Tensor(bt),
+                                          Tensor(ctx), Tensor(nlen))
+                          for i in range(nl)]
+                with no_grad(), swap_state(model, stt,
+                                           collect_buffers=False):
+                    h, new_caches = backbone(Tensor(tokens), caches=caches)
+                    if tokens.shape[1] > 1:
+                        # prefill (B=1): logits at the last VALID position
+                        idx = jnp.clip(nlen[0].astype(jnp.int32) - 1, 0,
+                                       tokens.shape[1] - 1)
+                        h = Tensor(jax.lax.dynamic_slice_in_dim(
+                            h.data, idx, 1, axis=1))
+                    logits = project(h)            # [B, 1, V]
+                kps = tuple(c.k_pool.data for c in new_caches)
+                vps = tuple(c.v_pool.data for c in new_caches)
+                return logits.data[:, 0].astype(jnp.float32), kps, vps
+            return step
+
+        # donating the pools lets XLA update them in place on TPU; the
+        # CPU backend can't honor donation (harmless warning), so gate it
+        donate = (2, 3) if jax.default_backend() == "tpu" else ()
+        return (jax.jit(make("prefill_traces"), donate_argnums=donate),
+                jax.jit(make("decode_traces"), donate_argnums=donate))
+
+    # -- metrics -----------------------------------------------------------
+    def _init_metrics(self):
+        from paddle_tpu.observability import get_registry
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "serving_requests_total", "requests by final outcome")
+        self._m_queue = reg.gauge(
+            "serving_queue_depth", "requests waiting for a batch slot")
+        self._m_running = reg.gauge(
+            "serving_requests_running", "requests holding a batch slot")
+        self._m_waiting = reg.gauge(
+            "serving_requests_waiting", "requests queued (incl. preempted)")
+        self._m_ttft = reg.histogram(
+            "serving_ttft_seconds", "submit -> first generated token")
+        self._m_itl = reg.histogram(
+            "serving_inter_token_seconds", "gap between streamed tokens")
+        self._m_latency = reg.histogram(
+            "serving_request_latency_seconds", "submit -> request finished")
+        self._m_tokens = reg.counter(
+            "serving_tokens_total",
+            "tokens processed, by kind (prompt incl. recompute/generated)")
+        self._m_preempt = reg.counter(
+            "serving_preemptions_total", "sequences preempted (recompute)")
+        self._m_steps = reg.counter(
+            "serving_engine_steps_total", "compiled steps run, by kind")
+        self.cache.gauge_in_use()
+
+    def _update_gauges(self):
+        # queue depth = never-started arrivals; waiting also counts
+        # preempted sequences awaiting readmission
+        fresh = sum(1 for r in self.scheduler.waiting
+                    if r.preemptions == 0)
+        self._m_queue.set(fresh)
+        self._m_waiting.set(self.scheduler.num_waiting)
+        self._m_running.set(self.scheduler.num_running)
+        self.cache.gauge_in_use()
+        # preemptions happen inside the scheduler; publish the delta
+        # against a PER-ENGINE cursor (the registry counter is process-
+        # global and may aggregate several engines)
+        new = self.scheduler.num_preemptions - self._published_preemptions
+        if new > 0:
+            self._m_preempt.inc(new)
+            self._published_preemptions += new
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               eos_token_id: Optional[int] = None,
+               on_token: Optional[Callable] = None) -> RequestHandle:
+        """Enqueue a request; returns immediately with a handle. Tokens
+        stream through ``on_token(request, token_id)`` as they decode."""
+        prompt_tokens = list(prompt_tokens)
+        if not prompt_tokens:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(prompt_tokens) + max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt+max_new_tokens = {total} exceeds the engine's "
+                f"max sequence length {self.max_model_len}")
+        need = self.cache.blocks_for(total)
+        if need > min(self.cache.allocator.capacity,
+                      self.cache.max_blocks_per_seq):
+            raise ValueError(
+                f"request needs {need} KV blocks but the engine has "
+                f"{self.cache.allocator.capacity} (table width "
+                f"{self.cache.max_blocks_per_seq}) — raise max_blocks or "
+                "shorten the request")
+        req = Request(prompt_tokens=prompt_tokens,
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      top_p=float(top_p), eos_token_id=eos_token_id,
+                      on_token=on_token)
+        handle = RequestHandle(req)
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("engine is shut down")
+            self._handles[req.req_id] = handle
+            self.scheduler.add(req)
+            self._m_requests.inc(outcome="accepted")
+            self._update_gauges()
+            self._cv.notify_all()
+        return handle
+
+    # -- one engine iteration ----------------------------------------------
+    def step(self) -> bool:
+        """Plan + run one prefill chunk and/or one decode step. Returns
+        whether any work happened."""
+        with self._lock:
+            plan = self.scheduler.schedule()
+            # belt-and-braces against plan staleness: never act on a
+            # sequence that lost its slot during planning
+            if plan.prefill is not None:
+                seq, n = plan.prefill
+                if (seq.slot is not None
+                        and seq.state is RequestState.PREFILL):
+                    self._run_prefill(seq, n)
+                else:
+                    plan.prefill = None
+            live = [s for s in plan.decode
+                    if s.slot is not None
+                    and s.state is RequestState.RUNNING]
+            if live:
+                self._run_decode(live)
+            self._update_gauges()
+            return plan.prefill is not None or bool(live)
+
+    def _run_prefill(self, seq: Request, n_new: int):
+        C = self.prefill_chunk
+        tokens = np.zeros((1, C), np.int32)
+        tokens[0, :n_new] = seq.pending_tokens[
+            seq.prefill_pos:seq.prefill_pos + n_new]
+        bt = self.cache.pad_block_table(seq.block_ids)[None, :]
+        ctx = np.array([seq.prefill_pos], np.int32)
+        nlen = np.array([n_new], np.int32)
+        logits, kps, vps = self._prefill_step(
+            self._st, jnp.asarray(tokens), self.cache.k_pools,
+            self.cache.v_pools, jnp.asarray(bt), jnp.asarray(ctx),
+            jnp.asarray(nlen))
+        self.cache.update_pools(kps, vps)
+        self._clear_model_side_effects()
+        seq.prefill_pos += n_new
+        seq.num_cached += n_new
+        self._m_tokens.inc(n_new, kind="prompt")
+        self._m_steps.inc(kind="prefill")
+        if seq.prefill_pos == len(seq.pending_tokens):
+            # prompt fully cached: sample the continuation (this is the
+            # request's first token — or, after a preemption, the next)
+            tok = self._sample(np.asarray(logits)[0], seq)
+            seq.state = RequestState.RUNNING
+            self._emit_token(seq, tok)
+
+    def _run_decode(self, seqs: List[Request]):
+        B = self.max_batch
+        tokens = np.zeros((B, 1), np.int32)
+        bt = np.zeros((B, self.cache.max_blocks_per_seq), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        nlen = np.zeros((B,), np.int32)
+        for seq in seqs:
+            s = seq.slot
+            tokens[s, 0] = seq.last_token()
+            bt[s] = self.cache.pad_block_table(seq.block_ids)
+            ctx[s] = seq.num_cached
+            nlen[s] = 1
+        logits, kps, vps = self._decode_step(
+            self._st, jnp.asarray(tokens), self.cache.k_pools,
+            self.cache.v_pools, jnp.asarray(bt), jnp.asarray(ctx),
+            jnp.asarray(nlen))
+        self.cache.update_pools(kps, vps)
+        self._clear_model_side_effects()
+        self._m_steps.inc(kind="decode")
+        arr = np.asarray(logits)
+        for seq in seqs:
+            seq.num_cached += 1
+            tok = self._sample(arr[seq.slot], seq)
+            self._emit_token(seq, tok)
+
+    def _sample(self, logits_row: np.ndarray, seq: Request) -> int:
+        if seq.temperature == 0:
+            return int(np.argmax(logits_row))
+        from paddle_tpu.models.generation import sample_token
+        tok = sample_token(jnp.asarray(logits_row)[None, :],
+                           seq.temperature, seq.top_k, seq.top_p)
+        return int(np.asarray(tok)[0])
+
+    def _emit_token(self, seq: Request, tok: int):
+        now = time.perf_counter()
+        if seq.first_token_time is None:
+            seq.first_token_time = now
+            self._m_ttft.observe(now - seq.arrival_time)
+        elif seq.last_token_time is not None:
+            self._m_itl.observe(now - seq.last_token_time)
+        seq.last_token_time = now
+        seq.generated.append(int(tok))
+        self._m_tokens.inc(kind="generated")
+        if seq.on_token is not None:
+            try:
+                seq.on_token(seq, int(tok))
+            except Exception:
+                pass  # a broken stream consumer must not kill the batch
+        if seq.eos_token_id is not None and tok == seq.eos_token_id:
+            self._finish(seq, "eos")
+        elif len(seq.generated) >= seq.max_new_tokens:
+            self._finish(seq, "length")
+
+    def _finish(self, seq: Request, reason: str,
+                state: RequestState = RequestState.FINISHED):
+        self.scheduler.finish(seq, state, reason)
+        self._m_requests.inc(
+            outcome="completed" if state is RequestState.FINISHED
+            else "failed")
+        if seq.latency() is not None:
+            self._m_latency.observe(seq.latency())
+        handle = self._handles.pop(seq.req_id, None)
+        if handle is not None:
+            handle._done.set()
+        with self._cv:
+            self._cv.notify_all()
+
+    def _clear_model_side_effects(self):
+        """MoE gates stash ``l_aux`` during traced forwards; drop it so a
+        later ``aux_loss()`` can't touch an escaped tracer."""
+        clear = getattr(self.model, "clear_decode_side_effects", None)
+        if clear is not None:
+            clear()
+
+    # -- run loop ----------------------------------------------------------
+    def has_pending(self) -> bool:
+        with self._lock:
+            return self.scheduler.has_work()
+
+    def run_until_idle(self):
+        """Synchronous driver (tests / batch jobs): step until every
+        submitted request has finished."""
+        while True:
+            did = self.step()
+            if not did and not self.has_pending():
+                return
+            if not did:
+                raise RuntimeError(
+                    "engine stalled with pending work — KV pool "
+                    "undersized for the admitted requests")
+
+    def start(self):
+        """Background step loop (the server front-end's mode)."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._shutdown = False
+            self._thread = threading.Thread(
+                target=self._run_loop, name="pt-serving-engine",
+                daemon=True)
+            self._thread.start()
+
+    def _run_loop(self):
+        while True:
+            with self._cv:
+                if self._shutdown and not self.scheduler.has_work():
+                    return
+                if not self.scheduler.has_work():
+                    self._cv.wait(timeout=0.1)
+                    continue
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — loop must not die silently
+                # a step failure (OOM, scheduling bug) would otherwise
+                # strand every pending handle forever: fail them all
+                # loudly and stop the loop
+                with self._cv:
+                    for seq in (list(self.scheduler.slotted())
+                                + list(self.scheduler.waiting)):
+                        seq.error = f"engine step failed: {e!r}"
+                        self._finish(seq, "error", RequestState.FAILED)
+                    self.scheduler.waiting.clear()
+                    self._shutdown = True
+                    self._cv.notify_all()
+                raise
+
+    def drain(self, timeout: Optional[float] = None):
+        """Block until every accepted request has finished."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        while self.has_pending():
+            if self._thread is None:
+                self.run_until_idle()
+                break
+            if deadline is not None and time.perf_counter() > deadline:
+                raise TimeoutError("engine drain timed out")
+            with self._cv:
+                if self.scheduler.has_work():
+                    self._cv.wait(timeout=0.1)
+
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
+        """Graceful stop: optionally finish in-flight work, then stop the
+        loop thread. New submissions are rejected once shut down."""
+        if drain:
+            self.drain(timeout)
+        with self._cv:
+            self._shutdown = True
+            if not drain:
+                for seq in (list(self.scheduler.slotted())
+                            + list(self.scheduler.waiting)):
+                    seq.error = "engine shut down"
+                    self._finish(seq, "aborted", RequestState.FAILED)
+                self.scheduler.waiting.clear()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        """Lock-free snapshot (every field below is individually
+        synchronized): /healthz must answer even while a step holds the
+        engine lock through a first-time XLA compile."""
+        return {
+            "running": self.scheduler.num_running,
+            "waiting": self.scheduler.num_waiting,
+            "kv_blocks_in_use": self.cache.allocator.blocks_in_use(),
+            "kv_blocks_free": self.cache.allocator.num_free(),
+            "preemptions": self.scheduler.num_preemptions,
+            "prefill_compiles": self.prefill_traces,
+            "decode_compiles": self.decode_traces,
+            "max_batch": self.max_batch,
+            "max_model_len": self.max_model_len,
+            "block_size": self.cache.block_size,
+        }

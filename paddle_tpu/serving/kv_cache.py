@@ -1,0 +1,166 @@
+"""Block-paged KV-cache manager for the serving engine.
+
+Two halves:
+
+* :class:`BlockAllocator` — host-side accounting over a fixed pool of
+  ``num_blocks`` token blocks: a free list, per-block refcounts
+  (refcounting keeps the door open for prefix sharing / request forks —
+  a shared block is freed only when its last holder drops it), and leak
+  assertions. Physical **block 0 is reserved as the null block** (see
+  ``ops/paged_attention.py``) and is never handed out.
+
+* :class:`PagedKVCache` — the device state: one ``[num_blocks + 1,
+  block_size, n_kv, hd]`` K pool and V pool per layer (the +1 row is
+  the null block at physical index 0), threaded
+  functionally through the engine's compiled step (the jitted function
+  takes the pools as inputs and returns the updated ones — nothing is
+  mutated in place, so the executable never recompiles), plus the
+  allocator and the block-table padding helper.
+
+Sizing math (docs/SERVING.md): a request of total length ``T`` (prompt +
+generated) holds ``ceil(T / block_size)`` blocks, so worst-case pool
+demand for ``B`` concurrent requests of max total length ``T_max`` is
+``B * ceil(T_max / block_size)`` blocks; internal fragmentation is at
+most ``block_size - 1`` tokens per sequence instead of the
+``T_max - T`` of a contiguous worst-case layout.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BlockAllocator", "PagedKVCache"]
+
+#: physical block id reserved as the write-off target for padding
+NULL_BLOCK = 0
+
+
+class BlockAllocator:
+    """Refcounted free-list allocator over block ids ``1..num_blocks``."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError("need at least one allocatable block")
+        self.num_blocks = num_blocks
+        self._lock = threading.Lock()
+        # ids 1..num_blocks (0 is the null block); popped from the end
+        self._free: List[int] = list(range(num_blocks, 0, -1))
+        self._refcount: Dict[int, int] = {}
+
+    @property
+    def capacity(self) -> int:
+        return self.num_blocks
+
+    def num_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return len(self._refcount)
+
+    def can_allocate(self, n: int) -> bool:
+        with self._lock:
+            return len(self._free) >= n
+
+    def allocate(self, n: int = 1) -> List[int]:
+        """``n`` fresh blocks at refcount 1; raises ``MemoryError`` when
+        the pool can't cover the request (callers preempt on that)."""
+        with self._lock:
+            if len(self._free) < n:
+                raise MemoryError(
+                    f"KV block pool exhausted: need {n}, "
+                    f"free {len(self._free)}/{self.num_blocks}")
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._refcount[b] = 1
+            return out
+
+    def incref(self, block_id: int):
+        with self._lock:
+            if block_id not in self._refcount:
+                raise ValueError(f"block {block_id} is not allocated")
+            self._refcount[block_id] += 1
+
+    def free(self, block_ids: Sequence[int]):
+        """Drop one reference per id; blocks return to the pool at 0."""
+        with self._lock:
+            for b in block_ids:
+                rc = self._refcount.get(b)
+                if rc is None:
+                    raise ValueError(f"double free of block {b}")
+                if rc == 1:
+                    del self._refcount[b]
+                    self._free.append(b)
+                else:
+                    self._refcount[b] = rc - 1
+
+    def refcount(self, block_id: int) -> int:
+        with self._lock:
+            return self._refcount.get(block_id, 0)
+
+    def assert_no_leaks(self):
+        """Every block is back in the pool (end-of-drain invariant)."""
+        with self._lock:
+            leaked = sorted(self._refcount)
+            if leaked:
+                raise AssertionError(
+                    f"{len(leaked)} KV blocks leaked: {leaked[:16]}")
+
+
+class PagedKVCache:
+    """Per-layer block pools + the allocator + table-shaping helpers."""
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 num_kv_heads: int, head_dim: int,
+                 max_blocks_per_seq: Optional[int] = None,
+                 dtype=jnp.float32):
+        if block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq or num_blocks
+        self.allocator = BlockAllocator(num_blocks)
+        # +1: physical block 0 is the null block and backs no sequence
+        shape = (num_blocks + 1, block_size, num_kv_heads, head_dim)
+        self.k_pools = tuple(jnp.zeros(shape, dtype)
+                             for _ in range(num_layers))
+        self.v_pools = tuple(jnp.zeros(shape, dtype)
+                             for _ in range(num_layers))
+
+    @property
+    def max_seq_len(self) -> int:
+        """Longest sequence one block table can address."""
+        return self.max_blocks_per_seq * self.block_size
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)  # ceil div
+
+    def update_pools(self, k_pools, v_pools):
+        """Swap in the pools returned by a compiled step (functional
+        threading: the old arrays are dropped, nothing recompiles)."""
+        self.k_pools = tuple(k_pools)
+        self.v_pools = tuple(v_pools)
+
+    def pad_block_table(self, block_ids: Sequence[int]) -> np.ndarray:
+        """[max_blocks_per_seq] int32 row, null-padded."""
+        if len(block_ids) > self.max_blocks_per_seq:
+            raise ValueError(
+                f"sequence holds {len(block_ids)} blocks > table width "
+                f"{self.max_blocks_per_seq}")
+        row = np.full((self.max_blocks_per_seq,), NULL_BLOCK, np.int32)
+        row[:len(block_ids)] = block_ids
+        return row
+
+    def gauge_in_use(self):
+        """Publish pool occupancy through the observability registry."""
+        from paddle_tpu.observability import get_registry
+        g = get_registry().gauge(
+            "serving_kv_blocks_in_use",
+            "KV-cache blocks currently held by live sequences")
+        g.set(self.allocator.blocks_in_use())
+        return g

@@ -1,0 +1,270 @@
+"""Continuous-batching scheduler: FCFS admission, prefill/decode
+interleaving, preemption-by-recompute.
+
+The scheduler owns request queues and KV-block accounting; the engine
+owns the compiled steps. Each engine iteration asks for a
+:class:`StepPlan`, which names at most ONE prefill chunk (chunked
+prefill: a long prompt advances ``prefill_chunk`` tokens per iteration
+so it can never starve running decoders) plus the set of running
+sequences to decode this step. Slots are the engine's fixed batch
+positions — a finished request's slot is handed to the next waiting
+request between steps, which is what keeps the decode executable's
+shapes (and therefore its compilation) constant.
+
+When the block pool can't cover a needed allocation, the sequence with
+the LATEST arrival is preempted (vLLM's recompute policy, protecting
+FCFS order): its blocks are freed, and it re-enters the waiting queue
+with ``prompt + generated-so-far`` as its new prefill text. On
+readmission the recompute-prefill rebuilds its KV state and the sampled
+continuation picks up exactly where it left off — under greedy decoding
+the final output is identical to the unpreempted run.
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from .kv_cache import PagedKVCache
+
+__all__ = ["RequestState", "Request", "StepPlan", "Scheduler"]
+
+_req_counter = itertools.count()
+
+
+class RequestState(Enum):
+    WAITING = "waiting"    # queued (fresh or preempted), no slot
+    PREFILL = "prefill"    # slot assigned, prompt not fully cached
+    RUNNING = "running"    # decoding one token per engine step
+    FINISHED = "finished"
+    FAILED = "failed"
+
+
+@dataclass
+class Request:
+    """One generation request plus its runtime sequence state."""
+
+    prompt_tokens: List[int]
+    max_new_tokens: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    #: per-token streaming callback ``(request, token_id) -> None``
+    on_token: Optional[Callable] = None
+    req_id: int = field(default_factory=lambda: next(_req_counter))
+    arrival_time: float = field(default_factory=time.perf_counter)
+
+    # -- runtime state (engine/scheduler managed) --------------------------
+    state: RequestState = RequestState.WAITING
+    slot: Optional[int] = None
+    block_ids: List[int] = field(default_factory=list)
+    #: tokens to (re)prefill — the prompt, or prompt+generated after a
+    #: preemption (recompute)
+    pending_tokens: List[int] = field(default=None)
+    prefill_pos: int = 0     # pending tokens already cached
+    num_cached: int = 0      # total tokens written to the KV cache
+    generated: List[int] = field(default_factory=list)
+    first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    preemptions: int = 0
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+
+    def __post_init__(self):
+        self.prompt_tokens = [int(t) for t in self.prompt_tokens]
+        if self.pending_tokens is None:
+            self.pending_tokens = list(self.prompt_tokens)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.FAILED)
+
+    def last_token(self) -> int:
+        """The decode-step input: the newest sampled, not-yet-cached
+        token (prefill completion always samples one before decoding)."""
+        return self.generated[-1]
+
+    def ttft(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    def latency(self) -> Optional[float]:
+        if self.finish_time is None:
+            return None
+        return self.finish_time - self.arrival_time
+
+
+@dataclass
+class StepPlan:
+    #: (sequence, number of prompt tokens to prefill this step)
+    prefill: Optional[Tuple[Request, int]] = None
+    #: running sequences to advance one decode token
+    decode: List[Request] = field(default_factory=list)
+
+    @property
+    def empty(self) -> bool:
+        return self.prefill is None and not self.decode
+
+
+class Scheduler:
+    """FCFS continuous-batching policy over ``max_batch`` engine slots."""
+
+    def __init__(self, cache: PagedKVCache, max_batch: int,
+                 prefill_chunk: int):
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.cache = cache
+        self.max_batch = max_batch
+        self.prefill_chunk = prefill_chunk
+        self.waiting: List[Request] = []   # sorted by arrival_time
+        self.slots: List[Optional[Request]] = [None] * max_batch
+        self.num_preemptions = 0
+
+    # -- queue state -------------------------------------------------------
+    def slotted(self) -> List[Request]:
+        return [s for s in self.slots if s is not None]
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.slotted())
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.slotted())
+
+    def add(self, req: Request):
+        """FCFS enqueue (kept sorted by arrival so a preempted earlier
+        request resumes ahead of later arrivals)."""
+        bisect.insort(self.waiting, req, key=lambda r: r.arrival_time)
+
+    # -- planning ----------------------------------------------------------
+    def schedule(self) -> StepPlan:
+        """Admit, pick one prefill chunk, and collect the decode batch
+        (preempting by recompute where the block pool falls short). The
+        planned prefill sequence is PROTECTED from decode-side
+        preemption for this plan — otherwise a decode allocation could
+        evict the very sequence the same plan tells the engine to
+        prefill, and the engine would write its chunk through an
+        all-null block table (silently corrupting the recompute)."""
+        self._admit()
+        plan = StepPlan()
+        plan.prefill = self._plan_prefill()
+        protect = plan.prefill[0] if plan.prefill else None
+        plan.decode = self._plan_decode(protect)
+        return plan
+
+    def _admit(self):
+        for i, s in enumerate(self.slots):
+            if s is not None or not self.waiting:
+                continue
+            req = self.waiting.pop(0)
+            req.slot = i
+            self.slots[i] = req
+            req.state = RequestState.PREFILL
+
+    def _plan_prefill(self) -> Optional[Tuple[Request, int]]:
+        cands = [s for s in self.slotted()
+                 if s.state is RequestState.PREFILL]
+        if not cands:
+            return None
+        seq = min(cands, key=lambda r: r.arrival_time)
+        n = min(self.prefill_chunk,
+                len(seq.pending_tokens) - seq.prefill_pos)
+        if not self._ensure_blocks(seq, seq.prefill_pos + n):
+            return None  # pool contended even after preemption; retry later
+        return (seq, n)
+
+    def _plan_decode(self, protect: Optional[Request] = None
+                     ) -> List[Request]:
+        batch = []
+        # earliest arrivals first: preemption victims come from the tail,
+        # so a seq preempted mid-planning is simply never reached
+        for seq in sorted(self.slotted(), key=lambda r: r.arrival_time):
+            if seq.state is not RequestState.RUNNING or seq.slot is None:
+                continue
+            if self._ensure_blocks(seq, seq.num_cached + 1,
+                                   protect=protect):
+                batch.append(seq)
+        return batch
+
+    # -- block management --------------------------------------------------
+    def _ensure_blocks(self, seq: Request, total_tokens: int,
+                       protect: Optional[Request] = None) -> bool:
+        """Grow ``seq``'s block table to cover ``total_tokens`` cached
+        positions, preempting latest-arrival sequences as needed.
+        ``protect`` (this plan's prefill target) is never evicted."""
+        alloc = self.cache.allocator
+        need = self.cache.blocks_for(total_tokens) - len(seq.block_ids)
+        if need <= 0:
+            return True
+        while not alloc.can_allocate(need):
+            victim = self._pick_victim(after=seq, protect=protect)
+            if victim is None:
+                holders = [s for s in self.slotted()
+                           if s is not seq and s.block_ids]
+                if (holders and seq.slot is not None and seq.block_ids
+                        and all(h.arrival_time < seq.arrival_time
+                                for h in holders)):
+                    # only FCFS-senior sequences hold the pool: hand our
+                    # blocks back so the head can finish sooner
+                    self.preempt(seq)
+                # else: a protected (or senior) holder will become
+                # evictable/finish on a later step — just wait
+                return False
+            self.preempt(victim)
+        seq.block_ids.extend(alloc.allocate(need))
+        return True
+
+    def _pick_victim(self, after: Request,
+                     protect: Optional[Request] = None
+                     ) -> Optional[Request]:
+        """Latest-arrival slotted sequence strictly younger than
+        ``after`` — preemption never evicts an earlier (FCFS-senior)
+        request, which also guarantees a decode batch member planned this
+        step can't be yanked out from under the plan; ``protect`` is
+        excluded outright."""
+        cands = [s for s in self.slotted()
+                 if s is not after and s is not protect and s.block_ids
+                 and s.arrival_time > after.arrival_time]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: r.arrival_time)
+
+    def preempt(self, seq: Request):
+        """Preemption-by-recompute: free every block, requeue with
+        prompt+generated as the new prefill text. Greedy decoding makes
+        the resumed continuation token-identical."""
+        self.cache.allocator.free(seq.block_ids)
+        seq.block_ids = []
+        self.release_slot(seq)
+        seq.pending_tokens = list(seq.prompt_tokens) + list(seq.generated)
+        seq.prefill_pos = 0
+        seq.num_cached = 0
+        seq.state = RequestState.WAITING
+        seq.preemptions += 1
+        self.num_preemptions += 1
+        self.add(seq)
+
+    def release_slot(self, seq: Request):
+        if seq.slot is not None:
+            self.slots[seq.slot] = None
+            seq.slot = None
+
+    def finish(self, seq: Request, state: RequestState,
+               reason: str = "stop"):
+        """Return every resource; the engine records metrics/callbacks."""
+        self.cache.allocator.free(seq.block_ids)
+        seq.block_ids = []
+        self.release_slot(seq)
+        seq.state = state
+        seq.finish_reason = reason
+        seq.finish_time = time.perf_counter()

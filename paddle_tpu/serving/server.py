@@ -1,0 +1,241 @@
+"""HTTP front-end for :class:`~paddle_tpu.serving.ServingEngine`.
+
+Stdlib-only (``http.server`` on daemon threads, mirroring
+``observability.metrics.MetricsExporter``). Endpoints:
+
+* ``POST /generate`` — JSON in, tokens out. Request body::
+
+      {"prompt_ids": [1, 2, 3],          # required, token ids
+       "max_new_tokens": 32,             # optional sampling params
+       "temperature": 0.0, "top_k": 0, "top_p": 1.0,
+       "eos_token_id": null,
+       "stream": false}
+
+  Non-streaming responses return one JSON object with ``token_ids``,
+  ``ttft_ms``, ``latency_ms``, ``finish_reason``. With ``"stream":
+  true`` the response is chunked ``application/x-ndjson``: one
+  ``{"token": id}`` line per generated token as it decodes, then a
+  final summary line ``{"done": true, ...}``.
+
+* ``GET /healthz`` — liveness + queue/batch occupancy.
+* ``GET /metrics`` / ``GET /metrics.json`` — the observability
+  registry's Prometheus-text / JSON expositions (serving_* families
+  included; see docs/SERVING.md).
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Optional
+
+__all__ = ["Server"]
+
+
+class Server:
+    """Owns the engine's background loop and an HTTP listener.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``).
+    ``close()`` drains the engine and stops both threads.
+    """
+
+    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
+                 request_timeout: float = 300.0):
+        import http.server
+
+        self.engine = engine
+        self.request_timeout = request_timeout
+        server_ref = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass  # keep pytest/example output quiet
+
+            # -- helpers ---------------------------------------------------
+            def _json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_body(self) -> Optional[dict]:
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    return json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return None
+
+            # -- routes ----------------------------------------------------
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                from paddle_tpu.observability import get_registry
+                if self.path.startswith("/healthz"):
+                    stats = server_ref.engine.stats()
+                    self._json(200, {"status": "ok", **stats})
+                elif self.path.startswith("/metrics.json"):
+                    self._json(200, get_registry().to_json())
+                elif self.path.startswith("/metrics"):
+                    body = get_registry().prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def handle_one_request(self):
+                # client disconnects (timeout, ctrl-C, LB retry) are
+                # routine, not errors: swallow the broken pipe instead
+                # of letting socketserver dump a traceback per drop.
+                # NOTE: the engine still decodes the abandoned request
+                # to completion — there is no cancellation path yet.
+                try:
+                    super().handle_one_request()
+                except (BrokenPipeError, ConnectionResetError):
+                    self.close_connection = True
+
+            def do_POST(self):  # noqa: N802 (stdlib API)
+                if not self.path.startswith("/generate"):
+                    self._json(404, {"error": "not found"})
+                    return
+                body = self._read_body()
+                if not isinstance(body, dict) or not isinstance(
+                        body.get("prompt_ids"), list):
+                    self._json(400, {"error": "body must be a JSON "
+                                     "object with prompt_ids"})
+                    return
+                stream = bool(body.get("stream", False))
+                tokens_q = queue.Queue() if stream else None
+
+                def on_token(req, tok):
+                    if tokens_q is not None:
+                        tokens_q.put(tok)
+
+                try:
+                    handle = server_ref.engine.submit(
+                        body["prompt_ids"],
+                        max_new_tokens=int(body.get("max_new_tokens", 32)),
+                        temperature=float(body.get("temperature", 0.0)),
+                        top_k=int(body.get("top_k", 0)),
+                        top_p=float(body.get("top_p", 1.0)),
+                        eos_token_id=body.get("eos_token_id"),
+                        on_token=on_token if stream else None)
+                except (ValueError, TypeError, RuntimeError) as e:
+                    # TypeError: well-formed JSON, wrong field types
+                    # (e.g. "max_new_tokens": null) — still a 400
+                    self._json(400, {"error": str(e)})
+                    return
+                if stream:
+                    self._stream_response(handle, tokens_q)
+                else:
+                    self._sync_response(handle)
+
+            def _sync_response(self, handle):
+                try:
+                    res = handle.result(server_ref.request_timeout)
+                except TimeoutError:
+                    self._json(504, {"error": "request timed out"})
+                    return
+                except RuntimeError as e:
+                    self._json(500, {"error": str(e)})
+                    return
+                self._json(200, _result_json(res))
+
+            def _stream_response(self, handle, tokens_q):
+                import time as _time
+
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(obj):
+                    data = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+
+                # INACTIVITY deadline, reset on every token: a healthy
+                # long generation streams past request_timeout; only a
+                # stalled/dead engine goes silent that long
+                deadline = _time.monotonic() + server_ref.request_timeout
+                sent = 0
+                while True:
+                    if _time.monotonic() > deadline:
+                        chunk({"done": True,
+                               "error": "stream stalled: no token for "
+                               f"{server_ref.request_timeout}s"})
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
+                    try:
+                        tok = tokens_q.get(timeout=0.05)
+                        chunk({"token": int(tok)})
+                        sent += 1
+                        deadline = _time.monotonic() + \
+                            server_ref.request_timeout
+                        continue
+                    except queue.Empty:
+                        pass
+                    if handle.wait(0):
+                        # engine done: flush any stragglers, then summary
+                        while True:
+                            try:
+                                chunk({"token": int(tokens_q.get_nowait())})
+                                sent += 1
+                            except queue.Empty:
+                                break
+                        try:
+                            res = handle.result(0.1)
+                            chunk({"done": True, **_result_json(res)})
+                        except (TimeoutError, RuntimeError) as e:
+                            chunk({"done": True, "error": str(e)})
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pt-serving-http",
+            daemon=True)
+
+    def start(self) -> "Server":
+        self.engine.start()
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self, drain: bool = True, stop_engine: bool = True):
+        """Stop accepting, optionally finish in-flight work, stop the
+        HTTP listener (and, unless ``stop_engine=False``, the engine
+        loop — leave it running to rebind a new listener later)."""
+        if self._thread.is_alive():
+            # shutdown() blocks on serve_forever's ack — only safe when
+            # the listener loop actually ran (close() before start()
+            # must not hang)
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+        if stop_engine:
+            self.engine.shutdown(drain=drain)
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _result_json(res: dict) -> dict:
+    out = dict(res)
+    ttft, lat = out.pop("ttft_s", None), out.pop("latency_s", None)
+    out["ttft_ms"] = None if ttft is None else round(ttft * 1e3, 3)
+    out["latency_ms"] = None if lat is None else round(lat * 1e3, 3)
+    return out

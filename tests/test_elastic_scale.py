@@ -9,6 +9,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -24,6 +26,7 @@ def _wait_for(pattern, run_dir, n, timeout=60):
         f"{os.listdir(run_dir)}")
 
 
+@pytest.mark.slow  # multi-minute multiprocess elastic integration
 def test_kill_and_replace_worker(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -80,6 +83,7 @@ def test_kill_and_replace_worker(tmp_path):
             launcher.kill()
 
 
+@pytest.mark.slow  # multi-minute multiprocess elastic integration
 def test_multinode_scale_in_and_out(tmp_path):
     """VERDICT r3 item 7: two LAUNCHERS (one trainer each). Killing one
     node's worker exhausts that launcher's budget and its heartbeat goes

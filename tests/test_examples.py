@@ -7,8 +7,9 @@ import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # glob, not a hardcoded list: every future example joins the contract
+# (underscore-prefixed files are shared helpers, not demos)
 EXAMPLES = sorted(f for f in os.listdir(os.path.join(ROOT, "examples"))
-                  if f.endswith(".py"))
+                  if f.endswith(".py") and not f.startswith("_"))
 
 
 @pytest.mark.parametrize("script", EXAMPLES)
