@@ -449,6 +449,77 @@ def bench_serve():
     }
 
 
+def bench_ckpt():
+    """Checkpoint subsystem bench (--ckpt): save/restore GB/s through the
+    ``CheckpointManager`` and the step-loop STALL each save mode injects
+    (sync = snapshot + shard write + fsync + commit on the caller;
+    async = snapshot only, writing overlaps the next steps) — the number
+    the async writer exists to shrink. A fake train loop of fixed-work
+    steps measures the stall end to end; ``ckpt_blocking_seconds``
+    reports the same quantity from the metrics side. Results ride the
+    ``--emit-metrics`` JSON schema."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    import paddle_tpu as pt
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.checkpoint.writer import ckpt_metrics
+
+    mb = float(os.environ.get("BENCH_CKPT_MB", "256"))
+    n_tensors = 16
+    per = max(int(mb * 1e6 / 4 / n_tensors), 1)
+    rng = np.random.RandomState(0)
+    state = {f"layers.{i}.weight":
+             pt.to_tensor(rng.randn(per // 256 + 1, 256).astype(np.float32))
+             for i in range(n_tensors)}
+    nbytes = sum(int(np.prod(t.shape)) * 4 for t in state.values())
+
+    root = tempfile.mkdtemp(prefix="pt_ckpt_bench_")
+    out = {"state_mb": round(nbytes / 1e6, 1)}
+    try:
+        mgr = CheckpointManager(root, keep_last_k=2)
+
+        # -- raw save / restore bandwidth (sync, timed to commit) ---------
+        t0 = _time.perf_counter()
+        mgr.save(0, state, async_=False)
+        save_s = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        mgr.restore(0)
+        restore_s = _time.perf_counter() - t0
+        out["save_gbps"] = round(nbytes / save_s / 1e9, 3)
+        out["restore_gbps"] = round(nbytes / restore_s / 1e9, 3)
+
+        # -- step-loop stall: fixed-work steps, one save injected ---------
+        step_work_s = 0.01
+
+        def loop(step_offset, async_):
+            times = []
+            for i in range(8):
+                t0 = _time.perf_counter()
+                _time.sleep(step_work_s)  # the "train step"
+                if i == 2:
+                    fut = mgr.save(step_offset, state, async_=async_)
+                times.append(_time.perf_counter() - t0)
+            fut.wait(600)
+            return max(times) - step_work_s
+
+        sync_stall = loop(1, async_=False)
+        async_stall = loop(2, async_=True)
+        out["sync_stall_ms"] = round(sync_stall * 1e3, 2)
+        out["async_stall_ms"] = round(async_stall * 1e3, 2)
+        out["stall_ratio"] = round(sync_stall / max(async_stall, 1e-9), 1)
+        blocked = ckpt_metrics()["blocking_seconds"]
+        out["blocking_ms_sync_mean"] = round(
+            blocked.stats(mode="sync")["mean"] * 1e3, 2)
+        out["blocking_ms_async_mean"] = round(
+            blocked.stats(mode="async")["mean"] * 1e3, 2)
+        mgr.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def bench_eager():
     """Eager-dispatch overhead — SURVEY §7's #1 risk ('per-op eager
     dispatch is untenable'), finally measured (reference ships the
@@ -561,6 +632,13 @@ def main():
         print(json.dumps({"serve": serve}))
         if metrics_out:
             emit_metrics({"serve": serve}, metrics_out)
+        return
+
+    if "--ckpt" in sys.argv:
+        ckpt = bench_ckpt()
+        print(json.dumps({"ckpt": ckpt}))
+        if metrics_out:
+            emit_metrics({"ckpt": ckpt}, metrics_out)
         return
 
     on_tpu = jax.default_backend() == "tpu"

@@ -1,0 +1,31 @@
+"""paddle_tpu.checkpoint — distributed checkpointing subsystem.
+
+Async sharded save / verified restore with atomic commit and cross-mesh
+reshard (see docs/CHECKPOINT.md):
+
+- **layout** — step-directory format: per-tensor shard raw-bytes shard files +
+  ``index.json`` manifest (global shape, dtype, shard grid, per-shard
+  crc32) + pickled state skeleton; commit = ``COMMITTED`` marker +
+  ``.tmp`` → final directory rename.
+- **writer** — device→host snapshot off the critical path, background
+  shard streaming, fsync + atomic publish; ``ckpt_*`` metric families.
+- **reshard** — mesh-independent shard assembly and re-layout onto the
+  *current* mesh (``NamedSharding`` placement), so a run saved under one
+  dp/mp topology resumes under another.
+- **manager** — ``CheckpointManager``: ``save``/``restore``,
+  ``latest_step``/``all_steps``, keep-last-k GC, loud corruption fallback.
+"""
+from . import layout, manager, reshard, writer  # noqa: F401
+from .layout import (  # noqa: F401
+    CheckpointError, CheckpointIntegrityError, is_checkpoint_dir,
+    list_committed_steps,
+)
+from .manager import CheckpointManager, load_state_dir  # noqa: F401
+from .reshard import place_on_mesh, read_state  # noqa: F401
+from .writer import SaveFuture, snapshot  # noqa: F401
+
+__all__ = ["CheckpointManager", "load_state_dir", "read_state",
+           "place_on_mesh", "snapshot", "SaveFuture", "CheckpointError",
+           "CheckpointIntegrityError", "is_checkpoint_dir",
+           "list_committed_steps", "layout", "writer", "manager",
+           "reshard"]

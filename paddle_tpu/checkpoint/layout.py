@@ -1,0 +1,288 @@
+"""Checkpoint on-disk layout: step directories, shard planning, manifest.
+
+Orbax/TensorStore-flavored format (PAPERS.md "Fine-Tuning and Serving
+Gemma ... on Cloud TPU" names sharded async checkpointing as the substrate
+for preemption-tolerant training):
+
+```
+<root>/
+  step_12.tmp/          # in-flight save — never loadable
+  step_12/              # committed step
+    COMMITTED           # commit marker (written BEFORE the dir rename)
+    index.json          # manifest: name -> shape/dtype/grid/per-shard crc32
+    aux.pkl             # pickled state skeleton (non-array leaves +
+                        # _TensorRef placeholders; preserves namedtuples)
+    t0000_s000.bin ...  # one raw-bytes file per shard
+```
+
+A step is **committed** iff its directory does not end in ``.tmp`` AND the
+``COMMITTED`` marker exists. The writer renames ``step_N.tmp`` →
+``step_N`` as the last act, so a crash at any earlier point leaves only a
+``.tmp`` directory, which readers ignore and GC removes — a torn
+checkpoint is never loadable.
+
+Shards are rectangular blocks of the global array: the manifest records
+each shard's ``offset`` (start index per dim) and ``shape``, so assembly
+is mesh-independent — any reader pastes shards into a full array and
+re-lays it onto *its* mesh (reference auto_parallel Converter semantics:
+merge under the old dist attrs, re-slice under the new).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "FORMAT_VERSION", "INDEX_FILE", "COMMIT_MARKER", "AUX_FILE",
+    "TMP_SUFFIX", "STEP_PREFIX", "CheckpointError",
+    "CheckpointIntegrityError", "step_dir_name", "parse_step_dir",
+    "is_committed", "list_committed_steps", "plan_grid", "iter_shards",
+    "crc32_of", "flatten_state", "unflatten_state", "write_index",
+    "read_index", "is_checkpoint_dir", "poll_until",
+]
+
+FORMAT_VERSION = 1
+INDEX_FILE = "index.json"
+COMMIT_MARKER = "COMMITTED"
+AUX_FILE = "aux.pkl"
+TMP_SUFFIX = ".tmp"
+STEP_PREFIX = "step_"
+
+
+class CheckpointError(RuntimeError):
+    """Malformed/unusable checkpoint directory."""
+
+
+def poll_until(predicate: Callable[[], bool], what: str,
+               timeout: Optional[float] = None, interval: float = 0.005):
+    """The shared filesystem-barrier wait (commit markers, rank shard
+    lists, flat-save sidecars): poll ``predicate`` until true or until
+    ``timeout`` seconds elapsed (default from
+    ``PADDLE_TPU_CKPT_BARRIER_TIMEOUT``, 600 s), then raise
+    ``TimeoutError`` naming ``what`` never happened."""
+    if timeout is None:
+        timeout = float(os.environ.get("PADDLE_TPU_CKPT_BARRIER_TIMEOUT",
+                                       "600"))
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"timed out after {timeout}s waiting for {what}; "
+                f"no commit observed")
+        time.sleep(interval)
+
+
+class CheckpointIntegrityError(CheckpointError):
+    """Checksum mismatch or missing shard — the step is corrupt."""
+
+
+def step_dir_name(step: int) -> str:
+    return f"{STEP_PREFIX}{int(step)}"
+
+
+def parse_step_dir(name: str) -> Optional[int]:
+    """``step_12`` -> 12; anything else (incl. ``step_12.tmp``) -> None."""
+    if not name.startswith(STEP_PREFIX) or name.endswith(TMP_SUFFIX):
+        return None
+    try:
+        return int(name[len(STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+def is_committed(step_dir: str) -> bool:
+    return (not step_dir.rstrip(os.sep).endswith(TMP_SUFFIX)
+            and os.path.isfile(os.path.join(step_dir, COMMIT_MARKER))
+            and os.path.isfile(os.path.join(step_dir, INDEX_FILE)))
+
+
+def list_committed_steps(root: str) -> List[int]:
+    """Ascending committed step numbers under ``root``."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        s = parse_step_dir(name)
+        if s is not None and is_committed(os.path.join(root, name)):
+            steps.append(s)
+    return sorted(steps)
+
+
+def is_checkpoint_dir(path: str) -> bool:
+    """True for a manager root (has committed steps) or a single step dir."""
+    if not os.path.isdir(path):
+        return False
+    return bool(list_committed_steps(path)) or \
+        os.path.isfile(os.path.join(path, INDEX_FILE))
+
+
+# ---------------------------- shard planning --------------------------------
+
+def plan_grid(shape: Sequence[int], nshards: int) -> List[int]:
+    """Partition grid (parts per dim) for a tensor of ``shape`` across up
+    to ``nshards`` writers: shard the largest dim that divides evenly by
+    the largest feasible part count. Scalars / indivisible shapes get a
+    single shard — correctness never depends on shardability."""
+    grid = [1] * len(shape)
+    if nshards <= 1 or not shape:
+        return grid
+    for parts in range(min(nshards, max(shape) if shape else 1), 1, -1):
+        divisible = [(size, dim) for dim, size in enumerate(shape)
+                     if size % parts == 0 and size >= parts]
+        if divisible:
+            _, dim = max(divisible)
+            grid[dim] = parts
+            return grid
+    return grid
+
+
+def iter_shards(shape: Sequence[int], grid: Sequence[int]):
+    """Yield ``(flat_pos, offset, shard_shape, slices)`` for every shard
+    of the grid, in row-major grid order."""
+    shape = list(shape)
+    grid = list(grid)
+    steps = [s // g for s, g in zip(shape, grid)] or []
+    for flat_pos, index in enumerate(itertools.product(
+            *[range(g) for g in grid])):
+        offset = [i * st for i, st in zip(index, steps)]
+        shard_shape = list(steps)
+        slices = tuple(slice(o, o + sh)
+                       for o, sh in zip(offset, shard_shape))
+        yield flat_pos, offset, shard_shape, slices
+
+
+def crc32_of(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+# ------------------------- state tree flattening ----------------------------
+
+class _TensorRef:
+    """Placeholder pickled into aux.pkl where an array leaf sat.
+
+    ``kind``: ``"tensor"`` (paddle Tensor — restored as Tensor with its
+    ``stop_gradient``/``name``), ``"jax"`` (bare jax array — restored as
+    Tensor, matching ``framework.io`` parity), ``"ndarray"`` (numpy —
+    restored as numpy)."""
+
+    __slots__ = ("key", "kind", "stop_gradient", "name")
+
+    def __init__(self, key: str, kind: str, stop_gradient: bool = True,
+                 name: str = ""):
+        self.key = key
+        self.kind = kind
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+    # __slots__ classes need explicit pickle support
+    def __getstate__(self):
+        return (self.key, self.kind, self.stop_gradient, self.name)
+
+    def __setstate__(self, st):
+        self.key, self.kind, self.stop_gradient, self.name = st
+
+
+def flatten_state(state) -> Tuple[object, Dict[str, Tuple[np.ndarray,
+                                                          "_TensorRef"]]]:
+    """Split a nested state into (skeleton, tensors).
+
+    The skeleton mirrors ``state``'s container structure (dicts, lists,
+    tuples, **namedtuples preserved**) with every array leaf replaced by a
+    :class:`_TensorRef`; ``tensors`` maps ref key -> (host numpy copy,
+    ref). The copy here IS the device→host snapshot: it must be an OWNED
+    host buffer, not a reference — the compiled train step DONATES old
+    param/moment buffers to XLA (a held jax array reference turns into
+    'Array has been deleted' on the writer thread) and numpy leaves may
+    be mutated in place by the caller."""
+    from paddle_tpu.core.tensor import Tensor
+
+    tensors: Dict[str, Tuple[np.ndarray, _TensorRef]] = {}
+    counter = itertools.count()
+
+    def ref_for(value, kind, stop_gradient=True, name=""):
+        key = f"t{next(counter):04d}"
+        ref = _TensorRef(key, kind, stop_gradient, name)
+        tensors[key] = (np.array(value, copy=True), ref)
+        return ref
+
+    def walk(obj, path):
+        if isinstance(obj, Tensor):
+            return ref_for(obj.data, "tensor", obj.stop_gradient, obj.name)
+        if isinstance(obj, np.ndarray):
+            return ref_for(obj, "ndarray")
+        if isinstance(obj, np.generic):
+            return obj  # numpy scalars pickle fine in the skeleton
+        if hasattr(obj, "dtype") and hasattr(obj, "shape") and \
+                not isinstance(obj, (int, float, complex)):
+            return ref_for(obj, "jax")  # bare jax arrays
+        if isinstance(obj, dict):
+            return {k: walk(v, f"{path}/{k}") for k, v in obj.items()}
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+            return type(obj)(*[walk(v, f"{path}/{i}")
+                               for i, v in enumerate(obj)])
+        if isinstance(obj, (list, tuple)):
+            seq = [walk(v, f"{path}/{i}") for i, v in enumerate(obj)]
+            return seq if isinstance(obj, list) else tuple(seq)
+        return obj
+
+    return walk(state, ""), tensors
+
+
+def unflatten_state(skeleton, arrays: Dict[str, np.ndarray]):
+    """Inverse of :func:`flatten_state`: rebuild the nested state from the
+    pickled skeleton plus assembled arrays (keyed by ref key)."""
+    from paddle_tpu.core.tensor import Tensor
+
+    def walk(obj):
+        if isinstance(obj, _TensorRef):
+            arr = arrays[obj.key]
+            if obj.kind == "ndarray":
+                return arr
+            return Tensor(arr, stop_gradient=obj.stop_gradient,
+                          name=obj.name)
+        if isinstance(obj, dict):
+            return {k: walk(v) for k, v in obj.items()}
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+            return type(obj)(*[walk(v) for v in obj])
+        if isinstance(obj, (list, tuple)):
+            seq = [walk(v) for v in obj]
+            return seq if isinstance(obj, list) else tuple(seq)
+        return obj
+
+    return walk(skeleton)
+
+
+# ------------------------------- manifest -----------------------------------
+
+def write_index(step_dir: str, doc: dict):
+    """fsynced atomic write of the manifest into ``step_dir``."""
+    path = os.path.join(step_dir, INDEX_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_index(step_dir: str) -> dict:
+    path = os.path.join(step_dir, INDEX_FILE)
+    if not os.path.isfile(path):
+        raise CheckpointError(f"no {INDEX_FILE} in {step_dir!r}")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        raise CheckpointIntegrityError(
+            f"unreadable manifest in {step_dir!r}: {e}") from e
+    if doc.get("format_version") != FORMAT_VERSION:
+        raise CheckpointError(
+            f"unsupported checkpoint format_version "
+            f"{doc.get('format_version')!r} in {step_dir!r}")
+    return doc

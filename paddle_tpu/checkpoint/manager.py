@@ -1,0 +1,251 @@
+"""CheckpointManager — the subsystem's front door.
+
+Owns one checkpoint root directory full of ``step_N`` dirs and provides:
+
+* ``save(step, state)`` — async by default: the caller pays only the
+  device→host snapshot; a background writer streams shards and commits
+  atomically (``writer.write_step``). Returns a :class:`SaveFuture`.
+* ``restore(step=None)`` — loads the latest (or given) committed step,
+  crc-verifying every shard; on corruption it warns LOUDLY, bumps
+  ``ckpt_failures_total{kind="integrity"}`` and falls back to the previous
+  committed step, so a torn/bit-rotted step never silently restores.
+* ``latest_step()`` / ``all_steps()`` — committed steps only.
+* keep-last-k retention GC (also sweeps stale ``.tmp`` dirs of crashed
+  saves), run after every commit.
+
+Integration seams: hapi's ``ModelCheckpoint`` callback,
+``incubate.checkpoint.TrainEpochRange`` and
+``serving.ServingEngine.load_weights`` all route through this class;
+``paddle.load`` dir-dispatches here (``load_state_dir``).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import warnings
+from typing import Callable, List, Optional
+
+from .layout import (INDEX_FILE, TMP_SUFFIX, CheckpointError,
+                     CheckpointIntegrityError, is_committed,
+                     list_committed_steps, parse_step_dir, read_index,
+                     step_dir_name)
+from .reshard import mesh_topology, read_state
+from .writer import (AsyncCheckpointWriter, SaveFuture, ckpt_metrics,
+                     snapshot, write_step)
+
+__all__ = ["CheckpointManager", "load_state_dir"]
+
+
+class CheckpointManager:
+    """Orbax-flavored manager over one checkpoint directory.
+
+    ``topology``: axis-name -> size dict recorded in the manifest and used
+    to pick shard grids; defaults to the current ``distributed.get_mesh()``
+    (falling back to one shard per tensor off-mesh). ``fault_hook`` is the
+    crash-injection seam forwarded to :func:`writer.write_step` — tests
+    use it to kill a save between shard write and commit.
+    """
+
+    def __init__(self, root: str, keep_last_k: Optional[int] = None,
+                 async_: bool = True, topology: Optional[dict] = None,
+                 registry=None,
+                 fault_hook: Optional[Callable[[str], None]] = None):
+        self.root = str(root)
+        self.keep_last_k = keep_last_k
+        self.async_ = bool(async_)
+        self.registry = registry
+        self.fault_hook = fault_hook
+        self._topology = topology
+        self._writer = AsyncCheckpointWriter(registry)
+        self._m = ckpt_metrics(registry)
+        self.last_restored_step: Optional[int] = None
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- save ----------------------------------------------------------------
+    def topology(self) -> dict:
+        if self._topology is not None:
+            return dict(self._topology)
+        try:
+            from paddle_tpu.distributed import get_mesh
+            return mesh_topology(get_mesh())
+        except Exception:
+            return {}
+
+    def save(self, step: int, state, async_: Optional[bool] = None,
+             metadata: Optional[dict] = None,
+             overwrite: bool = False) -> SaveFuture:
+        """Snapshot ``state`` and persist it as ``step``. Async saves
+        return immediately after the snapshot; ``fut.wait()`` blocks until
+        the atomic commit. Sync saves commit before returning.
+        ``overwrite`` lets a re-run replace an already-committed step id
+        (default: raise — silently clobbering history is a bug)."""
+        use_async = self.async_ if async_ is None else bool(async_)
+        mode = "async" if use_async else "sync"
+        t0 = time.perf_counter()
+        snap = snapshot(state)
+        topo = self.topology()
+
+        def write() -> str:
+            t1 = time.perf_counter()
+            path = write_step(self.root, step, snap, topology=topo,
+                              metadata=metadata, fault_hook=self.fault_hook,
+                              overwrite=overwrite,
+                              registry=self.registry)
+            self._m["save_seconds"].observe(
+                snap.seconds + (time.perf_counter() - t1), mode=mode)
+            self._gc()
+            return path
+
+        # both modes go through the single writer thread — saves (and the
+        # GC after each commit) are strictly serialized, so a sync save
+        # can never race an in-flight async one
+        fut = self._writer.submit(write, step)
+        if use_async:
+            self._m["blocking_seconds"].observe(
+                time.perf_counter() - t0, mode=mode)
+            return fut
+        try:
+            fut.wait()  # re-raises a failed sync save in the caller
+        finally:
+            self._m["blocking_seconds"].observe(
+                time.perf_counter() - t0, mode=mode)
+        return fut
+
+    def wait_all(self, timeout: Optional[float] = None):
+        """Drain every in-flight async save."""
+        self._writer.wait_all(timeout)
+
+    def close(self, timeout: Optional[float] = None):
+        self._writer.close(timeout)
+
+    # -- discovery -----------------------------------------------------------
+    def all_steps(self) -> List[int]:
+        return list_committed_steps(self.root)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.root, step_dir_name(step))
+
+    def metadata(self, step: int) -> dict:
+        return read_index(self.step_dir(step)).get("metadata", {})
+
+    # -- restore -------------------------------------------------------------
+    def restore(self, step: Optional[int] = None, mesh=None,
+                verify: bool = True, strict: bool = False):
+        """Load a committed step (default: latest) back into a state tree.
+
+        Corrupt steps (checksum mismatch, missing shards, unreadable
+        manifest) are skipped with a loud warning and the previous
+        committed step is tried — unless ``strict`` or an explicit
+        ``step`` was requested, in which case the integrity error raises.
+        """
+        steps = self.all_steps()
+        if step is not None:
+            if step not in steps:
+                raise FileNotFoundError(
+                    f"step {step} has no committed checkpoint in "
+                    f"{self.root!r} (committed: {steps})")
+            candidates = [step]
+        else:
+            candidates = list(reversed(steps))
+        if not candidates:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {self.root!r}")
+        last_err: Optional[CheckpointError] = None
+        for s in candidates:
+            try:
+                state = read_state(self.step_dir(s), verify=verify,
+                                   mesh=mesh, registry=self.registry)
+                self.last_restored_step = s
+                return state
+            except CheckpointIntegrityError as e:
+                self._m["failures"].inc(kind="integrity")
+                will_fall_back = not (strict or step is not None)
+                warnings.warn(
+                    f"checkpoint step {s} in {self.root!r} is CORRUPT "
+                    f"({e}); " +
+                    ("falling back to the previous committed step"
+                     if will_fall_back else
+                     "raising (explicitly requested step / strict mode)"),
+                    RuntimeWarning, stacklevel=2)
+                last_err = e
+                if not will_fall_back:
+                    raise
+        raise CheckpointIntegrityError(
+            f"every committed step under {self.root!r} failed integrity "
+            f"verification") from last_err
+
+    # -- retention -----------------------------------------------------------
+    def _gc(self):
+        """Keep the newest ``keep_last_k`` committed steps; sweep stale
+        ``.tmp`` dirs (aborted saves) regardless of retention policy."""
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return
+        committed = sorted(s for s in (parse_step_dir(n) for n in names)
+                           if s is not None
+                           if is_committed(os.path.join(
+                               self.root, step_dir_name(s))))
+        doomed = []
+        if self.keep_last_k is not None and self.keep_last_k > 0:
+            # retention by commit RECENCY (manifest mtime; id breaks
+            # ties), not by step id: a restarted run re-numbering from
+            # epoch 0 over higher-id steps of a previous run must not
+            # have its fresh commits collected as "oldest"
+            def commit_time(s):
+                try:
+                    return (os.path.getmtime(os.path.join(
+                        self.root, step_dir_name(s), INDEX_FILE)), s)
+                except OSError:
+                    return (0.0, s)
+            by_recency = sorted(committed, key=commit_time)
+            doomed = [os.path.join(self.root, step_dir_name(s))
+                      for s in by_recency[:-self.keep_last_k]]
+        try:
+            import jax
+            single_process = jax.process_count() == 1
+        except Exception:
+            single_process = True
+        for name in names:
+            if single_process and name.startswith("step_") and \
+                    name.endswith(TMP_SUFFIX):
+                # sweep only .tmp dirs STRICTLY OLDER than the newest
+                # committed step (saves commit in step order within this
+                # process's serialized writer, so such a dir can only be
+                # an aborted save's residue), and only in single-process
+                # runs — on a shared fs another rank's live in-flight
+                # save is indistinguishable from residue, so multi-host
+                # crash residue is left for operator cleanup
+                try:
+                    s = int(name[len("step_"):-len(TMP_SUFFIX)])
+                except ValueError:
+                    continue
+                if committed and s < committed[-1]:
+                    doomed.append(os.path.join(self.root, name))
+            elif name.startswith("step_") and name.endswith(".old"):
+                # overwrite-swap residue: superseded once the same-id
+                # final dir is committed again; if the final dir is
+                # MISSING, the .old holds the only copy of that step
+                # (crash between aside and publish) — keep it
+                if is_committed(os.path.join(self.root, name[:-4])):
+                    doomed.append(os.path.join(self.root, name))
+        for path in doomed:
+            shutil.rmtree(path, ignore_errors=True)
+            if not path.endswith(TMP_SUFFIX):
+                self._m["gc_removed"].inc()
+
+
+def load_state_dir(path: str, step: Optional[int] = None, mesh=None,
+                   verify: bool = True):
+    """``paddle.load`` dir-dispatch target: ``path`` may be a manager root
+    (latest committed step, with corruption fallback) or a single
+    ``step_N`` directory."""
+    if os.path.isfile(os.path.join(path, INDEX_FILE)):
+        return read_state(path, verify=verify, mesh=mesh)
+    return CheckpointManager(path).restore(step=step, mesh=mesh,
+                                           verify=verify)

@@ -1,0 +1,379 @@
+"""Async sharded checkpoint writer with atomic commit.
+
+Save path (``CheckpointManager.save`` drives this):
+
+1. **Snapshot** (caller's thread, blocking): :func:`snapshot` walks the
+   state tree and pulls every array to host numpy (`flatten_state`) —
+   after it returns, the training step may mutate parameters freely; the
+   checkpoint is isolated. This is the only part an async save charges to
+   the step loop.
+2. **Write** (background thread for async saves): shards stream into
+   ``step_N.tmp/`` as fsynced raw-bytes shard files, each rank writing only
+   shards it owns (round-robin over the flat shard index); rank 0 merges
+   the per-rank shard lists into ``index.json``, writes the ``COMMITTED``
+   marker, and **renames the directory** — the rename is the atomic
+   publish. A crash at any earlier point leaves only ``step_N.tmp``,
+   which no reader accepts.
+3. Non-zero ranks block until the committed directory appears (cheap
+   filesystem barrier — shared-fs semantics, like the reference's
+   distributed save helpers).
+
+Telemetry (``ckpt_*`` families through ``observability.metrics``, see
+docs/CHECKPOINT.md): save/blocking durations, bytes, in-flight gauge,
+last-committed-step gauge, failure counters.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import threading
+import time
+import warnings
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from . import layout
+from .layout import (AUX_FILE, COMMIT_MARKER, FORMAT_VERSION, TMP_SUFFIX,
+                     CheckpointError, crc32_of, flatten_state, iter_shards,
+                     plan_grid, poll_until, step_dir_name, write_index)
+
+__all__ = ["Snapshot", "snapshot", "SaveFuture", "write_step",
+           "AsyncCheckpointWriter", "ckpt_metrics"]
+
+
+def ckpt_metrics(registry=None) -> dict:
+    """The ``ckpt_*`` metric families (created on first use)."""
+    from paddle_tpu.observability.metrics import get_registry
+    r = registry or get_registry()
+    return {
+        "save_seconds": r.histogram(
+            "ckpt_save_seconds",
+            "snapshot->commit wall time per save, by mode"),
+        "blocking_seconds": r.histogram(
+            "ckpt_blocking_seconds",
+            "time save() blocked its caller (the step-loop stall), by mode"),
+        "restore_seconds": r.histogram(
+            "ckpt_restore_seconds", "restore wall time"),
+        "bytes": r.counter(
+            "ckpt_bytes_total", "checkpoint bytes, by direction"),
+        "in_flight": r.gauge(
+            "ckpt_in_flight", "async saves snapshotted but not committed"),
+        "last_step": r.gauge(
+            "ckpt_last_committed_step", "most recently committed step"),
+        "failures": r.counter(
+            "ckpt_failures_total", "failed saves / integrity errors, by kind"),
+        "gc_removed": r.counter(
+            "ckpt_gc_removed_total", "step dirs removed by retention GC"),
+    }
+
+
+class Snapshot:
+    """Host-side copy of one state tree, decoupled from device storage."""
+
+    def __init__(self, skeleton_bytes: bytes, tensors: Dict[str, tuple],
+                 nbytes: int, seconds: float):
+        self.skeleton_bytes = skeleton_bytes
+        self.tensors = tensors  # key -> (np array, _TensorRef)
+        self.nbytes = nbytes
+        self.seconds = seconds
+
+
+def snapshot(state) -> Snapshot:
+    """Device→host snapshot of ``state`` (see module docstring, phase 1).
+    Every leaf becomes an OWNED host copy — buffer donation in the
+    compiled train step forbids holding live jax references across the
+    async write (see ``flatten_state``). On a real multi-host mesh the
+    full-array copy per rank is the known cost; pulling only each rank's
+    addressable shards is the TPU follow-up."""
+    t0 = time.perf_counter()
+    skeleton, tensors = flatten_state(state)
+    nbytes = sum(int(a.nbytes) for a, _ in tensors.values())
+    skel = pickle.dumps(skeleton, protocol=4)
+    return Snapshot(skel, tensors, nbytes + len(skel),
+                    time.perf_counter() - t0)
+
+
+class SaveFuture:
+    """Handle for one save; ``wait()`` blocks until commit (or re-raises
+    the writer's failure)."""
+
+    def __init__(self, step: int):
+        self.step = step
+        self._ev = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._result: Optional[str] = None
+
+    def _finish(self, result: Optional[str], exc=None):
+        self._result = result
+        self._exc = exc
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def exception(self) -> Optional[BaseException]:
+        return self._exc
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        """Block until this save committed; returns the step directory."""
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                f"checkpoint save of step {self.step} not finished "
+                f"in {timeout}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+def _fsync_file(path: str, data: bytes):
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _rank_shards_file(rank: int) -> str:
+    return f"shards.rank{rank}.json"
+
+
+def write_step(root: str, step: int, snap: Snapshot, *,
+               topology: Optional[dict] = None,
+               metadata: Optional[dict] = None,
+               process_index: Optional[int] = None,
+               process_count: Optional[int] = None,
+               fault_hook: Optional[Callable[[str], None]] = None,
+               overwrite: bool = False,
+               registry=None) -> str:
+    """Write + atomically commit one step. Returns the final step dir.
+
+    ``fault_hook(phase)`` is the crash-injection seam (tests): it runs at
+    ``"after_shards"`` (shard files durable, no manifest yet) and
+    ``"before_commit"`` (manifest written, marker/rename pending); raising
+    from it aborts the save exactly as a process kill at that point would,
+    leaving only the ``.tmp`` directory.
+    """
+    import json as _json
+
+    if process_index is None or process_count is None:
+        try:
+            import jax
+            process_index = jax.process_index()
+            process_count = jax.process_count()
+        except Exception:
+            process_index, process_count = 0, 1
+    topology = dict(topology or {})
+    nshards = 1
+    for v in topology.values():
+        nshards *= int(v)
+    nshards = max(nshards, process_count, 1)
+
+    final_dir = os.path.join(root, step_dir_name(step))
+    tmp_dir = final_dir + TMP_SUFFIX
+    if os.path.isdir(final_dir) and not overwrite:
+        raise CheckpointError(
+            f"step {step} already committed at {final_dir!r}")
+    os.makedirs(tmp_dir, exist_ok=True)
+
+    # -- shards owned by this rank -------------------------------------------
+    my_entries: Dict[str, dict] = {}
+    written = 0
+    for key in sorted(snap.tensors):
+        arr, ref = snap.tensors[key]
+        grid = plan_grid(arr.shape, nshards)
+        entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                 "grid": grid, "kind": ref.kind, "shards": []}
+        for flat_pos, offset, shard_shape, slices in iter_shards(
+                arr.shape, grid):
+            owner = flat_pos % process_count
+            fname = f"{key}_s{flat_pos:03d}.bin"
+            shard_rec = {"file": fname, "offset": offset,
+                         "shape": shard_shape, "owner": owner}
+            if owner == process_index:
+                # raw C-order bytes, dtype/shape from the manifest — .npy
+                # would silently degrade extension dtypes (bfloat16→|V2)
+                data = np.asarray(arr[slices]).tobytes()
+                shard_rec["crc32"] = crc32_of(data)
+                shard_rec["nbytes"] = len(data)
+                _fsync_file(os.path.join(tmp_dir, fname), data)
+                written += len(data)
+            entry["shards"].append(shard_rec)
+        my_entries[key] = entry
+
+    if process_index == 0:
+        aux_crc = crc32_of(snap.skeleton_bytes)
+        _fsync_file(os.path.join(tmp_dir, AUX_FILE), snap.skeleton_bytes)
+        written += len(snap.skeleton_bytes)
+    _fsync_dir(tmp_dir)
+
+    if fault_hook is not None:
+        fault_hook("after_shards")
+
+    m = ckpt_metrics(registry)
+    m["bytes"].inc(written, direction="write")
+
+    # identity of any PRE-EXISTING commit of this step id (overwrite
+    # re-runs): captured before this rank publishes its shard records —
+    # rank 0 cannot commit until every rank has published, so this stat
+    # is guaranteed pre-commit and the barrier below can distinguish the
+    # stale dir from rank 0's fresh publish
+    def _commit_token():
+        try:
+            st = os.stat(os.path.join(final_dir, layout.INDEX_FILE))
+            return (st.st_ino, st.st_mtime_ns)
+        except OSError:
+            return None
+    stale_token = _commit_token()
+
+    if process_count > 1:
+        # publish this rank's shard records ATOMICALLY (tmp + rename) so
+        # rank 0's existence poll can never read a half-written file.
+        # Known limitation: a crashed multi-host attempt's residue in a
+        # reused step_N.tmp is not cleared (no rank may rmtree a dir the
+        # others are writing into) — a stale records file from the same
+        # step id could satisfy rank 0 early; multi-host re-saves of a
+        # crashed step id should use a fresh step id
+        rf = os.path.join(tmp_dir, _rank_shards_file(process_index))
+        _fsync_file(rf + ".tmp", _json.dumps(my_entries).encode())
+        os.replace(rf + ".tmp", rf)
+
+    if process_index != 0:
+        # wait for rank 0's FRESH commit (marker inside the renamed dir,
+        # manifest identity differing from any stale same-id commit)
+        poll_until(lambda: layout.is_committed(final_dir) and
+                   _commit_token() != stale_token,
+                   what=f"rank 0's commit of step {step} "
+                        f"(rank {process_index} barrier)")
+        return final_dir
+
+    # -- rank 0: merge ranks' crc records, write manifest, commit ------------
+    entries = my_entries
+    if process_count > 1:
+        for r in range(1, process_count):
+            path = os.path.join(tmp_dir, _rank_shards_file(r))
+            poll_until(lambda: os.path.exists(path),
+                       what=f"rank {r}'s shard records for step {step}")
+            with open(path) as f:
+                theirs = _json.load(f)
+            for key, entry in theirs.items():
+                mine = entries[key]["shards"]
+                for pos, rec in enumerate(entry["shards"]):
+                    if rec.get("owner") == r:
+                        mine[pos] = rec
+            os.unlink(path)
+
+    doc = {"format_version": FORMAT_VERSION, "step": int(step),
+           "world_size": process_count, "topology": topology,
+           "tensors": entries,
+           "aux": {"file": AUX_FILE, "crc32": aux_crc,
+                   "nbytes": len(snap.skeleton_bytes)},
+           "metadata": dict(metadata or {})}
+    write_index(tmp_dir, doc)
+    _fsync_dir(tmp_dir)
+
+    if fault_hook is not None:
+        fault_hook("before_commit")
+
+    # marker first, then the rename: the rename is the atomic publish, and
+    # the marker is already inside when the new name appears
+    _fsync_file(os.path.join(tmp_dir, COMMIT_MARKER), b"1\n")
+    _fsync_dir(tmp_dir)
+    aside = None
+    if overwrite and os.path.isdir(final_dir):
+        # replacing an existing step (a re-run writing the same step id):
+        # rename the old commit ASIDE first — at no instant is committed
+        # history deleted while the replacement is still unpublished (a
+        # crash here leaves step_N.old, which readers ignore and the
+        # committer below removes on success)
+        import shutil
+        aside = final_dir + ".old"
+        if os.path.isdir(aside):
+            shutil.rmtree(aside)  # residue of a previously crashed swap
+        os.rename(final_dir, aside)
+    os.rename(tmp_dir, final_dir)
+    if aside is not None:
+        import shutil
+        shutil.rmtree(aside, ignore_errors=True)
+    _fsync_dir(root)
+    m["last_step"].set(int(step))
+    return final_dir
+
+
+class AsyncCheckpointWriter:
+    """Single background thread draining a FIFO save queue.
+
+    One worker (not a pool) on purpose: saves commit in submission order,
+    so ``latest_step()`` can never observe step N+1 without step N when
+    both were submitted (the async ``wait()``-ordering contract)."""
+
+    def __init__(self, registry=None):
+        self._q: "queue.Queue" = queue.Queue()
+        self._registry = registry
+        self._m = ckpt_metrics(registry)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="pt-ckpt-writer", daemon=True)
+                self._thread.start()
+
+    def submit(self, fn: Callable[[], str], step: int) -> SaveFuture:
+        if self._closed:
+            raise CheckpointError("writer is closed")
+        fut = SaveFuture(step)
+        self._m["in_flight"].inc()
+        self._q.put((fn, fut))
+        self._ensure_thread()
+        return fut
+
+    def _run(self):
+        while True:
+            try:
+                fn, fut = self._q.get(timeout=0.2)
+            except queue.Empty:
+                with self._lock:
+                    # exit when drained (no idle polling thread per
+                    # manager); the empty-check under the submit lock
+                    # makes the handoff race-free — a concurrent submit
+                    # either sees this thread alive or restarts one
+                    if self._closed or self._q.empty():
+                        self._thread = None
+                        return
+                continue
+            try:
+                fut._finish(fn())
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                self._m["failures"].inc(kind="save")
+                warnings.warn(
+                    f"background checkpoint save of step {fut.step} "
+                    f"failed: {type(e).__name__}: {e} (sync callers "
+                    f"re-raise from wait())", RuntimeWarning)
+                fut._finish(None, e)
+            finally:
+                self._m["in_flight"].dec()
+                self._q.task_done()
+
+    def wait_all(self, timeout: Optional[float] = None):
+        """Block until every submitted save finished (committed or failed)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._q.unfinished_tasks:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("checkpoint writer queue not drained")
+            time.sleep(0.005)
+
+    def close(self, timeout: Optional[float] = None):
+        self.wait_all(timeout)
+        self._closed = True

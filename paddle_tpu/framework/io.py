@@ -36,6 +36,8 @@ def _to_host(obj):
                 "name": ""}
     if isinstance(obj, dict):
         return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        return type(obj)(*[_to_host(v) for v in obj])  # namedtuple
     if isinstance(obj, (list, tuple)):
         seq = [_to_host(v) for v in obj]
         return seq if isinstance(obj, list) else tuple(seq)
@@ -51,24 +53,67 @@ def _from_host(obj):
                        name=obj.get("name", ""))
             return t
         return {k: _from_host(v) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        return type(obj)(*[_from_host(v) for v in obj])  # namedtuple
     if isinstance(obj, (list, tuple)):
         seq = [_from_host(v) for v in obj]
         return seq if isinstance(obj, list) else tuple(seq)
     return obj
 
 
+#: per-path count of save() calls THIS process made — the round id all
+#: SPMD ranks agree on (every rank runs the same save sequence), letting
+#: the barrier distinguish "this round's commit" from a file left by an
+#: earlier save to the same path
+_save_rounds: dict = {}
+
+
+def _commit_sidecar(path: str) -> str:
+    return path + ".commit"
+
+
+def _read_round(path: str) -> int:
+    try:
+        with open(_commit_sidecar(path)) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def _wait_for_commit(path: str, round_n: int):
+    """Filesystem barrier for non-writing ranks: block until rank 0's
+    atomic publish for THIS save round is visible (sidecar round counter
+    >= ours), so a rank can neither race ahead of the commit nor be
+    satisfied by a stale file from a previous save to the same path.
+    Timeout via ``PADDLE_TPU_CKPT_BARRIER_TIMEOUT`` (default 600 s)."""
+    from paddle_tpu.checkpoint.layout import poll_until
+    poll_until(
+        lambda: os.path.exists(path) and _read_round(path) >= round_n,
+        what=f"rank 0's publish of {path!r} (save round {round_n})")
+
+
 def save(obj: Any, path: str, protocol: int = 4, **configs):
     """paddle.save parity: pickle a (possibly nested) object with Tensors.
 
     Multi-host: only process 0 writes (the reference guards the same way
-    in its distributed save helpers).
+    in its distributed save helpers); the other ranks BLOCK until the
+    written file is visible — without that barrier a non-zero rank could
+    race ahead into ``load`` before the commit. The barrier is keyed by a
+    per-path save-round counter (all ranks run the same save sequence) so
+    re-saving an existing path still synchronizes; note the counter is
+    per process lifetime — after a restart onto pre-existing files the
+    first round may pass on the prior file. Sharded/async checkpointing
+    (which barriers per explicit step id and has no such caveat) lives in
+    :mod:`paddle_tpu.checkpoint`.
     """
     if not (_PROTOCOL_MIN <= protocol <= _PROTOCOL_MAX):
         raise ValueError(
             f"pickle protocol must be in [{_PROTOCOL_MIN}, "
             f"{_PROTOCOL_MAX}], got {protocol}")
     import jax
+    round_n = _save_rounds[path] = _save_rounds.get(path, 0) + 1
     if jax.process_index() != 0:
+        _wait_for_commit(path, round_n)
         return
     d = os.path.dirname(path)
     if d:
@@ -78,10 +123,26 @@ def save(obj: Any, path: str, protocol: int = 4, **configs):
     with open(tmp, "wb") as f:
         pickle.dump(payload, f, protocol=protocol)
     os.replace(tmp, path)  # atomic publish — no torn checkpoints
+    stmp = _commit_sidecar(path) + ".tmp"
+    with open(stmp, "w") as f:
+        f.write(str(round_n))
+    os.replace(stmp, _commit_sidecar(path))
 
 
 def load(path: str, **configs) -> Any:
-    """paddle.load parity: read a checkpoint written by :func:`save`."""
+    """paddle.load parity: read a checkpoint written by :func:`save`.
+
+    Directory dispatch: a path that is a sharded-checkpoint directory
+    (a ``CheckpointManager`` root or a single ``step_N`` dir, see
+    docs/CHECKPOINT.md) routes through :mod:`paddle_tpu.checkpoint` —
+    ``paddle.load("ckpts/")`` restores the latest committed step."""
+    if os.path.isdir(path):
+        from paddle_tpu.checkpoint import is_checkpoint_dir, load_state_dir
+        if is_checkpoint_dir(path):
+            return load_state_dir(path)
+        raise FileNotFoundError(
+            f"{path!r} is a directory but not a checkpoint layout "
+            f"(no committed step_N subdirectory or index.json)")
     if not os.path.exists(path):
         raise FileNotFoundError(f"checkpoint {path!r} does not exist")
     with open(path, "rb") as f:

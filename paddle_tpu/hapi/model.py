@@ -70,14 +70,44 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir="checkpoint"):
+    """Epoch-end checkpointing through ``paddle_tpu.checkpoint`` (see
+    docs/CHECKPOINT.md): model + optimizer state commit atomically as ONE
+    step — no torn model/opt pairs — and with ``async_=True`` (default)
+    the fit loop pays only the device→host snapshot; shard writing runs
+    on the background writer. ``keep_last_k`` bounds disk usage.
+
+    Resume with ``model.load(save_dir)`` (dir-dispatch to the latest
+    committed step) or a ``CheckpointManager`` directly."""
+
+    def __init__(self, save_freq=1, save_dir="checkpoint", async_=True,
+                 keep_last_k=None):
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.async_ = async_
+        self.keep_last_k = keep_last_k
+        self._mgr = None
+
+    def manager(self):
+        if self._mgr is None:
+            from paddle_tpu.checkpoint import CheckpointManager
+            self._mgr = CheckpointManager(self.save_dir,
+                                          keep_last_k=self.keep_last_k,
+                                          async_=self.async_)
+        return self._mgr
 
     def on_epoch_end(self, epoch, logs=None):
         if epoch % self.save_freq == 0:
-            import os
-            self.model.save(os.path.join(self.save_dir, str(epoch)))
+            state = {"model": self.model.network.state_dict()}
+            if self.model._optimizer is not None and \
+                    hasattr(self.model._optimizer, "state_dict"):
+                state["optimizer"] = self.model._optimizer.state_dict()
+            # overwrite: a restarted fit re-saves the same epoch ids
+            self.manager().save(epoch, state, metadata={"epoch": epoch},
+                                overwrite=True)
+
+    def on_train_end(self, logs=None):
+        if self._mgr is not None:
+            self._mgr.wait_all()
 
 
 class EarlyStopping(Callback):
@@ -318,6 +348,22 @@ class Model:
         for cb in callbacks:
             cb.on_train_begin()
         import time as _time
+        try:
+            history = self._fit_loop(loader, eval_data, batch_size, epochs,
+                                     eval_freq, save_dir, save_freq,
+                                     num_workers, callbacks, num_iters,
+                                     history, _time)
+        finally:
+            # runs on exceptions/KeyboardInterrupt too: callbacks with
+            # teardown duties (ModelCheckpoint draining async saves) must
+            # not be skipped when the loop dies mid-epoch
+            for cb in callbacks:
+                cb.on_train_end()
+        return history
+
+    def _fit_loop(self, loader, eval_data, batch_size, epochs, eval_freq,
+                  save_dir, save_freq, num_workers, callbacks, num_iters,
+                  history, _time):
         step = 0
         for epoch in range(epochs):
             for cb in callbacks:
@@ -360,8 +406,6 @@ class Model:
             if self._stop_training or (num_iters is not None and
                                        step >= num_iters):
                 break
-        for cb in callbacks:
-            cb.on_train_end()
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
@@ -409,6 +453,19 @@ class Model:
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         import os
         from paddle_tpu.framework.io import load
+        if os.path.isdir(path):
+            # ModelCheckpoint layout: one committed step holding
+            # {"model": ..., "optimizer": ...}; a step holding a flat
+            # state_dict loads as model weights only (docs/CHECKPOINT.md)
+            state = load(path)
+            if isinstance(state.get("model"), dict):
+                self.network.set_state_dict(state["model"])
+                if not reset_optimizer and self._optimizer is not None \
+                        and "optimizer" in state:
+                    self._optimizer.set_state_dict(state["optimizer"])
+            else:
+                self.network.set_state_dict(state)
+            return
         self.network.set_state_dict(load(path + ".pdparams"))
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(path + ".pdopt"):

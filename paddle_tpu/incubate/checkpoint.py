@@ -3,10 +3,15 @@
 AutoCheckpointChecker + ``train_epoch_range`` — SURVEY.md §5 "snapshots
 exe scope ... and resumes by epoch id, keyed by job env").
 
-TPU-native shape: instead of snapshotting an executor scope, the range
-object holds (model, optimizer) references and pickles their state_dicts
-through ``paddle.save`` — the same artifact format as manual
-checkpointing, so resumes are inspectable.
+Persistence routes through :class:`paddle_tpu.checkpoint.CheckpointManager`
+(step number == epoch): model AND optimizer state commit atomically as ONE
+step, which closes the torn-pair window the previous two-file layout had —
+a crash between the ``model.pdparams`` and ``opt.pdopt`` writes left a
+mismatched pair that ``_load_meta`` happily restored. Now a crash mid-save
+leaves only an uncommitted ``step_N.tmp`` dir and resume falls back to the
+last committed epoch. A ``meta.json`` mirror (written AFTER the commit) is
+kept for inspectability and for pre-manager jobs, which still restore
+through the legacy two-file path.
 """
 from __future__ import annotations
 
@@ -28,7 +33,8 @@ class TrainEpochRange:
 
     def __init__(self, max_epoch_num: int, save_dir: Optional[str] = None,
                  model=None, optimizer=None, save_checkpoint_inter: int = 1,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, keep_last_k: Optional[int] = 2,
+                 async_: bool = False):
         self.max_epoch_num = int(max_epoch_num)
         self.save_dir = save_dir or os.environ.get(
             "PADDLE_CHECKPOINT_DIR", "./paddle_tpu_auto_ckpt")
@@ -38,11 +44,32 @@ class TrainEpochRange:
         self.optimizer = optimizer
         self.inter = max(int(save_checkpoint_inter), 1)
         self._meta = os.path.join(self._dir, "meta.json")
-        self.restored_from = self._load_meta()
+        from paddle_tpu.checkpoint import CheckpointManager
+        # sync by default: the epoch boundary is not a hot path, and a
+        # crashed process must not lose an epoch it believed durable
+        self._mgr = CheckpointManager(self._dir, keep_last_k=keep_last_k,
+                                      async_=async_)
+        self.restored_from = self._restore()
 
     # -- persistence ---------------------------------------------------------
-    def _load_meta(self) -> int:
-        """Returns the next epoch to run (0 if no checkpoint)."""
+    def _restore(self) -> int:
+        """Restore the newest committed epoch; returns the next epoch to
+        run (0 if no checkpoint)."""
+        if self._mgr.latest_step() is None:
+            return self._restore_legacy()
+        # no explicit step: a corrupt newest epoch falls back (loudly) to
+        # the previous committed one instead of failing the resume
+        state = self._mgr.restore()
+        last = self._mgr.last_restored_step
+        if self.model is not None and "model" in state:
+            self.model.set_state_dict(state["model"])
+        if self.optimizer is not None and "optimizer" in state and \
+                hasattr(self.optimizer, "set_state_dict"):
+            self.optimizer.set_state_dict(state["optimizer"])
+        return last + 1
+
+    def _restore_legacy(self) -> int:
+        """Pre-manager two-file layout (meta.json + .pdparams/.pdopt)."""
         if not os.path.exists(self._meta):
             return 0
         with open(self._meta) as f:
@@ -61,28 +88,50 @@ class TrainEpochRange:
         return epoch
 
     def _save(self, epoch: int):
-        import paddle_tpu as pt
-        os.makedirs(self._dir, exist_ok=True)
+        state = {}
         if self.model is not None:
-            pt.save(self.model.state_dict(),
-                    os.path.join(self._dir, "model.pdparams"))
+            state["model"] = self.model.state_dict()
         if self.optimizer is not None and hasattr(self.optimizer,
                                                   "state_dict"):
-            pt.save(self.optimizer.state_dict(),
-                    os.path.join(self._dir, "opt.pdopt"))
+            state["optimizer"] = self.optimizer.state_dict()
+        # overwrite: after a corruption fallback (or legacy resume) the
+        # epoch being re-run may still have a committed-but-corrupt step
+        # on disk; the re-save must replace it, not die on a collision
+        self._mgr.save(epoch, state, overwrite=True,
+                       metadata={"epoch": epoch,
+                                 "max_epoch_num": self.max_epoch_num})
+        # meta.json mirror — written only after the step committed (async
+        # saves defer it to wait()/the next epoch's save), so meta can
+        # never point at state that does not durably exist
+        self._write_meta()
+
+    def _write_meta(self):
+        last = self._mgr.latest_step()
+        if last is None:
+            return
         tmp = self._meta + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"epoch": epoch,
+            json.dump({"epoch": last,
                        "max_epoch_num": self.max_epoch_num}, f)
         os.replace(tmp, self._meta)  # atomic: a crash never corrupts meta
 
+    def wait(self):
+        """Drain in-flight async saves and sync the meta mirror."""
+        self._mgr.wait_all()
+        self._write_meta()
+
     # -- iteration -----------------------------------------------------------
     def __iter__(self) -> Iterator[int]:
-        for epoch in range(self.restored_from, self.max_epoch_num):
-            yield epoch
-            if (epoch + 1) % self.inter == 0 or \
-                    epoch == self.max_epoch_num - 1:
-                self._save(epoch)
+        try:
+            for epoch in range(self.restored_from, self.max_epoch_num):
+                yield epoch
+                if (epoch + 1) % self.inter == 0 or \
+                        epoch == self.max_epoch_num - 1:
+                    self._save(epoch)
+        finally:
+            # runs on early break/GeneratorExit too: in-flight async
+            # saves must not be silently lost on the daemon writer thread
+            self.wait()
 
 
 def train_epoch_range(max_epoch_num: int, save_checkpoint_inter: int = 1,

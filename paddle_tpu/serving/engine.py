@@ -87,11 +87,14 @@ class ServingEngine:
 
     def __init__(self, model, max_batch: int = 8, max_blocks: int = 64,
                  block_size: int = 16, prefill_chunk: int = 16,
-                 max_blocks_per_seq: Optional[int] = None):
+                 max_blocks_per_seq: Optional[int] = None,
+                 warm_start_from: Optional[str] = None):
         from paddle_tpu.jit.functional import functional_state
         from paddle_tpu.models.generation import decode_surfaces
 
         model.eval()
+        if warm_start_from is not None:
+            self._load_into_model(model, warm_start_from)
         self.model = model
         cfg = model.cfg
         train, frozen, buffers = functional_state(model)
@@ -134,6 +137,46 @@ class ServingEngine:
         self._handles = {}  # req_id -> RequestHandle
         self._published_preemptions = 0
         self._init_metrics()
+
+    # -- weights -----------------------------------------------------------
+    @staticmethod
+    def _load_into_model(model, path: str, step: Optional[int] = None):
+        import os
+        from paddle_tpu.framework.io import load
+        if os.path.isdir(path):
+            from paddle_tpu.checkpoint import load_state_dir
+            state = load_state_dir(path, step=step)
+        else:
+            state = load(path)
+        # training checkpoints hold {"model": ..., "optimizer": ...};
+        # serving only wants the model half (flat state_dicts key by
+        # qualified param name, never a bare "model" dict)
+        if isinstance(state, dict) and isinstance(state.get("model"), dict):
+            state = state["model"]
+        model.set_state_dict(state)
+
+    def load_weights(self, path: str, step: Optional[int] = None):
+        """Warm-start: swap in weights from a checkpoint — a training
+        ``CheckpointManager`` directory (latest or explicit ``step``), a
+        single ``step_N`` dir, or a flat ``.pdparams`` file. The compiled
+        prefill/decode executables are untouched (the state dict is a
+        traced input, same shapes/dtypes), so no recompilation happens —
+        this is the serving warm-start seam (docs/CHECKPOINT.md).
+
+        Refuses while requests are in flight: their KV cache was computed
+        under the old weights, and decoding on would silently garble the
+        rest of their output — ``drain()`` first."""
+        from paddle_tpu.jit.functional import functional_state
+        with self._lock:
+            active = self.scheduler.num_running + self.scheduler.num_waiting
+            if active:
+                raise RuntimeError(
+                    f"cannot swap weights with {active} request(s) in "
+                    f"flight (their KV cache predates the new weights); "
+                    f"drain() the engine first")
+            self._load_into_model(self.model, path, step)
+            train, frozen, buffers = functional_state(self.model)
+            self._st = {**train, **frozen, **buffers}
 
     # -- compiled steps ----------------------------------------------------
     def _build_steps(self):
