@@ -520,6 +520,99 @@ def bench_ckpt():
     return out
 
 
+def _chaos_worker():
+    """Trainer side of ``--chaos`` (launched under the elastic launcher):
+    a tiny resilient fit — FitResilience checkpointing every step and
+    resuming from ``latest_step`` on relaunch — that appends one JSON
+    line per completed step, so the parent can reconstruct the kill /
+    recovery timeline from the file alone."""
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.resilience import FitResilience
+
+    run_dir = os.environ["BENCH_CHAOS_DIR"]
+    target = int(os.environ.get("BENCH_CHAOS_STEPS", "12"))
+    steps_path = os.path.join(run_dir, "steps.jsonl")
+
+    model = pt.hapi.Model(nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                        nn.Linear(16, 1)))
+    model.prepare(pt.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters()),
+                  nn.MSELoss())
+    fr = FitResilience(checkpoint_dir=os.path.join(run_dir, "ckpt"),
+                       save_every_steps=1, preemption=True)
+    resumed = fr.restore(model)
+
+    class Progress(pt.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            with open(steps_path, "a") as f:
+                f.write(json.dumps({"gs": fr.global_step,
+                                    "pid": os.getpid(),
+                                    "t": time.time()}) + "\n")
+
+    remaining = target - (resumed or 0)
+    if remaining > 0:
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(4, 8).astype(np.float32),
+                 rng.randn(4, 1).astype(np.float32)) for _ in range(4)]
+        model.fit(data, epochs=(remaining + len(data) - 1) // len(data),
+                  num_iters=remaining, verbose=0,
+                  callbacks=[fr, Progress()])
+    fr.exit_if_preempted()
+
+
+def bench_chaos():
+    """Chaos/MTTR bench (--chaos): run the resilient worker under the
+    elastic launcher, SIGKILL it mid-run through the chaos harness
+    (``PADDLE_TPU_CHAOS_KILL_AT_STEP``), and measure recovery end to
+    end: mean time to recovery (gap between the last step before the
+    kill and the first step after the relaunch — dominated by process
+    start + jax import + restore), steps lost to the async-save window,
+    and whether the run still reached its target step count. Results
+    ride the ``--emit-metrics`` JSON schema."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    kill_step = int(os.environ.get("BENCH_CHAOS_KILL_STEP", "5"))
+    target = int(os.environ.get("BENCH_CHAOS_STEPS", "12"))
+    run_dir = tempfile.mkdtemp(prefix="pt_chaos_bench_")
+    env = dict(os.environ)
+    env.update({
+        "BENCH_CHAOS_DIR": run_dir,
+        "BENCH_CHAOS_STEPS": str(target),
+        "PADDLE_TPU_CHAOS_KILL_AT_STEP": str(kill_step),
+        "PADDLE_TPU_CHAOS_MARK_DIR": run_dir,  # kill fires once per job
+    })
+    try:
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--max_restarts", "2", os.path.abspath(__file__),
+             "--chaos-worker"],
+            env=env, timeout=600)
+        elapsed = time.perf_counter() - t0
+        steps = []
+        with open(os.path.join(run_dir, "steps.jsonl")) as f:
+            steps = [json.loads(line) for line in f if line.strip()]
+        pids = list(dict.fromkeys(s["pid"] for s in steps))
+        out = {"target_steps": target, "kill_step": kill_step,
+               "elapsed_s": round(elapsed, 2),
+               "launcher_rc": proc.returncode,
+               "restarts": len(pids) - 1,
+               "completed": bool(steps) and steps[-1]["gs"] >= target}
+        if len(pids) >= 2:
+            boundary = next(i for i, s in enumerate(steps)
+                            if s["pid"] == pids[1])
+            last_before, first_after = steps[boundary - 1], steps[boundary]
+            out["mttr_s"] = round(first_after["t"] - last_before["t"], 2)
+            # steps re-run because the kill outran the async commit
+            out["steps_lost"] = last_before["gs"] + 1 - first_after["gs"]
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+    return out
+
+
 def bench_eager():
     """Eager-dispatch overhead — SURVEY §7's #1 risk ('per-op eager
     dispatch is untenable'), finally measured (reference ships the
@@ -602,6 +695,10 @@ def bench_eager():
 
 
 def main():
+    if "--chaos-worker" in sys.argv:
+        _chaos_worker()
+        return
+
     import jax
 
     metrics_out = _metrics_out_path()
@@ -639,6 +736,13 @@ def main():
         print(json.dumps({"ckpt": ckpt}))
         if metrics_out:
             emit_metrics({"ckpt": ckpt}, metrics_out)
+        return
+
+    if "--chaos" in sys.argv:
+        chaos = bench_chaos()
+        print(json.dumps({"chaos": chaos}))
+        if metrics_out:
+            emit_metrics({"chaos": chaos}, metrics_out)
         return
 
     on_tpu = jax.default_backend() == "tpu"

@@ -55,6 +55,11 @@ class GradScaler:
             found = found | jnp.any(~jnp.isfinite(g))
             p.grad = Tensor(g, stop_gradient=True)
         self._found_inf = bool(found)
+        if self._found_inf:
+            # skipped-scale steps and NaNGuard rollbacks share ONE
+            # resilience_nonfinite_total family (docs/RESILIENCE.md)
+            from paddle_tpu.resilience.counters import record_nonfinite
+            record_nonfinite("grad_scaler")
         self._unscaled = True
 
     def step(self, optimizer):

@@ -27,6 +27,21 @@ from .tcp_store import TCPStore
 
 __all__ = ["launch", "ElasticManager", "main"]
 
+#: Trainers exiting with this code were PREEMPTED and committed a final
+#: checkpoint (resilience.preemption contract): the launcher relaunches
+#: them — they resume from ``CheckpointManager.latest_step`` — without
+#: consuming the ``max_restarts`` crash budget.
+from paddle_tpu.resilience.preemption import (  # noqa: E402
+    RESUMABLE_EXIT_CODE, preempt_stop_key)
+
+_RESUME_GRACE = 60.0   # wait this long for peers' coordinated final saves
+
+
+def _max_resumes(value: Optional[int]) -> int:
+    if value is not None:
+        return int(value)
+    return int(os.environ.get("PADDLE_TPU_MAX_RESUMES", "8"))
+
 
 class ElasticManager:
     """Store-backed membership (reference: elastic/manager.py:126 —
@@ -93,7 +108,8 @@ def launch(script: str, script_args: Optional[List[str]] = None,
            nproc_per_node: int = 1, master: Optional[str] = None,
            max_restarts: int = 0, log_dir: Optional[str] = None,
            node_rank: int = 0, nnodes: int = 1,
-           np_range: Optional[tuple] = None) -> int:
+           np_range: Optional[tuple] = None,
+           max_resumes: Optional[int] = None) -> int:
     """Spawn ``nproc_per_node`` trainer processes with reference-compatible
     env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER) and
     restart-on-failure up to ``max_restarts`` (elastic relaunch).
@@ -123,6 +139,15 @@ def launch(script: str, script_args: Optional[List[str]] = None,
     worker announcing itself — or an operator) triggers one more
     membership change back up to max. Below min the job fails. Scale
     events do not consume the ``max_restarts`` crash budget.
+
+    PREEMPTION (docs/RESILIENCE.md): trainers exiting with
+    ``RESUMABLE_EXIT_CODE`` committed a final checkpoint first — the
+    launcher waits (bounded) for the coordinated exit of all ranks, then
+    relaunches WITHOUT consuming ``max_restarts``; the relaunched
+    trainers resume from ``latest_step``. ``max_resumes`` (default
+    ``$PADDLE_TPU_MAX_RESUMES`` or 8) bounds the loop — past it the
+    launcher itself exits with the resumable code, surfacing "this job
+    keeps getting preempted" to the operator.
     """
     script_args = script_args or []
     np_min, np_max = np_range if np_range else (None, None)
@@ -175,10 +200,13 @@ def launch(script: str, script_args: Optional[List[str]] = None,
     if np_range is not None and nnodes > 1:
         return _elastic_multinode(script, script_args, master_addr, store,
                                   nnodes, node_rank, np_min, np_max,
-                                  max_restarts, log_dir)
+                                  max_restarts, log_dir,
+                                  _max_resumes(max_resumes))
 
     epoch = int(store.add("__restart_epoch", 0))
     attempts = 0  # local relaunch budget (epoch can over-bump on races)
+    resumes = 0   # preemption relaunch budget (separate from crashes)
+    resume_budget = _max_resumes(max_resumes)
     cur_np = nproc_per_node  # this epoch's local trainer count (elastic)
     scale_seen = int(store.add("__scale_out", 0))
     while True:
@@ -212,10 +240,29 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         # (elastic) the scale-out request counter
         fail_code = None
         scale_event = None  # "in" | "out"
+        resume_event = False
         while True:
             codes = [p.poll() for p in procs]
             if any(c not in (None, 0) for c in codes):
-                fail_code = next(c for c in codes if c not in (None, 0))
+                nonzero = [c for c in codes if c not in (None, 0)]
+                if all(c == RESUMABLE_EXIT_CODE for c in nonzero):
+                    # preempted trainers coordinate a final blocking save
+                    # and exit together — give the stragglers a bounded
+                    # window before deciding this was a resumable stop
+                    deadline = time.monotonic() + _RESUME_GRACE
+                    while any(p.poll() is None for p in procs) and \
+                            time.monotonic() < deadline:
+                        time.sleep(0.1)
+                    codes = [p.poll() for p in procs]
+                    if all(c in (0, RESUMABLE_EXIT_CODE) for c in codes):
+                        resume_event = True
+                        if int(store.add("__restart_epoch", 0)) == epoch:
+                            store.add("__restart_epoch", 1)
+                        break
+                fail_code = next(
+                    (c for c in codes
+                     if c not in (None, 0, RESUMABLE_EXIT_CODE)),
+                    RESUMABLE_EXIT_CODE)
                 if np_range:
                     survivors = sum(1 for c in codes if c is None)
                     if survivors >= np_min:
@@ -248,6 +295,30 @@ def launch(script: str, script_args: Optional[List[str]] = None,
                         break
             time.sleep(0.2)
 
+        if fail_code is None and scale_event is None and not resume_event \
+                and int(store.add("__restart_epoch", 0)) > epoch:
+            # a PEER bumped the epoch before our own trainers' exit codes
+            # were read. If this epoch carries a preemption verdict (the
+            # consensus stop key the listeners publish), our trainers are
+            # mid-final-save and about to exit resumable: give them the
+            # grace window and classify the event as a resume, not a
+            # crash that eats max_restarts
+            try:
+                preempt_verdict = store.get(
+                    preempt_stop_key(epoch)) is not None
+            except Exception:
+                preempt_verdict = False
+            if preempt_verdict:
+                deadline = time.monotonic() + _RESUME_GRACE
+                while any(p.poll() is None for p in procs) and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.1)
+                codes = [p.poll() for p in procs]
+                if codes and any(c == RESUMABLE_EXIT_CODE for c in codes) \
+                        and all(c in (0, RESUMABLE_EXIT_CODE)
+                                for c in codes):
+                    resume_event = True
+
         for p in procs:
             if p.poll() is None:
                 p.terminate()
@@ -256,7 +327,33 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         for lf in logs:
             lf.close()
 
+        final_codes = [p.returncode for p in procs]
+        if not resume_event and final_codes and \
+                any(c == RESUMABLE_EXIT_CODE for c in final_codes) and \
+                all(c in (0, RESUMABLE_EXIT_CODE) for c in final_codes):
+            # every trainer ultimately left cleanly or resumable: this was
+            # a coordinated preemption stop regardless of what the
+            # supervise loop concluded mid-flight (a straggler's blocking
+            # final save outlasting the grace window can masquerade as a
+            # scale-in or crash) — resume at FULL size, spend the resume
+            # budget, leave max_restarts alone
+            resume_event = True
+            scale_event = None
+            fail_code = None
+            cur_np = len(procs)
+
         new_epoch = int(store.add("__restart_epoch", 0))
+        if resume_event:
+            # preemption stop, checkpoint committed: relaunch (trainers
+            # resume from latest_step) without consuming max_restarts
+            resumes += 1
+            if resumes > resume_budget:
+                return _exit(RESUMABLE_EXIT_CODE)
+            if new_epoch == epoch:
+                store.add("__restart_epoch", 1)
+                new_epoch = int(store.add("__restart_epoch", 0))
+            epoch = new_epoch
+            continue
         if scale_event is not None:
             # membership change, not a crash: rewrite env and relaunch the
             # survivors at the new size without consuming max_restarts.
@@ -298,7 +395,8 @@ _CLAIM_TIMEOUT = 40.0  # a won-but-unpublished claim (claimer died mid-
 
 
 def _elastic_multinode(script, script_args, master_addr, store, nnodes,
-                       node_rank, np_min, np_max, max_restarts, log_dir):
+                       node_rank, np_min, np_max, max_restarts, log_dir,
+                       resume_budget=8):
     """Cluster-wide elastic membership (reference:
     fleet/elastic/manager.py:126 — etcd-leased node registry with a leader
     deciding the world; here the TCPStore is the registry).
@@ -317,7 +415,7 @@ def _elastic_multinode(script, script_args, master_addr, store, nnodes,
     try:
         return _elastic_multinode_loop(
             script, script_args, master_addr, store, nnodes, node_rank,
-            np_min, np_max, max_restarts, log_dir)
+            np_min, np_max, max_restarts, log_dir, resume_budget)
     except (ConnectionError, OSError) as e:
         # only claim "store lost" when the store actually IS unreachable —
         # a FileNotFoundError from Popen or a log-dir PermissionError must
@@ -333,10 +431,11 @@ def _elastic_multinode(script, script_args, master_addr, store, nnodes,
 
 def _elastic_multinode_loop(script, script_args, master_addr, store,
                             nnodes, node_rank, np_min, np_max,
-                            max_restarts, log_dir):
+                            max_restarts, log_dir, resume_budget=8):
     epoch = int(store.add("__restart_epoch", 0))
     scale_seen = int(store.add("__scale_out", 0))
     attempts = 0
+    resumes = 0
 
     def mn_exit(code, cur_epoch, members):
         """Membership-scoped exit sync: acks are keyed by (epoch, node) so
@@ -532,7 +631,13 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
                     return mn_exit(0, epoch, members)
                 time.sleep(0.2)
 
-        if fail_code is not None:
+        if fail_code == RESUMABLE_EXIT_CODE:
+            # preempted-with-checkpoint (resilience contract): rejoin the
+            # next membership round without consuming the crash budget
+            resumes += 1
+            if resumes > resume_budget:
+                return mn_exit(RESUMABLE_EXIT_CODE, epoch, [])
+        elif fail_code is not None:
             attempts += 1
             if attempts > max_restarts:
                 # exit immediately: surviving members are CONTINUING (they
@@ -561,13 +666,18 @@ def main(argv=None):
     parser.add_argument("--np", type=str, default=None, dest="np_arg",
                         help="elastic trainer-count bounds: N or min:max "
                              "(reference fleet/elastic --np)")
+    parser.add_argument("--max_resumes", type=int, default=None,
+                        help="preemption relaunch budget (trainers exiting "
+                             "with the resumable code; default "
+                             "$PADDLE_TPU_MAX_RESUMES or 8)")
     parser.add_argument("script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     return launch(args.script, args.script_args, args.nproc_per_node,
                   args.master, args.max_restarts, args.log_dir,
                   args.node_rank, args.nnodes,
-                  np_range=parse_np(args.np_arg))
+                  np_range=parse_np(args.np_arg),
+                  max_resumes=args.max_resumes)
 
 
 if __name__ == "__main__":

@@ -37,15 +37,26 @@ def _load_lib():
         build = os.path.join(_native_dir(), "build")
         os.makedirs(build, exist_ok=True)
         so = os.path.join(build, "libtcp_store.so")
-        if not os.path.exists(so) or \
-                os.path.getmtime(so) < os.path.getmtime(src):
+
+        def compile_so():
             tmp = so + f".tmp{os.getpid()}"
             subprocess.run(
                 ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                  src, "-o", tmp],
                 check=True, capture_output=True)
             os.replace(tmp, so)
-        lib = ctypes.CDLL(so)
+
+        if not os.path.exists(so) or \
+                os.path.getmtime(so) < os.path.getmtime(src):
+            compile_so()
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            # a prebuilt .so from another toolchain (e.g. newer glibc)
+            # dlopen-fails even though it is up to date — rebuild against
+            # THIS host and retry; raise only if the fresh build fails too
+            compile_so()
+            lib = ctypes.CDLL(so)
         lib.tcp_store_server_start.restype = ctypes.c_void_p
         lib.tcp_store_server_start.argtypes = [
             ctypes.c_uint16, ctypes.POINTER(ctypes.c_uint16)]

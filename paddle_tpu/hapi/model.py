@@ -361,10 +361,24 @@ class Model:
                 cb.on_train_end()
         return history
 
+    @staticmethod
+    def _maybe_chaos():
+        """The resilience chaos harness (docs/RESILIENCE.md), active only
+        when a PADDLE_TPU_CHAOS_* env var is set — launched workers under
+        fault-injection tests pick it up with zero cost to normal fits."""
+        import os
+        if not any(k.startswith("PADDLE_TPU_CHAOS_") and v
+                   for k, v in os.environ.items()):
+            return None
+        from paddle_tpu.resilience import chaos
+        chaos.refresh()
+        return chaos
+
     def _fit_loop(self, loader, eval_data, batch_size, epochs, eval_freq,
                   save_dir, save_freq, num_workers, callbacks, num_iters,
                   history, _time):
         step = 0
+        chaos = self._maybe_chaos()
         for epoch in range(epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
@@ -382,18 +396,30 @@ class Model:
                          "batch_size": int(shape[0]) if shape else None}
                 for cb in callbacks:
                     cb.on_train_batch_begin(step + 1, blogs)
+                if chaos is not None:
+                    x = chaos.poison_batch(step + 1, x)
                 loss = self.train_batch(x, y)[0]
+                if chaos is not None:
+                    loss = chaos.corrupt_loss(step + 1, loss)
                 epoch_losses.append(loss)
                 step += 1
                 logs = {"loss": loss}
                 for cb in callbacks:
                     cb.on_train_batch_end(step, logs)
+                if chaos is not None:
+                    chaos.kill_at_step(step)
+                if self._stop_training:
+                    # mid-epoch stop (preemption listener, NaN-guard
+                    # give-up, user callback): leave at a step boundary
+                    # without waiting for the epoch to drain
+                    break
                 if num_iters is not None and step >= num_iters:
                     break
                 t_fetch = _time.perf_counter()
             logs = {"loss": float(np.mean(epoch_losses))}
             history["loss"].append(logs["loss"])
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+            if eval_data is not None and not self._stop_training and \
+                    (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_data, batch_size,
                                           verbose=0,
                                           num_workers=num_workers)

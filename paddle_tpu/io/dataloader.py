@@ -12,6 +12,7 @@ reference pays a whole shm/queue subsystem to manage.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 from typing import Callable, Optional
@@ -43,6 +44,69 @@ def default_collate_fn(batch):
 class _WorkerError:
     def __init__(self, exc):
         self.exc = exc
+
+
+_SKIP = object()  # sentinel: a sample dropped by the bad-sample budget
+
+
+class _BadSampleBudget:
+    """Bounded retry-then-skip policy over sample fetch/collate
+    (docs/RESILIENCE.md): a corrupt shard or a flaky object-store read
+    must not kill the epoch, but an unbounded skip policy would silently
+    train on a shrinking dataset. Each failing fetch is retried once
+    (transient IO), then skipped and counted against the budget
+    (``PADDLE_TPU_LOADER_MAX_BAD_SAMPLES`` / ``max_bad_samples``) and
+    into the ``loader_bad_samples_total`` registry counter; exhausting
+    the budget raises loudly with the LAST underlying error chained."""
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self.used = 0
+        # the thread-pool fetch path spends from worker threads
+        # concurrently; an unlocked += could lose increments and let the
+        # budget-exhausted failure never fire
+        self._lock = threading.Lock()
+
+    def fetch(self, ds, i):
+        try:
+            return ds[i]
+        except Exception:
+            try:
+                return ds[i]  # one retry: transient IO heals here
+            except Exception as e:
+                self._spend("fetch", f"dataset[{i!r}]", e)
+                return _SKIP
+
+    def collate(self, collate_fn, batch):
+        try:
+            return collate_fn(batch)
+        except Exception as e:
+            self._spend("collate", f"batch of {len(batch)}", e)
+            return _SKIP
+
+    def _spend(self, stage: str, what: str, exc: Exception):
+        with self._lock:
+            self.used += 1
+            used = self.used
+        try:
+            from paddle_tpu.observability.metrics import get_registry
+            get_registry().counter(
+                "loader_bad_samples_total",
+                "samples/batches skipped by the bad-sample budget",
+            ).inc(stage=stage)
+        except Exception:
+            pass
+        import warnings
+        warnings.warn(
+            f"[dataloader] skipping bad {stage} ({what}): {exc!r} "
+            f"[{used}/{self.limit} budget used]",
+            RuntimeWarning, stacklevel=3)
+        if used > self.limit:
+            raise RuntimeError(
+                f"DataLoader bad-sample budget exhausted: {used} "
+                f"failures exceed PADDLE_TPU_LOADER_MAX_BAD_SAMPLES="
+                f"{self.limit}; last failure at {stage} of {what}"
+            ) from exc
 
 
 class _Prefetcher:
@@ -206,14 +270,23 @@ class DataLoader:
                  None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, use_process_workers=False):
+                 worker_init_fn=None, use_process_workers=False,
+                 max_bad_samples=None):
         """``use_process_workers=True`` runs the ``num_workers`` pool as
         forked SUBPROCESSES (reference ``fluid/dataloader/worker.py``
         semantics) instead of threads: GIL-bound Python transforms (image
         decode/augment for the PP-OCR/DiT families) scale with workers.
         Map-style datasets only; the dataset must be fork-safe and must
-        not touch jax in ``__getitem__``."""
+        not touch jax in ``__getitem__``.
+
+        ``max_bad_samples`` (default: ``$PADDLE_TPU_LOADER_MAX_BAD_SAMPLES``,
+        0 = off) turns on the bounded retry-then-skip fault policy over
+        sample fetch and collate for the in-process iteration paths (see
+        :class:`_BadSampleBudget`; the subprocess pool keeps its own
+        fail-fast worker semantics)."""
         self.dataset = dataset
+        self.max_bad_samples = max_bad_samples
+        self._bad_budget: Optional[_BadSampleBudget] = None
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.use_process_workers = bool(use_process_workers)
@@ -252,13 +325,42 @@ class DataLoader:
                     drop_last=drop_last)
 
     # -- iteration paths -------------------------------------------------------
+    def _budget(self) -> Optional[_BadSampleBudget]:
+        # one budget for the LOADER's lifetime, not per epoch: a re-created
+        # budget would reset every __iter__ and the exhaustion failure
+        # could never fire across a multi-epoch fit
+        if self._bad_budget is None:
+            limit = self.max_bad_samples
+            if limit is None:
+                limit = int(os.environ.get(
+                    "PADDLE_TPU_LOADER_MAX_BAD_SAMPLES", "0") or 0)
+            if int(limit) > 0:
+                self._bad_budget = _BadSampleBudget(limit)
+        return self._bad_budget
+
     def _iter_map_style(self):
         ds, collate = self.dataset, self.collate_fn
+        budget = self._budget()
+        fetch = ds.__getitem__ if budget is None \
+            else (lambda i: budget.fetch(ds, i))
+
+        def finish(samples):
+            """Collate one batch under the budget; _SKIP drops the batch
+            (every sample bad, or the collate itself failed)."""
+            samples = [s for s in samples if s is not _SKIP]
+            if budget is None:
+                return collate(samples)
+            if not samples:
+                return _SKIP
+            return budget.collate(collate, samples)
+
         if self.batch_sampler is None:
             # batch_size=None: deliver samples un-stacked (paddle contract),
             # honoring shuffle via the un-batched sampler
             for i in self._unbatched_sampler:
-                yield ds[i]
+                s = fetch(i)
+                if s is not _SKIP:
+                    yield s
             return
         if self.use_process_workers and self.num_workers >= 1:
             pool = _ProcessPool(ds, collate, self.num_workers,
@@ -267,7 +369,9 @@ class DataLoader:
             return
         if self.num_workers <= 1:
             for batch_idx in self.batch_sampler:
-                yield collate([ds[i] for i in batch_idx])
+                out = finish([fetch(i) for i in batch_idx])
+                if out is not _SKIP:
+                    yield out
             return
         # thread pool: fetch items of a batch concurrently, keep batch order
         from concurrent.futures import ThreadPoolExecutor
@@ -276,13 +380,17 @@ class DataLoader:
             batches = iter(self.batch_sampler)
             window = []
             for batch_idx in itertools.islice(batches, 2):
-                window.append(pool.map(ds.__getitem__, batch_idx))
+                window.append(pool.map(fetch, batch_idx))
             for batch_idx in batches:
                 done = window.pop(0)
-                window.append(pool.map(ds.__getitem__, batch_idx))
-                yield collate(list(done))
+                window.append(pool.map(fetch, batch_idx))
+                out = finish(list(done))
+                if out is not _SKIP:
+                    yield out
             for done in window:
-                yield collate(list(done))
+                out = finish(list(done))
+                if out is not _SKIP:
+                    yield out
 
     def _iter_iterable(self):
         from .sampler import _chunked

@@ -30,6 +30,14 @@ __all__ = ["comm_scope", "comm_event", "payload_bytes", "comm_totals"]
 
 _metrics_cache = None
 
+#: Resilience seams (docs/RESILIENCE.md), installed from outside so this
+#: hot path never imports the resilience package: a
+#: ``resilience.Watchdog`` with ``watch_collectives()`` active arms a
+#: deadline around every span; ``resilience.chaos.refresh()`` installs a
+#: hang-injection hook. Both are one module-attribute read when unused.
+_collective_watchdog = None
+_chaos_hook = None
+
 
 def _metrics():
     """The three per-collective counters, resolved once (they live in the
@@ -99,14 +107,25 @@ def _emit(op: str, axes_label: str, nbytes: int, t0: int, t1: int,
 def comm_scope(op: str, axes: Sequence[str], payload=None,
                nbytes: Optional[int] = None, extra: Optional[dict] = None):
     """Span around one collective. Records even when the body raises — a
-    failed collective is exactly what the flight recorder must show."""
+    failed collective is exactly what the flight recorder must show. A
+    collective-armed watchdog puts its deadline around the whole span
+    (chaos-injected hangs included: a wedged collective is precisely the
+    event the deadline exists to catch)."""
     nbytes = payload_bytes(payload) if nbytes is None else int(nbytes)
+    axes_label = _axes_label(axes)
+    wd = _collective_watchdog
+    token = None if wd is None else wd.arm(
+        f"collective:{op}@{axes_label}", wd.collective_timeout)
     t0 = time.perf_counter_ns()
     try:
+        hook = _chaos_hook
+        if hook is not None:
+            hook(op, axes_label)
         yield
     finally:
-        _emit(op, _axes_label(axes), nbytes, t0, time.perf_counter_ns(),
-              extra)
+        if wd is not None:
+            wd.disarm(token)
+        _emit(op, axes_label, nbytes, t0, time.perf_counter_ns(), extra)
 
 
 def comm_event(op: str, axes: Sequence[str], payload=None,

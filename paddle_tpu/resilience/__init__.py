@@ -1,0 +1,27 @@
+"""Fault-tolerance layer (docs/RESILIENCE.md).
+
+Four cooperating pieces, each usable standalone and composed by
+:class:`FitResilience` for ``Model.fit``:
+
+* :mod:`~paddle_tpu.resilience.preemption` — SIGTERM/notice listener,
+  coordinated final checkpoint, :data:`RESUMABLE_EXIT_CODE` contract
+  with the elastic launcher.
+* :mod:`~paddle_tpu.resilience.watchdog` — monotonic-deadline hang
+  watchdog over train steps and traced collectives, with postmortem
+  dumps and a log → dump → kill escalation ladder.
+* :mod:`~paddle_tpu.resilience.nan_guard` — numeric guard with
+  rollback-to-last-committed-checkpoint.
+* :mod:`~paddle_tpu.resilience.chaos` — env-driven fault injection
+  (kill-at-step, hang-collective, poison-batch, corrupt-loss) proving
+  mean-time-to-recovery end to end.
+"""
+from .counters import record_nonfinite  # noqa: F401
+from .preemption import RESUMABLE_EXIT_CODE, PreemptionListener  # noqa: F401
+from .watchdog import Watchdog, WatchdogExpired  # noqa: F401
+from .nan_guard import NaNGuard, NumericError  # noqa: F401
+from .fit import FitResilience  # noqa: F401
+from . import chaos  # noqa: F401
+
+__all__ = ["RESUMABLE_EXIT_CODE", "PreemptionListener", "Watchdog",
+           "WatchdogExpired", "NaNGuard", "NumericError", "FitResilience",
+           "record_nonfinite", "chaos"]

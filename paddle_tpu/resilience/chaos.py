@@ -1,0 +1,144 @@
+"""Chaos-injection harness — env-driven fault seams for proving recovery.
+
+Every fault the resilience layer claims to survive can be *induced* here,
+so multiprocess integration tests and ``bench.py --chaos`` measure real
+mean-time-to-recovery instead of trusting unit tests. All seams are env
+vars (they must cross the launcher's ``subprocess`` boundary) and cost one
+dict lookup per step when unset:
+
+* ``PADDLE_TPU_CHAOS_KILL_AT_STEP=N`` — SIGKILL this process right after
+  fit step ``N`` completes (simulates a hard preemption / host loss).
+* ``PADDLE_TPU_CHAOS_HANG_COLLECTIVE=op[:seconds]`` — the first traced
+  collective whose op name matches sleeps ``seconds`` (default 3600)
+  inside its comm span (simulates a wedged all-reduce; the watchdog's
+  collective deadline should fire first).
+* ``PADDLE_TPU_CHAOS_POISON_BATCH=N[,N...]`` — NaN-fill the input batch
+  of those fit steps (simulates a corrupt shard reaching the device).
+* ``PADDLE_TPU_CHAOS_CORRUPT_LOSS=N[,N...]`` — replace those steps'
+  losses with NaN after the train step (simulates a bf16 blow-up).
+* ``PADDLE_TPU_CHAOS_MARK_DIR=/path`` — fire each event at most once per
+  *job*: a marker file is written before the fault fires, so the
+  relaunched worker that replays the same step numbers does not re-die.
+
+Step numbers are the fit loop's 1-based batch counter. ``refresh()``
+re-reads the env (tests mutate ``os.environ`` in-process); the hapi fit
+loop calls it automatically when any ``PADDLE_TPU_CHAOS_*`` var is set.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["refresh", "enabled", "kill_at_step", "poison_batch",
+           "corrupt_loss", "active_config"]
+
+ENV_KILL = "PADDLE_TPU_CHAOS_KILL_AT_STEP"
+ENV_HANG = "PADDLE_TPU_CHAOS_HANG_COLLECTIVE"
+ENV_POISON = "PADDLE_TPU_CHAOS_POISON_BATCH"
+ENV_CORRUPT = "PADDLE_TPU_CHAOS_CORRUPT_LOSS"
+ENV_MARK_DIR = "PADDLE_TPU_CHAOS_MARK_DIR"
+
+_cfg: dict = {"kill": None, "hang": None, "poison": frozenset(),
+              "corrupt": frozenset(), "mark_dir": None}
+
+
+def _steps(val: Optional[str]) -> frozenset:
+    if not val:
+        return frozenset()
+    return frozenset(int(s) for s in val.split(",") if s.strip())
+
+
+def refresh() -> dict:
+    """Re-read the chaos env; (un)install the collective hang hook."""
+    env = os.environ
+    kill = env.get(ENV_KILL)
+    _cfg["kill"] = int(kill) if kill else None
+    _cfg["poison"] = _steps(env.get(ENV_POISON))
+    _cfg["corrupt"] = _steps(env.get(ENV_CORRUPT))
+    _cfg["mark_dir"] = env.get(ENV_MARK_DIR) or None
+    hang = env.get(ENV_HANG)
+    if hang:
+        op, _, secs = hang.partition(":")
+        _cfg["hang"] = (op, float(secs) if secs else 3600.0)
+    else:
+        _cfg["hang"] = None
+    from paddle_tpu.observability import comm
+    comm._chaos_hook = _hang_hook if _cfg["hang"] else None
+    return dict(_cfg)
+
+
+def active_config() -> dict:
+    return dict(_cfg)
+
+
+def enabled() -> bool:
+    return any(k.startswith("PADDLE_TPU_CHAOS_") and v
+               for k, v in os.environ.items())
+
+
+def _fire_once(event: str) -> bool:
+    """True if ``event`` should fire now; with a mark dir, each event
+    fires at most once per job (the marker survives the process)."""
+    d = _cfg["mark_dir"]
+    if d is None:
+        return True
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"chaos_{event}")
+    if os.path.exists(path):
+        return False
+    with open(path, "w") as f:
+        f.write(f"{os.getpid()} {time.time()}\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return True
+
+
+# -- seams (called from the fit loop / comm_scope) -------------------------
+
+def kill_at_step(step: int):
+    """SIGKILL — no atexit, no finally, no flushed buffers: exactly what a
+    preempted host looks like to the launcher."""
+    if _cfg["kill"] is not None and step == _cfg["kill"] \
+            and _fire_once(f"kill_step{step}"):
+        print(f"[chaos] SIGKILL at step {step}", file=sys.stderr, flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def poison_batch(step: int, x):
+    """NaN-fill float leaves of the batch for a poisoned step."""
+    if step not in _cfg["poison"] or not _fire_once(f"poison_step{step}"):
+        return x
+    return _poison_tree(x)
+
+
+def _poison_tree(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_poison_tree(e) for e in x)
+    arr = np.asarray(getattr(x, "data", x)
+                     if not isinstance(x, np.ndarray) else x)
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.full_like(arr, np.nan)
+    return x
+
+
+def corrupt_loss(step: int, loss: float) -> float:
+    if step in _cfg["corrupt"] and _fire_once(f"corrupt_step{step}"):
+        return float("nan")
+    return loss
+
+
+def _hang_hook(op: str, axes_label: str):
+    """Installed into ``observability.comm._chaos_hook`` by refresh()."""
+    hang = _cfg["hang"]
+    if hang is None or hang[0] != op:
+        return
+    if not _fire_once(f"hang_{op}"):
+        return
+    print(f"[chaos] hanging collective {op}@{axes_label} for {hang[1]}s",
+          file=sys.stderr, flush=True)
+    time.sleep(hang[1])

@@ -1,0 +1,186 @@
+"""FitResilience — the fault-tolerance layer's hapi front door.
+
+One callback that composes the four resilience pieces around
+``Model.fit`` (each is also usable standalone):
+
+* **step checkpointing + resume** — an owned (or provided)
+  :class:`~paddle_tpu.checkpoint.CheckpointManager`; ``save_every_steps``
+  commits model+optimizer atomically as ONE step id (async — the loop
+  pays only the snapshot); :meth:`restore` resumes from ``latest_step``
+  on relaunch and keeps the global-step numbering monotonic.
+* **preemption** — a :class:`~.preemption.PreemptionListener`
+  (SIGTERM/SIGUSR1 + maintenance-notice seam + TCPStore broadcast).
+  When it trips, every rank finishes the in-flight step, takes one final
+  *blocking* synchronized save, and ``fit`` returns with
+  ``exit_code == RESUMABLE_EXIT_CODE`` — call :meth:`exit_if_preempted`
+  (or read ``.exit_code``) in the trainer script so the elastic launcher
+  restarts from the committed step instead of counting a crash.
+* **watchdog** — arms ``step_timeout`` around each train step and
+  ``collective_timeout`` around every traced collective; escalation via
+  ``watchdog_action`` (log → dump → kill).
+* **NaN guard** — loss/grad finiteness + spike window with
+  rollback-to-last-commit (see :class:`~.nan_guard.NaNGuard`).
+
+Chaos seams (``PADDLE_TPU_CHAOS_*``) are refreshed on ``on_train_begin``
+so launched workers pick up their injected faults.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from paddle_tpu.hapi.model import Callback
+
+from .nan_guard import NaNGuard, apply_restored_state
+from .preemption import RESUMABLE_EXIT_CODE, PreemptionListener
+from .watchdog import Watchdog
+
+__all__ = ["FitResilience"]
+
+
+class FitResilience(Callback):
+    def __init__(self, checkpoint_dir: Optional[str] = None, manager=None,
+                 save_every_steps: Optional[int] = None,
+                 keep_last_k: Optional[int] = 3,
+                 preemption: bool = True, listener=None,
+                 step_timeout: Optional[float] = None,
+                 collective_timeout: Optional[float] = None,
+                 watchdog_action: str = "dump",
+                 nan_guard: bool = False, max_rollbacks: int = 3,
+                 spike_window: int = 0, spike_factor: float = 10.0,
+                 registry=None):
+        if manager is None and checkpoint_dir is not None:
+            from paddle_tpu.checkpoint import CheckpointManager
+            manager = CheckpointManager(checkpoint_dir,
+                                        keep_last_k=keep_last_k,
+                                        registry=registry)
+        self.manager = manager
+        self.save_every_steps = save_every_steps
+        self._want_preemption = preemption
+        self.listener = listener
+        self.watchdog: Optional[Watchdog] = None
+        self._step_timeout = step_timeout
+        self._collective_timeout = collective_timeout
+        self._watchdog_action = watchdog_action
+        self.nan_guard: Optional[NaNGuard] = None
+        if nan_guard:
+            self.nan_guard = NaNGuard(manager=self.manager,
+                                      max_rollbacks=max_rollbacks,
+                                      spike_window=spike_window,
+                                      spike_factor=spike_factor,
+                                      registry=registry)
+        self._registry = registry
+        self.preempted = False
+        self.final_step: Optional[int] = None
+        self._step0 = 0          # global-step offset after a resume
+        self._cur_step = 0
+        self._wd_token = None
+        self._installed_listener = False
+
+    # -- resume ------------------------------------------------------------
+    def restore(self, model) -> Optional[int]:
+        """Resume ``model`` (network + optimizer) from the manager's
+        latest committed step; returns the step or None. Call before
+        ``fit`` in a relaunched trainer. Global-step numbering continues
+        from the restored step, so subsequent saves never collide with a
+        *different* committed step's id."""
+        if self.manager is None or self.manager.latest_step() is None:
+            return None
+        state = self.manager.restore()
+        apply_restored_state(model, state)
+        restored = self.manager.last_restored_step
+        meta = self.manager.metadata(restored)
+        self._step0 = int(meta.get("global_step", restored))
+        return restored
+
+    @property
+    def global_step(self) -> int:
+        return self._cur_step
+
+    # -- hooks -------------------------------------------------------------
+    def set_model(self, model):
+        super().set_model(model)
+        if self.nan_guard is not None:
+            self.nan_guard.set_model(model)
+
+    def on_train_begin(self, logs=None):
+        from . import chaos
+        if chaos.enabled():
+            chaos.refresh()
+        if self._want_preemption and self.listener is None:
+            self.listener = PreemptionListener(registry=self._registry)
+        if self.listener is not None and not self._installed_listener:
+            self.listener.install()
+            self._installed_listener = True
+        if self._step_timeout is not None or \
+                self._collective_timeout is not None:
+            self.watchdog = Watchdog(
+                default_timeout=self._step_timeout or 300.0,
+                action=self._watchdog_action, registry=self._registry)
+            if self._collective_timeout is not None:
+                self.watchdog.watch_collectives(self._collective_timeout)
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._cur_step = self._step0 + step
+        if self.watchdog is not None and self._step_timeout is not None:
+            self._wd_token = self.watchdog.arm(
+                "train_step", self._step_timeout, step=self._cur_step)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._wd_token is not None:
+            self.watchdog.disarm(self._wd_token)
+            self._wd_token = None
+        gs = self._cur_step
+        if self.nan_guard is not None:
+            logs = logs or {}
+            self.nan_guard.check(gs, logs.get("loss"),
+                                 logs.get("grad_norm"))
+        if self.manager is not None and self.save_every_steps and \
+                gs % self.save_every_steps == 0:
+            self.manager.save(gs, self._state(),
+                              metadata={"global_step": gs},
+                              overwrite=True)
+        if self.listener is not None and not self.preempted and \
+                self.listener.should_stop(step=gs):
+            self._final_save(gs)
+
+    def on_train_end(self, logs=None):
+        if self.manager is not None:
+            self.manager.wait_all()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self._installed_listener:
+            self.listener.uninstall()
+            self._installed_listener = False
+
+    # -- preemption stop ---------------------------------------------------
+    def _state(self) -> dict:
+        state = {"model": self.model.network.state_dict()}
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None and hasattr(opt, "state_dict"):
+            state["optimizer"] = opt.state_dict()
+        return state
+
+    def _final_save(self, gs: int):
+        """The preemption commit: blocking (the process is about to exit —
+        an async save could be torn by the platform's hard kill), rank-
+        synchronized by the writer's commit barrier, overwriting a
+        periodic save of the same id if one landed this step."""
+        self.preempted = True
+        self.final_step = gs
+        if self.manager is not None:
+            self.manager.save(
+                gs, self._state(), async_=False, overwrite=True,
+                metadata={"global_step": gs, "preempted": True,
+                          "reason": getattr(self.listener, "reason", None)})
+        self.model._stop_training = True
+
+    @property
+    def exit_code(self) -> int:
+        return RESUMABLE_EXIT_CODE if self.preempted else 0
+
+    def exit_if_preempted(self):
+        """Trainer-script epilogue: exit with the launcher's resumable
+        contract when fit stopped on a preemption."""
+        if self.preempted:
+            sys.exit(RESUMABLE_EXIT_CODE)

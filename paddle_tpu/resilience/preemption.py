@@ -1,0 +1,270 @@
+"""Preemption-aware training — catch the eviction notice, checkpoint, exit
+resumable.
+
+TPU pods get preempted with a SIGTERM and (on Cloud) an advance
+"maintenance notice". This module turns those into a *graceful* stop:
+
+* :class:`PreemptionListener` installs SIGTERM/SIGUSR1 handlers that only
+  set a flag — the fit loop finishes the in-flight step, takes one final
+  synchronized blocking ``CheckpointManager.save`` and stops cleanly
+  (wired by :class:`~paddle_tpu.resilience.fit.FitResilience`).
+* A file/env "maintenance notice" seam (``PADDLE_TPU_PREEMPTION_FILE`` /
+  ``PADDLE_TPU_PREEMPTION_NOTICE``) stands in for the cloud metadata
+  server: touch the file (or set the env) and every rank that polls
+  ``should_stop()`` sees the notice without any signal delivery.
+* Multi-rank coordination rides the job TCPStore (the elastic launcher's
+  rendezvous) with a *consensus stop step*: signal/notice delivery is
+  per-rank and per-step polls race, so the first rank to observe one
+  wins an atomic claim (``store.add``) and publishes ``stop_at = its
+  step + 1``; every rank (the announcer included) keeps stepping until
+  its own step reaches ``stop_at`` and stops exactly there. Lockstep
+  SPMD ranks are never a full step apart (each step's collectives
+  synchronize them), so all ranks reach the same boundary and the final
+  save's commit barrier can complete instead of deadlocking on
+  mismatched step ids.
+* :data:`RESUMABLE_EXIT_CODE` is the contract with the elastic launcher:
+  a trainer exiting with it was preempted *after* committing a resumable
+  checkpoint — the launcher relaunches without consuming the crash
+  budget and the trainer resumes from ``latest_step``.
+
+The listener deliberately does NOT chain SIGTERM to a previously
+installed handler: the flight recorder's default SIGTERM behavior is
+dump-then-die, which would kill the process before the graceful save. A
+flight-recorder event is recorded instead, and a recorder enabled *after*
+the listener chains to us on its own.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+__all__ = ["RESUMABLE_EXIT_CODE", "PreemptionListener",
+           "preempt_stop_key"]
+
+#: Exit status meaning "preempted, checkpoint committed, restart me from
+#: latest_step". 79 sits just past the sysexits.h range (64-78) and far
+#: from the signal-death codes (128+n / negative Popen returncodes), so it
+#: can never be confused with a crash.
+RESUMABLE_EXIT_CODE = 79
+
+#: Store key prefix the first preempted rank broadcasts under (namespaced
+#: by the launcher's restart epoch so a resumed attempt never consumes a
+#: previous attempt's stale notice).
+STORE_KEY = "__preempt"
+
+NOTICE_ENV = "PADDLE_TPU_PREEMPTION_NOTICE"
+NOTICE_FILE_ENV = "PADDLE_TPU_PREEMPTION_FILE"
+
+
+def _store_key() -> str:
+    epoch = os.environ.get("PADDLE_RESTART_EPOCH", "0")
+    return f"{STORE_KEY}/{epoch}"
+
+
+def preempt_stop_key(epoch) -> str:
+    """The consensus-verdict key for ``epoch`` — shared with the elastic
+    launcher, which probes it to classify a peer-driven epoch bump as a
+    preemption resume rather than a crash. Single source for the layout:
+    the listener publishes ``{_store_key()}/stop`` and ``_store_key()``
+    is ``{STORE_KEY}/{PADDLE_RESTART_EPOCH}``."""
+    return f"{STORE_KEY}/{epoch}/stop"
+
+
+class PreemptionListener:
+    """Flag-setting preemption observer; poll :meth:`should_stop` at step
+    boundaries.
+
+    ``signals``: handled signal numbers (default SIGTERM + SIGUSR1;
+    handlers install only on the main thread).
+    ``notice_file``: path whose *existence* is the maintenance notice
+    (default: ``$PADDLE_TPU_PREEMPTION_FILE``).
+    ``use_store``: coordinate through the job TCPStore when the launcher
+    env (``PADDLE_MASTER``) is present (default: auto).
+    ``check_interval``: minimum seconds between notice env/file polls
+    inside ``should_stop`` — 0 checks every call. The store poll is NOT
+    throttled: consensus needs every rank to read the stop step every
+    step (a localhost round trip is ~100µs).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGUSR1),
+                 notice_file: Optional[str] = None,
+                 use_store: Optional[bool] = None,
+                 check_interval: float = 0.0,
+                 registry=None):
+        self._signals = tuple(signals)
+        self._notice_file = notice_file
+        self._use_store = use_store
+        self._check_interval = float(check_interval)
+        self._registry = registry
+        # plain bools, not an Event: these are written from signal
+        # context, where taking ANY lock (an Event's condition, the
+        # metrics registry) can deadlock against the interrupted main
+        # thread holding it. GIL-atomic attribute writes are enough —
+        # readers only poll.
+        self._flagged = False
+        self._note_pending = False
+        self.reason: Optional[str] = None
+        self._prev_handlers: dict = {}
+        self._installed = False
+        self._store = None
+        self._store_failed = False
+        self._last_poll = 0.0
+        self._broadcast_done = False
+        self._stop_decided = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def install(self) -> "PreemptionListener":
+        """Install signal handlers (idempotent; main thread only — off the
+        main thread only the notice/store channels are active)."""
+        if self._installed:
+            return self
+        if threading.current_thread() is threading.main_thread():
+            for sn in self._signals:
+                try:
+                    self._prev_handlers[sn] = signal.signal(sn, self._handler)
+                except (ValueError, OSError):
+                    pass
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        for sn, prev in self._prev_handlers.items():
+            try:
+                signal.signal(sn, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers.clear()
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionListener":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+    # -- channels ----------------------------------------------------------
+    def _handler(self, sn, frame):
+        # SIGNAL CONTEXT: plain attribute writes only. The metric bump
+        # and flight-recorder event are deferred to the next
+        # ``should_stop`` poll (_note), like the store broadcast — a
+        # handler that takes the registry lock deadlocks when the signal
+        # interrupts a main thread already holding it (step telemetry,
+        # loader counters, GradScaler all inc every few steps).
+        if not self._flagged:
+            self.reason = signal.Signals(sn).name
+            self._note_pending = True
+            self._flagged = True
+
+    def request(self, reason: str, broadcast: bool = True):
+        """Mark this process preempted (the programmatic seam chaos and
+        tests use; real signals go through the attribute-only handler).
+        The store broadcast is deferred to the next ``should_stop``."""
+        if not self._flagged:
+            self.reason = reason
+            self._note_pending = True
+            self._flagged = True
+        if not broadcast:
+            self._broadcast_done = True
+        self._note()
+
+    def _note(self):
+        """Record the preemption into metrics + flight recorder — called
+        only from ordinary (non-signal) context."""
+        if not self._note_pending:
+            return
+        self._note_pending = False
+        try:
+            from .counters import preemption_counter
+            preemption_counter(self._registry).inc(reason=self.reason)
+        except Exception:
+            pass
+        try:
+            from paddle_tpu.observability import flight_recorder as fr
+            t = time.perf_counter_ns()
+            fr.record(fr.KIND_USER, f"preempt:{self.reason}", t, t)
+        except Exception:
+            pass
+
+    def _job_store(self):
+        if self._store is not None or self._store_failed:
+            return self._store
+        use = self._use_store
+        if use is None:
+            use = bool(os.environ.get("PADDLE_MASTER"))
+        if not use:
+            self._store_failed = True
+            return None
+        try:
+            from paddle_tpu.distributed.tcp_store import job_store
+            self._store = job_store()
+        except Exception:
+            self._store_failed = True
+        return self._store
+
+    def _poll_notice(self):
+        """Maintenance-notice env/file channels (signal channels set the
+        flag directly from the handler)."""
+        if os.environ.get(NOTICE_ENV, "").strip() not in ("", "0"):
+            self.request("notice_env")
+        path = self._notice_file or os.environ.get(NOTICE_FILE_ENV)
+        if path and os.path.exists(path):
+            self.request("notice_file")
+
+    # -- the step-boundary query ------------------------------------------
+    def should_stop(self, step: Optional[int] = None) -> bool:
+        """Poll at a step boundary. ``step`` (the caller's current global
+        step) activates the consensus protocol: with a job store, True
+        only once the cluster-agreed stop step is reached — all ranks
+        return True at the SAME boundary. Without a store (or with
+        ``step=None``) a locally observed preemption stops immediately.
+        """
+        if self._stop_decided:
+            return True
+        self._note()  # metrics/FR for a signal observed since last poll
+        now = time.monotonic()
+        if now - self._last_poll >= self._check_interval:
+            self._last_poll = now
+            self._poll_notice()
+        store = self._job_store()
+        if store is None:
+            self._stop_decided = self._flagged
+            return self._stop_decided
+        try:
+            key = _store_key()
+            if self._flagged and not self._broadcast_done:
+                # exactly one rank (atomic claim) publishes the stop
+                # step: one PAST its own, so lockstep peers still inside
+                # this step learn it before reaching that boundary
+                if int(store.add(key + "/armed", 1)) == 1:
+                    stop_at = 0 if step is None else int(step) + 1
+                    store.set(key + "/stop",
+                              f"{stop_at}:{self.reason or '?'}".encode())
+                self._broadcast_done = True
+            v = store.get(key + "/stop")
+            if v is None:
+                return False
+            stop_s, _, reason = v.decode(errors="replace").partition(":")
+            if not self._flagged:
+                self.request(f"store:{reason}", broadcast=False)
+            stop_at = int(stop_s)
+            if step is None or stop_at == 0 or int(step) >= stop_at:
+                self._stop_decided = True
+            return self._stop_decided
+        except Exception:
+            # the control plane dying must never kill the training step;
+            # fall back to local-only semantics
+            self._store_failed = True
+            self._stop_decided = self._flagged
+            return self._stop_decided
+
+    @property
+    def preempted(self) -> bool:
+        return self._flagged
+
+    def exit_resumable(self):
+        """Terminate with the launcher's resumable contract."""
+        sys.exit(RESUMABLE_EXIT_CODE)

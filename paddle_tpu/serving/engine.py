@@ -418,6 +418,30 @@ class ServingEngine:
         with self._cv:
             self._cv.notify_all()
 
+    def abort(self, req_id: int, reason: str = "aborted") -> bool:
+        """Cancel a queued or in-flight request, releasing its batch slot
+        and KV blocks (a waiting request simply leaves the queue). The
+        graceful-degradation seam (docs/RESILIENCE.md): the HTTP server
+        aborts requests that blew their deadline so abandoned work stops
+        consuming engine capacity. Returns False when the request is
+        unknown or already finished. Safe against a concurrent step():
+        both run under the engine lock, so no plan is in flight."""
+        with self._cv:
+            handle = self._handles.get(req_id)
+            if handle is None:
+                return False
+            seq = handle._req
+            if seq.done:
+                return False
+            if seq in self.scheduler.waiting:
+                self.scheduler.waiting.remove(seq)
+            seq.error = reason
+            # _finish records the request outcome; no extra inc here or
+            # the serving_requests_total family double-counts the abort
+            self._finish(seq, "aborted", RequestState.FAILED)
+            self._update_gauges()
+            return True
+
     def _clear_model_side_effects(self):
         """MoE gates stash ``l_aux`` during traced forwards; drop it so a
         later ``aux_loss()`` can't touch an escaped tracer."""

@@ -17,10 +17,18 @@ Stdlib-only (``http.server`` on daemon threads, mirroring
   ``{"token": id}`` line per generated token as it decodes, then a
   final summary line ``{"done": true, ...}``.
 
-* ``GET /healthz`` — liveness + queue/batch occupancy.
+* ``GET /healthz`` — liveness + queue/batch occupancy; reports
+  ``"status": "degraded"`` while the scheduler queue exceeds
+  ``max_queue_depth``.
 * ``GET /metrics`` / ``GET /metrics.json`` — the observability
   registry's Prometheus-text / JSON expositions (serving_* families
   included; see docs/SERVING.md).
+
+Graceful degradation (docs/RESILIENCE.md): with ``max_queue_depth`` set,
+``POST /generate`` sheds load with ``503 + Retry-After`` instead of
+queueing unboundedly, and each request may carry a ``"deadline_s"``
+budget — the server answers ``504`` when it can't finish in time rather
+than holding the connection to the global timeout.
 """
 from __future__ import annotations
 
@@ -40,11 +48,15 @@ class Server:
     """
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 request_timeout: float = 300.0):
+                 request_timeout: float = 300.0,
+                 max_queue_depth: Optional[int] = None,
+                 retry_after_s: int = 1):
         import http.server
 
         self.engine = engine
         self.request_timeout = request_timeout
+        self.max_queue_depth = max_queue_depth
+        self.retry_after_s = int(retry_after_s)
         server_ref = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -54,13 +66,22 @@ class Server:
                 pass  # keep pytest/example output quiet
 
             # -- helpers ---------------------------------------------------
-            def _json(self, code: int, payload: dict):
+            def _json(self, code: int, payload: dict, headers=None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _overloaded(self):
+                """Queue depth over the shed threshold? (None = never)"""
+                depth = server_ref.max_queue_depth
+                if depth is None:
+                    return False
+                return server_ref.engine.stats()["waiting"] >= depth
 
             def _read_body(self) -> Optional[dict]:
                 try:
@@ -74,7 +95,14 @@ class Server:
                 from paddle_tpu.observability import get_registry
                 if self.path.startswith("/healthz"):
                     stats = server_ref.engine.stats()
-                    self._json(200, {"status": "ok", **stats})
+                    depth = server_ref.max_queue_depth
+                    degraded = depth is not None and \
+                        stats.get("waiting", 0) >= depth
+                    self._json(200, {
+                        "status": "degraded" if degraded else "ok",
+                        **stats,
+                        **({"max_queue_depth": depth}
+                           if depth is not None else {})})
                 elif self.path.startswith("/metrics.json"):
                     self._json(200, get_registry().to_json())
                 elif self.path.startswith("/metrics"):
@@ -92,8 +120,9 @@ class Server:
                 # client disconnects (timeout, ctrl-C, LB retry) are
                 # routine, not errors: swallow the broken pipe instead
                 # of letting socketserver dump a traceback per drop.
-                # NOTE: the engine still decodes the abandoned request
-                # to completion — there is no cancellation path yet.
+                # The request itself is aborted in the engine at the
+                # point the disconnect is detected (_stream_response) or
+                # when its deadline expires (_sync_response).
                 try:
                     super().handle_one_request()
                 except (BrokenPipeError, ConnectionResetError):
@@ -109,6 +138,33 @@ class Server:
                     self._json(400, {"error": "body must be a JSON "
                                      "object with prompt_ids"})
                     return
+                if self._overloaded():
+                    # shed load instead of queueing unboundedly: the
+                    # client (or LB) retries against a recovering server
+                    from paddle_tpu.observability import get_registry
+                    get_registry().counter(
+                        "serving_rejections_total",
+                        "requests shed by graceful degradation",
+                    ).inc(reason="queue_full")
+                    self._json(
+                        503, {"error": "server overloaded: scheduler "
+                              "queue exceeds max_queue_depth "
+                              f"{server_ref.max_queue_depth}"},
+                        headers={"Retry-After":
+                                 str(server_ref.retry_after_s)})
+                    return
+                try:
+                    deadline_s = body.get("deadline_s")
+                    deadline_s = None if deadline_s is None \
+                        else float(deadline_s)
+                    if deadline_s is not None and deadline_s <= 0:
+                        raise ValueError("deadline_s must be > 0")
+                except (TypeError, ValueError) as e:
+                    self._json(400, {"error": f"bad deadline_s: {e}"})
+                    return
+                timeout = server_ref.request_timeout \
+                    if deadline_s is None \
+                    else min(server_ref.request_timeout, deadline_s)
                 stream = bool(body.get("stream", False))
                 tokens_q = queue.Queue() if stream else None
 
@@ -131,22 +187,45 @@ class Server:
                     self._json(400, {"error": str(e)})
                     return
                 if stream:
-                    self._stream_response(handle, tokens_q)
+                    self._stream_response(handle, tokens_q, timeout)
                 else:
-                    self._sync_response(handle)
+                    self._sync_response(handle, timeout)
 
-            def _sync_response(self, handle):
+            def _abort(self, handle):
+                """Deadline blown: cancel the engine-side request so
+                abandoned work stops holding batch slots / KV blocks."""
+                abort = getattr(server_ref.engine, "abort", None)
+                if abort is not None:
+                    try:
+                        abort(handle.req_id, reason="client deadline")
+                    except Exception:
+                        pass  # best-effort; the 504 already went out
+
+            def _sync_response(self, handle, timeout):
                 try:
-                    res = handle.result(server_ref.request_timeout)
+                    res = handle.result(timeout)
                 except TimeoutError:
-                    self._json(504, {"error": "request timed out"})
+                    self._json(504, {"error": "request timed out after "
+                                     f"{timeout}s"})
+                    self._abort(handle)
                     return
                 except RuntimeError as e:
                     self._json(500, {"error": str(e)})
                     return
                 self._json(200, _result_json(res))
 
-            def _stream_response(self, handle, tokens_q):
+            def _stream_response(self, handle, tokens_q, timeout):
+                # a disconnect mid-stream aborts the engine-side request
+                # too: decoding thousands of tokens into a dead socket
+                # would hold a batch slot + KV blocks that live requests
+                # are being 503-shed for
+                try:
+                    self._stream_body(handle, tokens_q, timeout)
+                except (BrokenPipeError, ConnectionResetError):
+                    self._abort(handle)
+                    raise
+
+            def _stream_body(self, handle, tokens_q, timeout):
                 import time as _time
 
                 self.send_response(200)
@@ -159,23 +238,24 @@ class Server:
                     self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
 
                 # INACTIVITY deadline, reset on every token: a healthy
-                # long generation streams past request_timeout; only a
-                # stalled/dead engine goes silent that long
-                deadline = _time.monotonic() + server_ref.request_timeout
+                # long generation streams past the timeout; only a
+                # stalled/dead engine goes silent that long (a
+                # per-request deadline_s tightens it per client)
+                deadline = _time.monotonic() + timeout
                 sent = 0
                 while True:
                     if _time.monotonic() > deadline:
                         chunk({"done": True,
                                "error": "stream stalled: no token for "
-                               f"{server_ref.request_timeout}s"})
+                               f"{timeout}s"})
                         self.wfile.write(b"0\r\n\r\n")
+                        self._abort(handle)
                         return
                     try:
                         tok = tokens_q.get(timeout=0.05)
                         chunk({"token": int(tok)})
                         sent += 1
-                        deadline = _time.monotonic() + \
-                            server_ref.request_timeout
+                        deadline = _time.monotonic() + timeout
                         continue
                     except queue.Empty:
                         pass

@@ -1,0 +1,101 @@
+"""Resilient trainer worker for the chaos/preemption integration tests
+(run via the elastic launcher or directly — NOT a pytest file).
+
+A tiny deterministic fit wrapped in FitResilience. Env contract:
+
+* ``RESILIENCE_TEST_DIR`` — run directory (checkpoints + progress files).
+* ``RESILIENCE_TEST_STEPS`` — target global step count (default 8).
+* ``RESILIENCE_TEST_SELF_PREEMPT_STEP`` — request preemption at this
+  step on a FRESH (non-resumed) run: graceful stop, final commit, exit
+  with the resumable code. A resumed run ignores it (so the launcher's
+  relaunch completes the job).
+* ``RESILIENCE_TEST_STEP_SLEEP`` — seconds of sleep per step (gives the
+  parent time to deliver a real SIGTERM).
+* ``RESILIENCE_TEST_SAVE_EVERY`` — periodic step-checkpoint cadence
+  ("" disables: the only possible commit is the preemption save).
+* ``PADDLE_TPU_CHAOS_*`` — the chaos harness (kill-at-step etc.).
+
+Progress: appends ``{"gs", "pid", "t"}`` lines to ``steps.jsonl``. On
+resume, writes ``resume_<pid>.json`` with the restored step and a sha256
+digest of the restored parameters (the test recomputes the digest from
+the checkpoint itself to prove the restore was bit-identical). On
+reaching the target, writes ``done.json``.
+"""
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def state_digest(named_arrays) -> str:
+    """sha256 over raw bytes of (name, array) in name order — the
+    bit-identical oracle shared with tests/test_resilience.py."""
+    h = hashlib.sha256()
+    for name in sorted(named_arrays):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(np.asarray(named_arrays[name])).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.resilience import FitResilience
+
+    run_dir = os.environ["RESILIENCE_TEST_DIR"]
+    target = int(os.environ.get("RESILIENCE_TEST_STEPS", "8"))
+    self_preempt = os.environ.get("RESILIENCE_TEST_SELF_PREEMPT_STEP")
+    step_sleep = float(os.environ.get("RESILIENCE_TEST_STEP_SLEEP", "0"))
+    save_every = os.environ.get("RESILIENCE_TEST_SAVE_EVERY", "1")
+    steps_path = os.path.join(run_dir, "steps.jsonl")
+
+    pt.seed(7)
+    model = pt.hapi.Model(nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                        nn.Linear(16, 1)))
+    model.prepare(pt.optimizer.SGD(learning_rate=0.05,
+                                   parameters=model.parameters()),
+                  nn.MSELoss())
+    fr = FitResilience(
+        checkpoint_dir=os.path.join(run_dir, "ckpt"),
+        save_every_steps=int(save_every) if save_every else None,
+        keep_last_k=None, preemption=True)
+    resumed = fr.restore(model)
+    if resumed is not None:
+        sd = {k: v.numpy() for k, v in model.network.state_dict().items()}
+        with open(os.path.join(run_dir, f"resume_{os.getpid()}.json"),
+                  "w") as f:
+            json.dump({"resumed_from": resumed,
+                       "digest": state_digest(sd)}, f)
+
+    class Progress(pt.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            with open(steps_path, "a") as f:
+                f.write(json.dumps({"gs": fr.global_step,
+                                    "pid": os.getpid(),
+                                    "t": time.time()}) + "\n")
+            if step_sleep:
+                time.sleep(step_sleep)
+            if self_preempt is not None and resumed is None and \
+                    fr.global_step == int(self_preempt):
+                fr.listener.request("self_test")
+
+    remaining = target - (resumed or 0)
+    if remaining > 0:
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(4, 8).astype(np.float32),
+                 rng.randn(4, 1).astype(np.float32)) for _ in range(4)]
+        model.fit(data, epochs=(remaining + len(data) - 1) // len(data),
+                  num_iters=remaining, verbose=0,
+                  callbacks=[fr, Progress()])
+    if not fr.preempted:
+        with open(os.path.join(run_dir, "done.json"), "w") as f:
+            json.dump({"final_step": fr.global_step or (resumed or 0),
+                       "pid": os.getpid()}, f)
+    fr.exit_if_preempted()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -235,6 +235,28 @@ def test_submit_validation(served):
         eng.submit([1] * 8, max_new_tokens=10_000)
 
 
+def test_abort_releases_queued_request():
+    """Resilience seam (docs/RESILIENCE.md): aborting a request frees
+    its queue entry/slot/blocks and fails the handle — the HTTP server
+    uses this when a request blows its deadline_s. Engine never steps,
+    so no compile cost in tier-1."""
+    model = _tiny(7)
+    eng = ServingEngine(model, max_batch=2, max_blocks=16, block_size=4,
+                        prefill_chunk=4)
+    h1 = eng.submit([1, 2, 3], max_new_tokens=4)
+    h2 = eng.submit([4, 5, 6], max_new_tokens=4)
+    assert eng.abort(h1.req_id, reason="client deadline")
+    assert not eng.abort(h1.req_id)      # already finished: no-op
+    assert not eng.abort(424242)         # unknown id: no-op
+    with pytest.raises(RuntimeError, match="client deadline"):
+        h1.result(1)
+    # the aborted request left the scheduler entirely; the other stays
+    assert h2._req in eng.scheduler.waiting or h2._req.slot is not None
+    assert h1._req not in eng.scheduler.waiting and h1._req.slot is None
+    assert eng.stats()["waiting"] + eng.stats()["running"] == 1
+    eng.cache.allocator.assert_no_leaks()
+
+
 # ---------------- HTTP front-end ---------------------------------------------
 def test_http_generate_roundtrip(served):
     """Rides the shared module engine (no extra compile in tier-1): the
