@@ -520,6 +520,184 @@ def bench_ckpt():
     return out
 
 
+def bench_data():
+    """Input-pipeline bench (--data): the two numbers the
+    ``paddle_tpu.data`` subsystem exists to move (docs/DATA.md).
+
+    1. **packed vs padded tokens/sec** — same variable-length corpus,
+       same model, same compiled TrainStep geometry: the padded loader
+       places one document per row (padding the tail, the classic
+       fine-tuning shape); the packed pipeline first-fit-packs documents
+       into the same [B, seq] with segment-id masking. Throughput is
+       counted in REAL (non-pad) tokens — the tokens that actually
+       train — so the ratio is the utilization the packer recovers.
+       Packing efficiency (real-token fraction per batch) is reported
+       from the ``data_packing_efficiency`` histogram.
+    2. **prefetch on/off step-time delta** — a deliberately slow
+       (IO-bound, GIL-releasing) dataset feeds the same fit-shaped loop
+       with and without the async device prefetcher; the delta is the
+       per-step data wait the prefetcher hides (the
+       ``train_step_data_seconds`` component StepTelemetry reports).
+
+    Results ride the ``--emit-metrics`` JSON schema."""
+    import time as _time
+
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.data import DataPipeline
+    from paddle_tpu.jit.train_step import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=128256, hidden_size=2048, intermediate_size=7168,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=4096,
+            tie_word_embeddings=True)
+        B, S, n_docs, steps = 4, 2048, 512, 8
+        d_lo, d_hi = 128, 1024
+    else:
+        cfg = LlamaConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=448,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512,
+            tie_word_embeddings=True)
+        B, S, n_docs, steps = 2, 256, 256, 6
+        d_lo, d_hi = 24, 128
+
+    class Corpus:
+        """Deterministic variable-length documents."""
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(7000 + i)
+            return rng.randint(1, cfg.vocab_size,
+                               rng.randint(d_lo, d_hi + 1)).astype(np.int32)
+
+        def __len__(self):
+            return n_docs
+
+    def build_step():
+        pt.seed(0)
+        model = LlamaForCausalLM(cfg)
+        if on_tpu:
+            model.bfloat16()
+        opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+
+        def loss_fn(m, **batch):
+            out = m(**batch)
+            return out[1] if isinstance(out, tuple) else out
+        return TrainStep(model, loss_fn, opt)
+
+    def run(batches, step):
+        """(elapsed_s, real_tokens) over pre-built batches (data cost
+        excluded — this measures the step-time value of density)."""
+        real = 0
+        loss = None
+        for b in batches:  # warmup/compile on the first call
+            loss = step(**{k: pt.to_tensor(v) for k, v in b.items()})
+            break
+        loss.numpy()
+        t0 = _time.perf_counter()
+        for b in batches:
+            real += int((np.asarray(b["attention_mask"]) > 0).sum())
+            loss = step(**{k: pt.to_tensor(v) for k, v in b.items()})
+        loss.numpy()
+        return _time.perf_counter() - t0, real
+
+    corpus = Corpus()
+    out = {"config": {"batch": B, "seq": S, "docs": n_docs,
+                      "doc_len": f"{d_lo}..{d_hi}"}}
+
+    # -- packed: first-fit pipeline batches ------------------------------
+    pipe = DataPipeline(corpus, batch_size=B, seq_len=S, pack=True,
+                        base_seed=3, shuffle=True, drop_last=True)
+    packed = []
+    for b in pipe:
+        packed.append(b)
+        if len(packed) >= steps:
+            break
+    # -- padded: one doc per row, padded to S (same label/mask form) -----
+    padded = []
+    di = 0
+    while len(padded) < len(packed):
+        ids = np.zeros((B, S), np.int32)
+        seg = np.zeros((B, S), np.int32)
+        pos = np.zeros((B, S), np.int32)
+        lab = np.full((B, S), -100, np.int32)
+        for r in range(B):
+            d = corpus[di % n_docs][:S]
+            di += 1
+            ids[r, :len(d)] = d
+            seg[r, :len(d)] = 1
+            pos[r, :len(d)] = np.arange(len(d))
+            lab[r, 1:len(d)] = d[1:]
+        padded.append({"input_ids": ids, "attention_mask": seg,
+                       "position_ids": pos, "labels": lab})
+
+    step_fn = build_step()
+    t_packed, tok_packed = run(packed, step_fn)
+    del step_fn
+    gc.collect()
+    step_fn = build_step()  # fresh params: identical compile state
+    t_padded, tok_padded = run(padded, step_fn)
+    del step_fn
+    gc.collect()
+
+    eff = pipe.packer.efficiency_stats()
+    out["packing_efficiency"] = round(eff["mean"], 4)
+    out["packed_tokens_per_sec"] = round(tok_packed / t_packed, 1)
+    out["padded_tokens_per_sec"] = round(tok_padded / t_padded, 1)
+    out["packed_over_padded"] = round(
+        (tok_packed / t_packed) / max(tok_padded / t_padded, 1e-9), 2)
+    out["packed_step_ms"] = round(t_packed / len(packed) * 1e3, 2)
+    out["padded_step_ms"] = round(t_padded / len(padded) * 1e3, 2)
+
+    # -- prefetch on/off: hide a slow host fetch -------------------------
+    fetch_s = 0.015
+
+    class SlowDocs:
+        """IO-bound corpus: sleep stands in for object-store reads and
+        releases the GIL exactly like real IO would."""
+
+        def __getitem__(self, i):
+            _time.sleep(fetch_s)
+            return corpus[i]
+
+        def __len__(self):
+            return n_docs
+
+    def timed_loop(loader, n):
+        """Mean per-step wall time of a fit-shaped loop: fetch (the
+        measured wait) + a fixed compute phase."""
+        it = iter(loader)
+        next(it)  # exclude iterator spin-up
+        t0 = _time.perf_counter()
+        got = 0
+        for b in it:
+            _time.sleep(0.01)  # the "train step" the chip would run
+            got += 1
+            if got >= n:
+                break
+        return (_time.perf_counter() - t0) / max(got, 1)
+
+    def fresh_pipe(prefetch):
+        return DataPipeline(SlowDocs(), batch_size=B, seq_len=S,
+                            pack=True, base_seed=3, shuffle=True,
+                            drop_last=True, device_prefetch=prefetch)
+
+    n_timed = max(len(packed) - 2, 3)
+    sync_step = timed_loop(fresh_pipe(0), n_timed)
+    pre_step = timed_loop(fresh_pipe(2), n_timed)
+    out["sync_step_ms"] = round(sync_step * 1e3, 2)
+    out["prefetch_step_ms"] = round(pre_step * 1e3, 2)
+    out["prefetch_data_wait_saved_ms"] = round(
+        (sync_step - pre_step) * 1e3, 2)
+    return out
+
+
 def _chaos_worker():
     """Trainer side of ``--chaos`` (launched under the elastic launcher):
     a tiny resilient fit — FitResilience checkpointing every step and
@@ -736,6 +914,13 @@ def main():
         print(json.dumps({"ckpt": ckpt}))
         if metrics_out:
             emit_metrics({"ckpt": ckpt}, metrics_out)
+        return
+
+    if "--data" in sys.argv:
+        data = bench_data()
+        print(json.dumps({"data": data}))
+        if metrics_out:
+            emit_metrics({"data": data}, metrics_out)
         return
 
     if "--chaos" in sys.argv:

@@ -50,6 +50,7 @@ from paddle_tpu import incubate  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import serving  # noqa: F401
 from paddle_tpu import checkpoint  # noqa: F401
+from paddle_tpu import data  # noqa: F401
 from paddle_tpu import utils  # noqa: F401
 from paddle_tpu import text  # noqa: F401
 from paddle_tpu import audio  # noqa: F401

@@ -1,0 +1,24 @@
+"""paddle_tpu.data — deterministic, checkpointable input pipeline.
+
+The Grain/tf.data-flavored subsystem (docs/DATA.md) that closes the
+train-side loop between checkpointing, resilience and step throughput:
+
+* :class:`~.stream.ShardedStream` — seeded, per-host-sharded sample
+  order; epoch-keyed shuffle makes any restart replay identically.
+* :class:`~.packing.SequencePacker` — first-fit packing of
+  variable-length documents into fixed ``[B, seq]`` batches with
+  segment-id / position / label tensors for the flash-attention mask.
+* :class:`~.pipeline.DataPipeline` — the composed iterator with a
+  compact ``state_dict()`` that ``FitResilience`` commits atomically
+  alongside model+optimizer (exactly-once data across preemptions).
+* :class:`~.prefetch.DevicePrefetcher` — double-buffered async
+  ``jax.device_put`` so the train loop's data wait approaches zero.
+"""
+from .metrics import data_metrics  # noqa: F401
+from .packing import SequencePacker  # noqa: F401
+from .pipeline import DataPipeline  # noqa: F401
+from .prefetch import DevicePrefetcher, to_device  # noqa: F401
+from .stream import ShardedStream  # noqa: F401
+
+__all__ = ["DataPipeline", "ShardedStream", "SequencePacker",
+           "DevicePrefetcher", "to_device", "data_metrics"]

@@ -1,0 +1,214 @@
+"""DataPipeline — the checkpointable front door of ``paddle_tpu.data``.
+
+Composes the subsystem (docs/DATA.md): a :class:`~.stream.ShardedStream`
+(deterministic epoch-keyed order, per-host shard) feeding either a plain
+collate batcher or a :class:`~.packing.SequencePacker` (``pack=True``),
+optionally behind a :class:`~.prefetch.DevicePrefetcher`
+(``device_prefetch=N`` — batches land on device N steps ahead of the
+train loop).
+
+Checkpoint contract — the piece PR 3/4 left open: ``state_dict()`` is a
+COMPACT iterator state ``{step, stream: {epoch, cursor, …}, packer:
+carry}`` that ``FitResilience`` commits atomically in the SAME checkpoint
+step as model+optimizer, and ``load_state_dict`` rebuilds the exact
+position, so a chaos-kill resume replays the identical batch sequence
+(exactly-once data, not just exactly-once weights).
+
+The subtlety prefetch introduces: the producer side of the pipeline runs
+AHEAD of the training loop, so "how far has the stream advanced" is the
+wrong state to checkpoint — it would skip every batch sitting in the
+prefetch buffer at kill time. Each produced batch therefore carries the
+post-batch state alongside it, and the state COMMITS only when the batch
+is DELIVERED to the consumer (``__next__`` returning it). ``state_dict``
+always describes exactly the batches the trainer has actually received —
+with any prefetch depth, including zero.
+
+Iteration yields one epoch per ``__iter__`` (DataLoader convention, so
+``Model.fit``'s epoch loop drives it unchanged); the internal epoch
+counter advances across calls and a restored mid-epoch state resumes in
+the middle of its epoch.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from paddle_tpu.io.dataloader import default_collate_fn
+
+from .metrics import data_metrics
+from .packing import SequencePacker
+from .stream import ShardedStream
+
+__all__ = ["DataPipeline"]
+
+STATE_VERSION = 1
+
+
+class DataPipeline:
+    """``pack=True`` expects each dataset item to be (or map, via
+    ``to_tokens``, to) a 1-D int token sequence and yields packed dict
+    batches (see :class:`SequencePacker` for the layout — feed them to a
+    network that computes its own loss, ``Model.prepare(opt, loss=None)``).
+    ``pack=False`` collates ``batch_size`` items with ``collate_fn``
+    (tuple batches, the classic ``(x, y)`` fit shape)."""
+
+    def __init__(self, dataset, batch_size: int, *, seq_len: int = 0,
+                 pack: bool = False, base_seed: int = 0,
+                 shuffle: bool = True, shard_index: Optional[int] = None,
+                 num_shards: Optional[int] = None, drop_last: bool = False,
+                 collate_fn: Optional[Callable] = None,
+                 to_tokens: Optional[Callable] = None, pad_id: int = 0,
+                 device_prefetch: int = 0, sharding=None,
+                 max_bad_samples: Optional[int] = None, registry=None):
+        self.stream = ShardedStream(
+            dataset, base_seed=base_seed, shuffle=shuffle,
+            shard_index=shard_index, num_shards=num_shards,
+            max_bad_samples=max_bad_samples, registry=registry)
+        self.pack = bool(pack)
+        self.batch_size = int(batch_size)
+        self.drop_last = bool(drop_last)
+        self.collate_fn = collate_fn or default_collate_fn
+        self.to_tokens = to_tokens
+        self.packer: Optional[SequencePacker] = None
+        if self.pack:
+            if seq_len < 2:
+                raise ValueError("pack=True requires seq_len >= 2")
+            self.packer = SequencePacker(seq_len, batch_size,
+                                         pad_id=pad_id, registry=registry)
+        self.device_prefetch = int(device_prefetch)
+        self.sharding = sharding
+        self._registry = registry
+        self._m = data_metrics(registry)
+        self._step = 0  # batches DELIVERED over the pipeline's lifetime
+        # batches built but not yet yielded: one packer.add() can flush
+        # SEVERAL batches from a single long document, while the stream
+        # cursor has already moved past that document — these must ride
+        # the checkpoint state or a kill between them loses the later
+        # ones (they exist nowhere else)
+        self._pending: list = []
+        self._committed = self._capture()
+
+    # -- state -----------------------------------------------------------------
+    def _capture(self) -> dict:
+        state = {"version": STATE_VERSION, "step": int(self._step),
+                 "stream": self.stream.state_dict()}
+        if self.packer is not None:
+            state["packer"] = self.packer.state_dict()
+            if self._pending:
+                state["pending"] = [
+                    {k: v.copy() for k, v in b.items()}
+                    for b in self._pending]
+        return state
+
+    def state_dict(self) -> dict:
+        """Iterator state as of the last DELIVERED batch (see module
+        docstring — prefetched-but-unconsumed batches are not counted)."""
+        return copy.deepcopy(self._committed)
+
+    def load_state_dict(self, state: dict):
+        if int(state.get("version", 0)) != STATE_VERSION:
+            raise ValueError(
+                f"unsupported pipeline state version "
+                f"{state.get('version')!r} (this build writes "
+                f"{STATE_VERSION})")
+        self.stream.load_state_dict(state["stream"])
+        if self.packer is not None:
+            if "packer" not in state:
+                raise ValueError("state has no packer carry but this "
+                                 "pipeline packs")
+            self.packer.load_state_dict(state["packer"])
+        self._pending = [
+            {k: np.asarray(v) for k, v in b.items()}
+            for b in state.get("pending", [])]
+        self._step = int(state["step"])
+        self._committed = self._capture()
+
+    @property
+    def step(self) -> int:
+        """Batches DELIVERED (the producer may be ahead under prefetch)."""
+        return int(self._committed["step"])
+
+    @property
+    def epoch(self) -> int:
+        return self.stream.epoch
+
+    def __len__(self):
+        if self.pack:
+            raise TypeError(
+                "a packing pipeline's batch count depends on document "
+                "lengths; it has no static length")
+        n = self.stream.samples_per_epoch()
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    # -- production ------------------------------------------------------------
+    def _pairs_for_epoch(self) -> Iterator[tuple]:
+        """(post_batch_state, batch) pairs for the remainder of the
+        current epoch. The state in each pair describes the stream/packer
+        AFTER every sample that batch consumed — committing it and
+        resuming reproduces the next batch exactly."""
+        if self.pack:
+            # deliver batches restored into _pending first: a checkpoint
+            # can land between the flushes of one multi-batch add() (long
+            # document) and the stream cursor is already past that doc —
+            # these batches exist only in the saved state. cursor == 0
+            # alongside a nonempty pending means that doc was the LAST of
+            # its epoch (the stream normalized to the next epoch's start):
+            # the pending batches complete the finished epoch, so this
+            # __iter__ ends after them instead of bleeding into the next
+            # epoch's samples.
+            if self._pending:
+                tail_of_epoch = self.stream.cursor == 0
+                while self._pending:
+                    yield self._pair(self._pending.pop(0))
+                if tail_of_epoch:
+                    return
+            for sample in self.stream:
+                doc = sample if self.to_tokens is None \
+                    else self.to_tokens(sample)
+                self._pending = self.packer.add(doc)
+                while self._pending:
+                    yield self._pair(self._pending.pop(0))
+            if not self.drop_last:
+                # epoch boundary: flush the carry so every token of the
+                # epoch is trained on; drop_last=True keeps the carry
+                # open across epochs for maximum packing density
+                tail = self.packer.flush()
+                if tail is not None:
+                    yield self._pair(tail)
+            return
+        buf = []
+        for sample in self.stream:
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield self._pair(self.collate_fn(buf))
+                buf = []
+        if buf and not self.drop_last:
+            yield self._pair(self.collate_fn(buf))
+
+    def _pair(self, batch):
+        self._step += 1
+        return (self._capture(), batch)
+
+    # -- consumption -----------------------------------------------------------
+    def __iter__(self):
+        if self._step != int(self._committed["step"]):
+            # a prefetching producer ran AHEAD of an early-exiting
+            # consumer (num_iters break, preemption stop): re-anchor
+            # production at the last DELIVERED batch, else re-iterating
+            # would skip the batches that died in the buffer
+            self.load_state_dict(self._committed)
+        pairs = self._pairs_for_epoch()
+        if self.device_prefetch > 0:
+            from .prefetch import prefetch_pairs
+            pairs = prefetch_pairs(pairs, depth=self.device_prefetch,
+                                   sharding=self.sharding,
+                                   registry=self._registry)
+        for state, batch in pairs:
+            # the commit point: this batch is now the trainer's problem
+            self._committed = state
+            self._m["batches"].inc()
+            yield batch

@@ -1,0 +1,188 @@
+"""DevicePrefetcher — double-buffered async host→device batch transfer.
+
+The last leg of the input pipeline (docs/DATA.md): while the chip runs
+step N, a background thread fetches batch N+1..N+depth from the
+underlying iterator and ``jax.device_put``s them, so the train loop's
+next ``__next__`` returns a batch that is ALREADY on device —
+``StepTelemetry``'s data-wait decomposition (train_step_data_seconds)
+approaches zero instead of paying fetch + transfer on the critical path.
+``depth=2`` is classic double buffering; deeper only helps when fetch
+latency is spiky.
+
+Placement: by default batches land on the default device. Pass a jax
+``Sharding`` to place every leaf with it, or ``sharding="auto"`` to shard
+leaf dim 0 across the current ``distributed.get_mesh()``'s ``dp`` axis
+(replicating when the batch doesn't divide) — the same placement
+``jit.TrainStep`` would choose, minus a transfer at trace time. Leaves
+come back wrapped in :class:`~paddle_tpu.core.tensor.Tensor` so the hapi
+loop and ``TrainStep`` consume them without a host round trip.
+
+Buffer occupancy is exported as the ``data_prefetch_buffer`` gauge.
+Errors in the producer propagate to the consumer at the point of the
+failed batch; an early-exiting consumer (``break``) unblocks and stops
+the producer (same discipline as ``io.dataloader._Prefetcher``).
+
+Two entry points: :func:`prefetch_pairs` is the internal seam
+``DataPipeline`` uses (it threads the pipeline's per-batch checkpoint
+state through the buffer so state still commits at DELIVERY, not at
+production); :class:`DevicePrefetcher` wraps any iterable-of-batches
+loader (a ``DataLoader``, a list) for ad-hoc use and the
+``bench.py --data`` prefetch-on/off comparison.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from .metrics import data_metrics
+
+__all__ = ["DevicePrefetcher", "prefetch_pairs", "to_device"]
+
+_SENTINEL = object()
+
+
+class _ProducerError:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _resolve_sharding(sharding):
+    if sharding != "auto":
+        return sharding
+    try:
+        from paddle_tpu.distributed import get_mesh
+        mesh = get_mesh()
+        if mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+        axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+        return NamedSharding(mesh, PartitionSpec(axis))
+    except Exception:
+        return None
+
+
+def to_device(batch, sharding=None):
+    """``jax.device_put`` every array leaf of ``batch`` (dict/tuple/list
+    nesting preserved), wrapped as Tensors. Non-divisible leaves fall
+    back to an unsharded put rather than failing the pipeline."""
+    import jax
+
+    from paddle_tpu.core.tensor import Tensor
+
+    def put(leaf):
+        if isinstance(leaf, Tensor):
+            leaf = leaf.data
+        if not hasattr(leaf, "shape"):
+            leaf = np.asarray(leaf)
+        if sharding is not None:
+            try:
+                return Tensor(jax.device_put(leaf, sharding))
+            except Exception:
+                pass
+        return Tensor(jax.device_put(leaf))
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            return {k: walk(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(walk(v) for v in obj)
+        return put(obj)
+
+    return walk(batch)
+
+
+def prefetch_pairs(pairs: Iterator[tuple], depth: int = 2, sharding=None,
+                   registry=None) -> Iterator[tuple]:
+    """Run ``(state, batch)`` pairs through a bounded background buffer,
+    transferring each batch to device on the producer thread. Yields the
+    pairs in order — the caller commits ``state`` when it receives one."""
+    if depth < 1:
+        raise ValueError("prefetch depth must be >= 1")
+    m = data_metrics(registry)
+    gauge = m["prefetch_buffer"]
+    placed = _resolve_sharding(sharding)
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                gauge.set(q.qsize())
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce():
+        try:
+            for state, batch in pairs:
+                dev = to_device(batch, placed)
+                if not put((state, dev)):
+                    return
+        except BaseException as e:
+            if not put(_ProducerError(e)):
+                return
+        finally:
+            put(_SENTINEL)
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name="pt-data-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            gauge.set(q.qsize())
+            if item is _SENTINEL:
+                return
+            if isinstance(item, _ProducerError):
+                raise item.exc
+            yield item
+    finally:
+        stop.set()
+        # wait for a straggler producer: it may be mid-iteration inside
+        # the pairs generator (mutating the pipeline's stream/packer
+        # state) — returning before it finishes would let it race the
+        # caller's re-anchoring load_state_dict on early exit. put()
+        # polls `stop` every 0.1s, so this converges quickly.
+        t.join()
+        close = getattr(pairs, "close", None)
+        if close is not None:
+            close()
+
+
+class DevicePrefetcher:
+    """Iterable wrapper: ``for batch in DevicePrefetcher(loader): …``
+    yields ``loader``'s batches already on device, ``depth`` ahead.
+    Re-iterable — each ``__iter__`` starts a fresh pass over ``loader``
+    (so a multi-epoch ``Model.fit`` drives it like any DataLoader)."""
+
+    def __init__(self, loader, depth: int = 2, sharding=None,
+                 registry=None):
+        from .pipeline import DataPipeline
+        if isinstance(loader, DataPipeline):
+            # wrapping the pipeline externally would commit its
+            # checkpoint state when the PREFETCHER pulls a batch, not
+            # when the trainer receives it — silently breaking
+            # exactly-once resume by up to `depth` batches
+            raise ValueError(
+                "wrap a DataPipeline with DataPipeline(device_prefetch="
+                f"{depth}) instead — an external prefetcher would "
+                "de-synchronize its checkpoint state from delivery")
+        self.loader = loader
+        self.depth = int(depth)
+        self.sharding = sharding
+        self.registry = registry
+
+    def __iter__(self):
+        pairs = ((None, b) for b in self.loader)
+        for _, batch in prefetch_pairs(pairs, depth=self.depth,
+                                       sharding=self.sharding,
+                                       registry=self.registry):
+            yield batch
+
+    def __len__(self):
+        return len(self.loader)

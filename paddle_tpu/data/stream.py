@@ -1,0 +1,205 @@
+"""ShardedStream — deterministic, seeded, per-host-sharded sample stream.
+
+The bottom of the ``paddle_tpu.data`` pipeline (docs/DATA.md). Grain-style
+determinism contract: the sequence of samples a shard yields is a pure
+function of ``(dataset, base_seed, num_shards, shard_index)`` — epoch
+``e``'s order comes from ``epoch_seed(base_seed, e)`` (io/sampler.py), so
+ANY rebuilt stream (fresh process, relaunched trainer, tomorrow's debug
+session) replays the identical order. Restart-safety then reduces to two
+integers: ``{epoch, cursor}`` — the whole iterator state fits in a
+checkpoint manifest.
+
+Sharding is strided over the epoch's (shuffled) order: shard ``k`` takes
+positions ``k, k+N, k+2N, …`` — shards are disjoint, cover the epoch, and
+stay balanced regardless of where the shuffle put any sample. The
+remainder (``len(dataset) % num_shards``) is dropped by default so every
+shard steps the same number of times per epoch (SPMD hosts must agree on
+step counts; ``drop_remainder=False`` wraps instead, repeating early
+samples like DistributedBatchSampler).
+
+Iterable datasets cannot seek, so their resume REPLAYS the source and
+discards the first ``cursor`` samples, counting each into
+``data_skipped_on_resume_total`` (the honest cost of an unseekable
+source); their per-shard split is the same strided rule over arrival
+order, and shuffle is refused rather than faked.
+
+Bad samples spend from the SAME retry-then-skip budget as the DataLoader
+(``io.dataloader._BadSampleBudget`` / ``loader_bad_samples_total``),
+under ``stage="stream"``. A skipped sample still advances the cursor —
+skips must not shift every later sample's position or resume breaks.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from paddle_tpu.io.dataloader import _SKIP, _BadSampleBudget
+from paddle_tpu.io.dataset import IterableDataset
+from paddle_tpu.io.sampler import epoch_seed
+
+from .metrics import data_metrics
+
+__all__ = ["ShardedStream"]
+
+
+def _default_shards():
+    """(shard_index, num_shards) from the jax process topology — under
+    single-controller SPMD each HOST feeds its slice of the global batch."""
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+class ShardedStream:
+    def __init__(self, dataset, base_seed: int = 0, shuffle: bool = True,
+                 shard_index: Optional[int] = None,
+                 num_shards: Optional[int] = None,
+                 drop_remainder: bool = True,
+                 max_bad_samples: Optional[int] = None,
+                 registry=None):
+        di, dn = _default_shards()
+        self.dataset = dataset
+        self.base_seed = int(base_seed)
+        self.shuffle = bool(shuffle)
+        self.num_shards = int(num_shards if num_shards is not None else dn)
+        self.shard_index = int(shard_index if shard_index is not None
+                               else di)
+        if not 0 <= self.shard_index < self.num_shards:
+            raise ValueError(
+                f"shard_index {self.shard_index} out of range for "
+                f"{self.num_shards} shards")
+        self.drop_remainder = bool(drop_remainder)
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable and self.shuffle:
+            raise ValueError(
+                "an IterableDataset has no index space to shuffle "
+                "deterministically; pass shuffle=False (shuffle inside "
+                "the dataset with its own seeded rng if needed)")
+        self.epoch = 0
+        self.cursor = 0  # samples already yielded of the CURRENT epoch
+        self._m = data_metrics(registry)
+        self._budget: Optional[_BadSampleBudget] = None
+        if max_bad_samples is None:
+            max_bad_samples = int(os.environ.get(
+                "PADDLE_TPU_LOADER_MAX_BAD_SAMPLES", "0") or 0)
+        if int(max_bad_samples) > 0:
+            self._budget = _BadSampleBudget(int(max_bad_samples))
+
+    # -- deterministic order ---------------------------------------------------
+    def epoch_order(self, epoch: int) -> np.ndarray:
+        """This shard's dataset indices for ``epoch`` (map-style only) —
+        pure function of the constructor args and ``epoch``."""
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.RandomState(
+                epoch_seed(self.base_seed, epoch)).permutation(n)
+        else:
+            order = np.arange(n)
+        rem = n % self.num_shards
+        if rem:
+            if self.drop_remainder:
+                order = order[:n - rem]
+            else:
+                order = np.concatenate(
+                    [order, order[:self.num_shards - rem]])
+        return order[self.shard_index::self.num_shards]
+
+    def samples_per_epoch(self) -> int:
+        if self._iterable:
+            raise TypeError("IterableDataset stream has no length")
+        n = len(self.dataset)
+        if self.drop_remainder:
+            return (n - n % self.num_shards) // self.num_shards
+        return -(-n // self.num_shards)
+
+    __len__ = samples_per_epoch
+
+    # -- iteration -------------------------------------------------------------
+    def __iter__(self) -> Iterator:
+        """Yield the REMAINDER of the current epoch (all of it when
+        ``cursor`` is 0), then advance to the next epoch. A mid-epoch
+        ``load_state_dict`` therefore resumes exactly where the restored
+        state left off."""
+        if self._iterable:
+            yield from self._iter_iterable()
+            return
+        order = self.epoch_order(self.epoch)
+        ds, budget = self.dataset, self._budget
+        while self.cursor < len(order):
+            i = int(order[self.cursor])
+            # advance BEFORE the fetch: a checkpoint taken after this
+            # sample lands downstream must not replay it
+            self.cursor += 1
+            if budget is None:
+                yield ds[i]
+            else:
+                s = budget.fetch(ds, i, stage="stream")
+                if s is not _SKIP:
+                    yield s
+        self.epoch += 1
+        self.cursor = 0
+
+    def _iter_iterable(self):
+        skip = self.cursor
+        if skip:
+            self._m["skipped_on_resume"].inc(skip)
+        pos = 0  # arrival position within this shard, this epoch
+        for j, sample in enumerate(self.dataset):
+            if j % self.num_shards != self.shard_index:
+                continue
+            if pos < skip:
+                pos += 1
+                continue
+            pos += 1
+            self.cursor = pos
+            yield sample
+        self.epoch += 1
+        self.cursor = 0
+
+    # -- checkpointable state --------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"epoch": int(self.epoch), "cursor": int(self.cursor),
+                "base_seed": self.base_seed,
+                "num_shards": self.num_shards,
+                "shard_index": self.shard_index,
+                "shuffle": self.shuffle,
+                "drop_remainder": self.drop_remainder}
+
+    def load_state_dict(self, state: dict):
+        if int(state.get("num_shards", self.num_shards)) != self.num_shards:
+            raise ValueError(
+                f"stream state was saved with num_shards="
+                f"{state['num_shards']}, this stream has "
+                f"{self.num_shards} — deterministic resume requires a "
+                "mesh-size-preserving restart (elastic reshard of the "
+                "DATA order is not defined; start a fresh epoch instead)")
+        if int(state.get("shard_index", self.shard_index)) != \
+                self.shard_index:
+            raise ValueError(
+                f"stream state belongs to shard "
+                f"{state['shard_index']}, this stream is shard "
+                f"{self.shard_index} — each rank must restore its OWN "
+                "data state")
+        if bool(state.get("shuffle", self.shuffle)) != self.shuffle or \
+                int(state.get("base_seed", self.base_seed)) != \
+                self.base_seed or \
+                bool(state.get("drop_remainder", self.drop_remainder)) != \
+                self.drop_remainder:
+            raise ValueError(
+                "stream state disagrees with this stream's shuffle/"
+                "base_seed/drop_remainder — the cursor would index a "
+                "different order; resuming would silently change the "
+                "sample sequence")
+        self.epoch = int(state["epoch"])
+        self.cursor = int(state["cursor"])
+        # a state captured with an epoch's FINAL batch has cursor at the
+        # end of the order (rollover happens lazily on the next pull);
+        # normalize so `epoch` always means "next epoch to iterate" and
+        # a resumed fit doesn't spend one epoch iteration yielding nothing
+        if not self._iterable and self.cursor >= self.samples_per_epoch():
+            self.epoch += 1
+            self.cursor = 0

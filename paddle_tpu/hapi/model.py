@@ -281,6 +281,12 @@ class Model:
     # -- setup ----------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
+        """``loss=None`` with an optimizer takes the SELF-SUPERVISED path:
+        the network computes its own loss — ``net(*batch)`` (or
+        ``net(**batch)`` for dict batches, the packed-pipeline shape,
+        docs/DATA.md) returns the scalar loss or an ``(out, loss)``
+        tuple, the causal-LM convention (``LlamaForCausalLM(input_ids,
+        labels=…)``)."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -288,17 +294,35 @@ class Model:
         else:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
-        if optimizer is not None and loss is not None:
+        if optimizer is not None:
             import paddle_tpu as pt
 
-            def loss_fn(net, x, y):
-                return self._loss(net(x), y)
+            if loss is not None:
+                def loss_fn(net, x, y):
+                    return self._loss(net(x), y)
+            else:
+                def loss_fn(net, *args, **kwargs):
+                    out = net(*args, **kwargs)
+                    return out[1] if isinstance(out, (tuple, list)) \
+                        else out
             self._train_step = pt.jit.TrainStep(self.network, loss_fn,
                                                 optimizer)
         return self
 
     # -- core steps -----------------------------------------------------------
     def train_batch(self, inputs, labels=None):
+        if isinstance(inputs, dict):
+            # packed-pipeline batches: keys ARE the network kwargs
+            # (input_ids / attention_mask / …) — needs the loss=None
+            # self-supervised TrainStep from prepare()
+            if self._train_step is None or self._loss is not None:
+                raise RuntimeError(
+                    "dict (packed-pipeline) batches require "
+                    "prepare(optimizer, loss=None) — the network "
+                    "computes its own loss from the batch kwargs")
+            loss = self._train_step(
+                **{k: _as_tensor(v) for k, v in inputs.items()})
+            return [float(loss.numpy())]
         x = _as_tensor(inputs[0] if isinstance(inputs, (list, tuple))
                        else inputs)
         y = _as_tensor(labels[0] if isinstance(labels, (list, tuple))
@@ -308,6 +332,20 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         from paddle_tpu.core.autograd import no_grad
+        if isinstance(inputs, dict):
+            # packed-pipeline batch: keys are the network kwargs and the
+            # network computes its own loss (prepare(opt, loss=None));
+            # hapi metrics don't apply — there is no (out, label) pair
+            if self._loss is not None:
+                raise RuntimeError(
+                    "dict (packed-pipeline) batches require "
+                    "prepare(..., loss=None) — the network computes "
+                    "its own loss from the batch kwargs")
+            with no_grad():
+                out = self.network(
+                    **{k: _as_tensor(v) for k, v in inputs.items()})
+            loss = out[1] if isinstance(out, (tuple, list)) else out
+            return [float(loss.numpy())]
         x = _as_tensor(inputs[0] if isinstance(inputs, (list, tuple))
                        else inputs)
         y = _as_tensor(labels[0] if isinstance(labels, (list, tuple))
@@ -389,8 +427,14 @@ class Model:
                 # loader-fetch time, handed to telemetry callbacks as the
                 # step's data component (StepTimer decomposition)
                 data_time = _time.perf_counter() - t_fetch
-                x, y = batch[0], batch[1]
-                first = x[0] if isinstance(x, (list, tuple)) else x
+                if isinstance(batch, dict):
+                    # packed-pipeline batch: the whole dict goes to
+                    # train_batch as network kwargs
+                    x, y = batch, None
+                    first = next(iter(batch.values()))
+                else:
+                    x, y = batch[0], batch[1]
+                    first = x[0] if isinstance(x, (list, tuple)) else x
                 shape = getattr(first, "shape", None)
                 blogs = {"data_time": data_time,
                          "batch_size": int(shape[0]) if shape else None}
@@ -443,7 +487,10 @@ class Model:
             m.reset()
         losses = []
         for batch in loader:
-            res = self.eval_batch(batch[0], batch[1])
+            if isinstance(batch, dict):
+                res = self.eval_batch(batch)
+            else:
+                res = self.eval_batch(batch[0], batch[1])
             if res:
                 losses.append(res[0])
         logs = {}

@@ -5,6 +5,6 @@ from .dataset import (  # noqa: F401
 )
 from .sampler import (  # noqa: F401
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
-    BatchSampler, DistributedBatchSampler, SubsetRandomSampler,
+    BatchSampler, DistributedBatchSampler, SubsetRandomSampler, epoch_seed,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -67,21 +67,25 @@ class _BadSampleBudget:
         # budget-exhausted failure never fire
         self._lock = threading.Lock()
 
-    def fetch(self, ds, i):
+    def fetch(self, ds, i, stage: str = "fetch"):
+        """``stage`` labels the skip in ``loader_bad_samples_total`` —
+        the data pipeline (paddle_tpu.data) spends from this same budget
+        class under its own stage so operators can tell the paths apart
+        while alerting on one family."""
         try:
             return ds[i]
         except Exception:
             try:
                 return ds[i]  # one retry: transient IO heals here
             except Exception as e:
-                self._spend("fetch", f"dataset[{i!r}]", e)
+                self._spend(stage, f"dataset[{i!r}]", e)
                 return _SKIP
 
-    def collate(self, collate_fn, batch):
+    def collate(self, collate_fn, batch, stage: str = "collate"):
         try:
             return collate_fn(batch)
         except Exception as e:
-            self._spend("collate", f"batch of {len(batch)}", e)
+            self._spend(stage, f"batch of {len(batch)}", e)
             return _SKIP
 
     def _spend(self, stage: str, what: str, exc: Exception):
@@ -271,7 +275,7 @@ class DataLoader:
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
                  worker_init_fn=None, use_process_workers=False,
-                 max_bad_samples=None):
+                 max_bad_samples=None, base_seed=None):
         """``use_process_workers=True`` runs the ``num_workers`` pool as
         forked SUBPROCESSES (reference ``fluid/dataloader/worker.py``
         semantics) instead of threads: GIL-bound Python transforms (image
@@ -283,7 +287,12 @@ class DataLoader:
         0 = off) turns on the bounded retry-then-skip fault policy over
         sample fetch and collate for the in-process iteration paths (see
         :class:`_BadSampleBudget`; the subprocess pool keeps its own
-        fail-fast worker semantics)."""
+        fail-fast worker semantics).
+
+        ``base_seed`` makes the built-in ``shuffle=True`` sampler
+        DETERMINISTIC and epoch-keyed (``sampler.epoch_seed``): two fresh
+        loaders over the same dataset replay the same order — see
+        docs/DATA.md."""
         self.dataset = dataset
         self.max_bad_samples = max_bad_samples
         self._bad_budget: Optional[_BadSampleBudget] = None
@@ -317,12 +326,13 @@ class DataLoader:
         else:
             if batch_size is None:
                 self.batch_sampler = None  # un-batched mode
-                self._unbatched_sampler = RandomSampler(dataset) if shuffle \
+                self._unbatched_sampler = \
+                    RandomSampler(dataset, base_seed=base_seed) if shuffle \
                     else SequenceSampler(dataset)
             else:
                 self.batch_sampler = BatchSampler(
                     dataset, shuffle=shuffle, batch_size=batch_size,
-                    drop_last=drop_last)
+                    drop_last=drop_last, base_seed=base_seed)
 
     # -- iteration paths -------------------------------------------------------
     def _budget(self) -> Optional[_BadSampleBudget]:

@@ -9,7 +9,24 @@ import numpy as np
 
 __all__ = ["Sampler", "SequenceSampler", "RandomSampler",
            "WeightedRandomSampler", "BatchSampler",
-           "DistributedBatchSampler", "SubsetRandomSampler"]
+           "DistributedBatchSampler", "SubsetRandomSampler", "epoch_seed"]
+
+
+def epoch_seed(base_seed: int, epoch: int) -> int:
+    """Stable 32-bit seed for ``(base_seed, epoch)`` — the determinism
+    contract of the data pipeline (docs/DATA.md): any rebuilt sampler /
+    stream seeded this way replays the identical shuffle for an epoch, so
+    a relaunched trainer resumes the exact sample order instead of
+    re-rolling from process entropy. splitmix64 finalizer: nearby
+    (seed, epoch) pairs land far apart, unlike ``base_seed + epoch``
+    (where seed=5/epoch=0 collides with seed=0/epoch=5)."""
+    mask = (1 << 64) - 1
+    x = ((int(base_seed) & mask) * 0x9E3779B97F4A7C15 + int(epoch) + 1) \
+        & mask
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & mask
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EB & mask
+    x ^= x >> 31
+    return int(x & 0xFFFFFFFF)
 
 
 def _rng(generator):
@@ -60,12 +77,25 @@ class SequenceSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """``base_seed`` switches on DETERMINISTIC epoch-keyed shuffling:
+    each ``__iter__`` draws its permutation from
+    ``epoch_seed(base_seed, epoch)`` and advances the epoch, so a rebuilt
+    sampler (fresh process, relaunched trainer) replays the identical
+    order — the prerequisite for exactly-once resume (docs/DATA.md).
+    ``set_epoch`` pins the next epoch explicitly. Default (None) keeps
+    the legacy process-entropy behavior."""
+
     def __init__(self, data_source, replacement=False, num_samples=None,
-                 generator=None):
+                 generator=None, base_seed=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
         self.generator = generator
+        self.base_seed = base_seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = int(epoch)
 
     @property
     def num_samples(self):
@@ -73,7 +103,12 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = _rng(self.generator)
+        if self.base_seed is not None and self.generator is None:
+            rng = np.random.RandomState(
+                epoch_seed(self.base_seed, self.epoch))
+            self.epoch += 1
+        else:
+            rng = _rng(self.generator)
         if self.replacement:
             if hasattr(rng, "integers"):  # np.random.Generator API
                 return iter(rng.integers(0, n, self.num_samples).tolist())
@@ -122,17 +157,21 @@ class BatchSampler(Sampler):
     """Reference: paddle.io.BatchSampler — wraps a dataset or sampler."""
 
     def __init__(self, dataset=None, sampler=None, shuffle=False,
-                 batch_size=1, drop_last=False):
+                 batch_size=1, drop_last=False, base_seed=None):
         super().__init__(dataset)
         if (dataset is None) == (sampler is None):
             raise ValueError("pass exactly one of dataset / sampler")
         if sampler is not None:
             self.sampler = sampler
         else:
-            self.sampler = RandomSampler(dataset) if shuffle \
-                else SequenceSampler(dataset)
+            self.sampler = RandomSampler(dataset, base_seed=base_seed) \
+                if shuffle else SequenceSampler(dataset)
         self.batch_size = batch_size
         self.drop_last = drop_last
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.sampler, "set_epoch"):
+            self.sampler.set_epoch(epoch)
 
     def __iter__(self):
         yield from _chunked(self.sampler, self.batch_size, self.drop_last)
@@ -151,7 +190,7 @@ class DistributedBatchSampler(BatchSampler):
     case where each host loads its shard (num_replicas = host count)."""
 
     def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
-                 shuffle=False, drop_last=False):
+                 shuffle=False, drop_last=False, base_seed=0):
         import jax
         self.dataset = dataset
         self.batch_size = batch_size
@@ -160,6 +199,7 @@ class DistributedBatchSampler(BatchSampler):
         self.local_rank = rank if rank is not None else jax.process_index()
         self.shuffle = shuffle
         self.drop_last = drop_last
+        self.base_seed = base_seed
         self.epoch = 0
         self.num_samples = int(
             math.ceil(len(dataset) / self.nranks)) if not drop_last else \
@@ -169,8 +209,11 @@ class DistributedBatchSampler(BatchSampler):
     def __iter__(self):
         n = len(self.dataset)
         if self.shuffle:
+            # (base_seed, epoch)-keyed: every rank derives the SAME full
+            # permutation for an epoch, and a rebuilt sampler replays it
             indices = np.random.RandomState(
-                self.epoch).permutation(n).tolist()
+                epoch_seed(self.base_seed, self.epoch)).permutation(
+                    n).tolist()
         else:
             indices = list(range(n))
         if not self.drop_last:

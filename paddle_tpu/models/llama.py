@@ -120,6 +120,24 @@ def apply_rotary(q, k, theta: float = 500000.0, pos_offset: int = 0,
     return apply_op(f, q, k, op_name="rotary_embedding")
 
 
+def apply_rotary_positions(q, k, position_ids, theta: float = 500000.0,
+                           table_len: int = 0):
+    """Rotate q,k ([B,S,H,D]) at PER-TOKEN positions ``position_ids``
+    [B,S] — the packed-sequence form (docs/DATA.md): each document inside
+    a packed row restarts at position 0, so RoPE must be gathered per
+    token instead of sliced by row offset. Same table and rotation
+    convention as :func:`apply_rotary` (one ``_rope_cache`` /
+    ``_rot_interleaved`` pair for every path)."""
+    def f(qa, ka, pidx):
+        s, d = qa.shape[1], qa.shape[-1]
+        n = max(table_len, s)
+        pidx = jnp.clip(pidx.astype(jnp.int32), 0, n - 1)
+        cos, sin = _gather_rope(pidx, d, theta, str(qa.dtype), n)
+        return (_rot_interleaved(qa, cos, sin),
+                _rot_interleaved(ka, cos, sin))
+    return apply_op(f, q, k, position_ids, op_name="rotary_embedding")
+
+
 def _linear_cls(cfg: LlamaConfig, kind: str):
     if not cfg.tensor_parallel:
         return None
@@ -153,7 +171,8 @@ class LlamaAttention(nn.Layer):
         self.o_proj = _make_linear(cfg, self.n_heads * self.head_dim,
                                    cfg.hidden_size, "row")
 
-    def forward(self, x, cache=None, attention_mask=None, pos_offsets=None):
+    def forward(self, x, cache=None, attention_mask=None, pos_offsets=None,
+                position_ids=None):
         """``cache=(k, v)`` ([B, P, n_kv, hd] each, P may be 0) switches to
         the incremental-decode path: returns (out, (k', v')). A
         ``cache=(k_buf, v_buf, pos)`` triple ([B, L, n_kv, hd] preallocated
@@ -174,6 +193,12 @@ class LlamaAttention(nn.Layer):
         ``pos_offsets`` ([B] int32, static path) shifts RoPE positions per
         row — a LEFT-padded row with ``pad`` pads has its first real token
         at position 0, not ``pad`` (the ragged-serving shape).
+        ``position_ids`` ([B, S] int32, cacheless path) sets PER-TOKEN
+        RoPE positions — the packed-training shape (docs/DATA.md): with a
+        packed batch, ``attention_mask`` carries the packer's SEGMENT IDS
+        (1, 2, … per document, 0 = pad; the kernel attends only within
+        equal ids, which is exactly the 1/0 padding form generalized) and
+        ``position_ids`` restarts at 0 inside each document.
 
         A :class:`~paddle_tpu.ops.paged_attention.PagedLayerCache` takes
         the BLOCK-PAGED path (the continuous-batching serving engine's
@@ -187,6 +212,9 @@ class LlamaAttention(nn.Layer):
                     "liveness from the cache itself; attention_mask/"
                     "pos_offsets do not apply")
             return self._paged_forward(x, cache)
+        if cache is not None and position_ids is not None:
+            raise NotImplementedError(
+                "position_ids is a cacheless (packed training) argument")
         if cache is not None and len(cache) == 3:
             return self._static_forward(x, cache, attention_mask,
                                         pos_offsets)
@@ -202,7 +230,12 @@ class LlamaAttention(nn.Layer):
         k = ops.reshape(self.k_proj(x), [B, S, self.n_kv, self.head_dim])
         v = ops.reshape(self.v_proj(x), [B, S, self.n_kv, self.head_dim])
         if cache is None:
-            q, k = apply_rotary(q, k, self.cfg.rope_theta)
+            if position_ids is not None:
+                q, k = apply_rotary_positions(
+                    q, k, position_ids, self.cfg.rope_theta,
+                    table_len=self.cfg.max_position_embeddings)
+            else:
+                q, k = apply_rotary(q, k, self.cfg.rope_theta)
             if attention_mask is not None:
                 # padding -> segment ids (real tokens segment 1, pads 0):
                 # the flash kernel's varlen form — pads never mix with
@@ -367,10 +400,12 @@ class LlamaDecoderLayer(nn.Layer):
                                                    epsilon=cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cache=None, attention_mask=None, pos_offsets=None):
+    def forward(self, x, cache=None, attention_mask=None, pos_offsets=None,
+                position_ids=None):
         if cache is None:
             x = ops.add(x, self.self_attn(self.input_layernorm(x),
-                                          attention_mask=attention_mask))
+                                          attention_mask=attention_mask,
+                                          position_ids=position_ids))
             x = ops.add(x, self.mlp(self.post_attention_layernorm(x)))
             return x
         attn_out, new_cache = self.self_attn(self.input_layernorm(x),
@@ -397,24 +432,28 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
 
     def forward(self, input_ids, caches=None, attention_mask=None,
-                pos_offsets=None):
-        """``attention_mask``: [B, S] 1/0 padding mask (cacheless path,
-        flash segment ids) or [B, L] buffer key-liveness mask (static-
-        cache path); ``pos_offsets``: [B] per-row RoPE shift for
-        left-padded ragged batches (static path only). Reference mask
-        threading: ``nn/layer/transformer.py:84``."""
+                pos_offsets=None, position_ids=None):
+        """``attention_mask``: [B, S] 1/0 padding mask — or packed
+        SEGMENT IDS (docs/DATA.md) — on the cacheless path (flash
+        segment ids), [B, L] buffer key-liveness mask on the static-cache
+        path; ``pos_offsets``: [B] per-row RoPE shift for left-padded
+        ragged batches (static path only); ``position_ids``: [B, S]
+        per-token RoPE positions (cacheless packed path only). Reference
+        mask threading: ``nn/layer/transformer.py:84``."""
         x = self.embed_tokens(input_ids)
         if caches is None:
+            kw = {}
+            if attention_mask is not None:
+                kw["attention_mask"] = attention_mask
+            if position_ids is not None:
+                kw["position_ids"] = position_ids
             for layer in self.layers:
                 if self.cfg.recompute and self.training:
                     from paddle_tpu.distributed.fleet import recompute
-                    if attention_mask is None:
-                        x = recompute(layer, x)
-                    else:
-                        x = recompute(layer, x,
-                                      attention_mask=attention_mask)
+                    x = recompute(layer, x, **kw) if kw \
+                        else recompute(layer, x)
                 else:
-                    x = layer(x, attention_mask=attention_mask)
+                    x = layer(x, **kw)
             return self.norm(x)
         if len(caches) != len(self.layers):
             raise ValueError(
@@ -456,11 +495,18 @@ class LlamaForCausalLM(nn.Layer):
     # available to callers)
     _FUSED_CE_MIN_VOCAB = 32768
 
-    def forward(self, input_ids, labels=None, attention_mask=None):
+    def forward(self, input_ids, labels=None, attention_mask=None,
+                position_ids=None):
         """``attention_mask`` [B, S] (1 real / 0 pad) masks padded tokens
         out of attention (flash segment ids); set padded label positions
-        to -100 so the loss ignores them too."""
-        h = self.model(input_ids, attention_mask=attention_mask)
+        to -100 so the loss ignores them too. A PACKED batch
+        (``paddle_tpu.data`` pipeline, docs/DATA.md) passes segment ids
+        as ``attention_mask`` and per-document ``position_ids`` — this
+        signature matches the packer's batch keys, so
+        ``Model.prepare(opt, loss=None)`` + ``fit(pipeline)`` feeds
+        batches straight through as kwargs."""
+        h = self.model(input_ids, attention_mask=attention_mask,
+                       position_ids=position_ids)
         if labels is not None and labels.shape[1] < 2:
             raise ValueError(
                 "causal-LM loss needs sequences of length >= 2 (the "

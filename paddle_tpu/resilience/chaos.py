@@ -55,6 +55,7 @@ def _steps(val: Optional[str]) -> frozenset:
 
 def refresh() -> dict:
     """Re-read the chaos env; (un)install the collective hang hook."""
+    _poison_loss_steps.clear()
     env = os.environ
     kill = env.get(ENV_KILL)
     _cfg["kill"] = int(kill) if kill else None
@@ -110,23 +111,46 @@ def kill_at_step(step: int):
 
 
 def poison_batch(step: int, x):
-    """NaN-fill float leaves of the batch for a poisoned step."""
+    """NaN-fill float leaves of the batch for a poisoned step. Packed-
+    pipeline batches are all-int (token ids / segment ids / positions) —
+    int32 can't hold a NaN, so for a batch with no float leaf the fault
+    escalates to corrupting this step's loss instead of silently not
+    firing (the NaN guard must still see a fault to prove recovery)."""
     if step not in _cfg["poison"] or not _fire_once(f"poison_step{step}"):
         return x
-    return _poison_tree(x)
+    hit = [False]
+    out = _poison_tree(x, hit)
+    if not hit[0]:
+        print(f"[chaos] poison at step {step}: batch has no float "
+              "leaves (packed int batch) — corrupting the step's loss "
+              "instead", file=sys.stderr, flush=True)
+        _poison_loss_steps.add(step)
+    return out
 
 
-def _poison_tree(x):
+def _poison_tree(x, hit):
+    if isinstance(x, dict):  # packed-pipeline batches are dicts
+        return {k: _poison_tree(v, hit) for k, v in x.items()}
     if isinstance(x, (list, tuple)):
-        return type(x)(_poison_tree(e) for e in x)
+        return type(x)(_poison_tree(e, hit) for e in x)
     arr = np.asarray(getattr(x, "data", x)
                      if not isinstance(x, np.ndarray) else x)
     if np.issubdtype(arr.dtype, np.floating):
+        hit[0] = True
         return np.full_like(arr, np.nan)
     return x
 
 
+# poison steps whose batch had no float leaf: corrupt_loss picks them up
+# in the same fit iteration (poison_batch runs before the train step,
+# corrupt_loss after)
+_poison_loss_steps: set = set()
+
+
 def corrupt_loss(step: int, loss: float) -> float:
+    if step in _poison_loss_steps:
+        _poison_loss_steps.discard(step)
+        return float("nan")
     if step in _cfg["corrupt"] and _fire_once(f"corrupt_step{step}"):
         return float("nan")
     return loss

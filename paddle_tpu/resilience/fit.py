@@ -48,7 +48,16 @@ class FitResilience(Callback):
                  watchdog_action: str = "dump",
                  nan_guard: bool = False, max_rollbacks: int = 3,
                  spike_window: int = 0, spike_factor: float = 10.0,
-                 registry=None):
+                 registry=None, pipeline=None):
+        """``pipeline``: a ``paddle_tpu.data.DataPipeline`` (or anything
+        with ``state_dict``/``load_state_dict``) whose iterator state is
+        committed under the ``"data"`` key of EVERY save — atomically in
+        the same checkpoint step as model+optimizer — and restored by
+        :meth:`restore`, so a relaunch resumes the exact sample order
+        (exactly-once data, docs/DATA.md). NaN-guard rollbacks restore
+        weights only: the data stream keeps moving forward (replaying
+        consumed batches into a rolled-back model would double-train
+        them; see docs/RESILIENCE.md)."""
         if manager is None and checkpoint_dir is not None:
             from paddle_tpu.checkpoint import CheckpointManager
             manager = CheckpointManager(checkpoint_dir,
@@ -70,6 +79,7 @@ class FitResilience(Callback):
                                       spike_factor=spike_factor,
                                       registry=registry)
         self._registry = registry
+        self.pipeline = pipeline
         self.preempted = False
         self.final_step: Optional[int] = None
         self._step0 = 0          # global-step offset after a resume
@@ -88,6 +98,11 @@ class FitResilience(Callback):
             return None
         state = self.manager.restore()
         apply_restored_state(model, state)
+        if self.pipeline is not None and isinstance(state, dict) and \
+                "data" in state:
+            # same committed step as model+opt: the restored iterator
+            # resumes at exactly the batch after the last trained one
+            self.pipeline.load_state_dict(state["data"])
         restored = self.manager.last_restored_step
         meta = self.manager.metadata(restored)
         self._step0 = int(meta.get("global_step", restored))
@@ -159,6 +174,8 @@ class FitResilience(Callback):
         opt = getattr(self.model, "_optimizer", None)
         if opt is not None and hasattr(opt, "state_dict"):
             state["optimizer"] = opt.state_dict()
+        if self.pipeline is not None:
+            state["data"] = self.pipeline.state_dict()
         return state
 
     def _final_save(self, gs: int):

@@ -1,0 +1,105 @@
+"""Trainer worker for the data-pipeline exactly-once integration test
+(run as a subprocess — NOT a pytest file).
+
+A tiny deterministic fit over a ``paddle_tpu.data.DataPipeline`` wrapped
+in ``FitResilience(pipeline=…)``, checkpointing SYNCHRONOUSLY every step
+so a SIGKILL at any step boundary loses nothing (the chaos harness's
+``PADDLE_TPU_CHAOS_KILL_AT_STEP`` fires right after the step's save
+commits; async saves would re-run the kill-window batches and the digest
+ledger would show them twice — steps_lost is the MTTR bench's metric,
+not this test's).
+
+Env contract:
+
+* ``DATA_TEST_DIR`` — run directory (checkpoint root + ledger).
+* ``DATA_TEST_EPOCHS`` — total epochs to train (default 3).
+* ``PADDLE_TPU_CHAOS_KILL_AT_STEP`` / ``PADDLE_TPU_CHAOS_MARK_DIR`` —
+  the chaos kill (fires once per job thanks to the mark dir).
+
+Appends one ``{"gs", "pid", "digest"}`` line per TRAINED batch to
+``batches.jsonl`` — the digest ledger the test compares against an
+uninterrupted run's. Writes ``done.json`` on completion.
+"""
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def batch_digest(batch) -> str:
+    h = hashlib.sha256()
+    for part in batch:
+        arr = np.asarray(getattr(part, "data", part))
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()[:16]
+
+
+class LedgerDS:
+    """Deterministic per-index samples."""
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(50 + i)
+        return (rng.randn(4).astype(np.float32),
+                rng.randn(1).astype(np.float32))
+
+    def __len__(self):
+        return 24
+
+
+def main():
+    import paddle_tpu as pt
+    import paddle_tpu.nn as nn
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.data import DataPipeline
+    from paddle_tpu.resilience import FitResilience
+
+    run_dir = os.environ["DATA_TEST_DIR"]
+    epochs = int(os.environ.get("DATA_TEST_EPOCHS", "3"))
+    ledger = os.path.join(run_dir, "batches.jsonl")
+
+    pipe = DataPipeline(LedgerDS(), batch_size=4, shuffle=True,
+                        base_seed=5, drop_last=True)
+
+    pt.seed(11)
+    model = pt.hapi.Model(nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                        nn.Linear(8, 1)))
+    model.prepare(pt.optimizer.SGD(learning_rate=0.05,
+                                   parameters=model.parameters()),
+                  nn.MSELoss())
+    mgr = CheckpointManager(os.path.join(run_dir, "ckpt"),
+                            keep_last_k=None, async_=False)
+    fr = FitResilience(manager=mgr, save_every_steps=1, preemption=True,
+                      pipeline=pipe)
+    fr.restore(model)
+
+    last = {"d": None}
+
+    class Ledger(pt.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            with open(ledger, "a") as f:
+                f.write(json.dumps({"gs": fr.global_step,
+                                    "pid": os.getpid(),
+                                    "digest": last["d"]}) + "\n")
+
+    class Wrap:
+        """Digest each batch at DELIVERY (what the trainer consumed)."""
+
+        def __iter__(self):
+            for b in pipe:
+                last["d"] = batch_digest(b)
+                yield b
+
+    remaining = epochs - pipe.epoch
+    if remaining > 0:
+        model.fit(Wrap(), epochs=remaining, verbose=0,
+                  callbacks=[fr, Ledger()])
+    if not fr.preempted:
+        with open(os.path.join(run_dir, "done.json"), "w") as f:
+            json.dump({"pid": os.getpid(), "steps": fr.global_step}, f)
+    fr.exit_if_preempted()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,671 @@
+"""paddle_tpu.data — deterministic pipeline, packing, prefetch, resume.
+
+Tier-1 tests are in-process and cheap (tiny models, no fresh traces
+where avoidable); the SIGKILL → relaunch → identical-digest integration
+test is ``@pytest.mark.slow`` (worker: ``tests/data_worker.py``).
+"""
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+from paddle_tpu import io
+from paddle_tpu.data import (DataPipeline, DevicePrefetcher, SequencePacker,
+                             ShardedStream)
+from paddle_tpu.io.sampler import epoch_seed
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Docs:
+    """Deterministic variable-length token documents."""
+
+    def __init__(self, n=64, lo=5, hi=40, vocab=100):
+        self.n, self.lo, self.hi, self.vocab = n, lo, hi, vocab
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(900 + i)
+        return rng.randint(1, self.vocab,
+                           rng.randint(self.lo, self.hi)).astype(np.int32)
+
+    def __len__(self):
+        return self.n
+
+
+class Pairs:
+    """Deterministic (x, y) samples for fit-shaped pipelines."""
+
+    def __init__(self, n=24):
+        self.n = n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(50 + i)
+        return (rng.randn(4).astype(np.float32),
+                rng.randn(1).astype(np.float32))
+
+    def __len__(self):
+        return self.n
+
+
+class ToyLM(nn.Layer):
+    """Tiny self-supervised net with the packed-batch kwargs signature."""
+
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(100, 8)
+        self.head = nn.Linear(8, 100)
+
+    def forward(self, input_ids, labels, attention_mask=None,
+                position_ids=None):
+        h = self.emb(input_ids)
+        logits = self.head(h)
+        loss = nn.functional.cross_entropy(
+            logits, labels, ignore_index=-100)
+        return logits, loss
+
+
+def digest(batch) -> str:
+    h = hashlib.sha256()
+    if isinstance(batch, dict):
+        parts = [batch[k] for k in sorted(batch)]
+    else:
+        parts = list(batch)
+    for p in parts:
+        arr = np.asarray(getattr(p, "data", p))
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()[:16]
+
+
+# ============================ epoch seeding =================================
+class TestEpochSeed:
+    def test_stable_and_distinct(self):
+        assert epoch_seed(7, 3) == epoch_seed(7, 3)
+        seen = {epoch_seed(s, e) for s in range(4) for e in range(64)}
+        assert len(seen) == 4 * 64  # no collisions across nearby keys
+
+    def test_two_fresh_loaders_agree(self):
+        """The satellite regression: a REBUILT DataLoader replays the
+        same shuffled order (prerequisite for deterministic resume)."""
+        ds = Pairs(16)
+
+        def orders(n_epochs=2):
+            dl = io.DataLoader(ds, batch_size=4, shuffle=True, base_seed=9)
+            return [digest(b) for _ in range(n_epochs) for b in dl]
+
+        assert orders() == orders()
+
+    def test_epochs_shuffle_differently(self):
+        s = io.RandomSampler(Pairs(32), base_seed=1)
+        e0, e1 = list(s), list(s)
+        assert sorted(e0) == sorted(e1)
+        assert e0 != e1  # epoch-keyed, not frozen
+
+    def test_set_epoch_pins_order(self):
+        a = io.RandomSampler(Pairs(32), base_seed=1)
+        b = io.RandomSampler(Pairs(32), base_seed=1)
+        list(a)  # advance a to epoch 1
+        b.set_epoch(1)
+        assert list(a) == list(b)
+
+    def test_distributed_sampler_rebuild_replays(self):
+        ds = Pairs(16)
+
+        def order(epoch):
+            s = io.DistributedBatchSampler(ds, batch_size=2,
+                                           num_replicas=2, rank=0,
+                                           shuffle=True, base_seed=3)
+            s.set_epoch(epoch)
+            return [i for b in s for i in b]
+
+        assert order(2) == order(2)
+        assert order(2) != order(3)
+
+
+# ============================ sharded stream ================================
+class TestShardedStream:
+    def test_shards_disjoint_cover_balanced(self):
+        ds = Pairs(24)
+        per_shard = []
+        for k in range(3):
+            s = ShardedStream(ds, base_seed=5, shard_index=k, num_shards=3)
+            per_shard.append([int(i) for i in s.epoch_order(0)])
+        flat = [i for sh in per_shard for i in sh]
+        assert sorted(flat) == list(range(24))
+        assert all(len(sh) == 8 for sh in per_shard)
+
+    def test_rebuild_replays_and_epochs_differ(self):
+        def epochs():
+            s = ShardedStream(Pairs(12), base_seed=2, shard_index=0,
+                              num_shards=1)
+            return [digest(b) for b in s], [digest(b) for b in s]
+
+        (a0, a1), (b0, b1) = epochs(), epochs()
+        assert a0 == b0 and a1 == b1
+        assert a0 != a1
+
+    def test_state_roundtrip_mid_epoch(self):
+        ds = Pairs(12)
+        ref = [digest(x) for x in
+               ShardedStream(ds, base_seed=4, shard_index=0, num_shards=1)]
+        s1 = ShardedStream(ds, base_seed=4, shard_index=0, num_shards=1)
+        it = iter(s1)
+        got = [digest(next(it)) for _ in range(5)]
+        state = s1.state_dict()
+        assert state["cursor"] == 5
+        s2 = ShardedStream(ds, base_seed=4, shard_index=0, num_shards=1)
+        s2.load_state_dict(state)
+        got += [digest(x) for x in s2]
+        assert got == ref
+
+    def test_mesh_size_change_refused(self):
+        s1 = ShardedStream(Pairs(12), shard_index=0, num_shards=2,
+                           shuffle=False)
+        s2 = ShardedStream(Pairs(12), shard_index=0, num_shards=3,
+                           shuffle=False)
+        with pytest.raises(ValueError, match="mesh-size-preserving"):
+            s2.load_state_dict(s1.state_dict())
+
+    def test_geometry_disagreement_refused(self):
+        """drop_remainder / shard identity change the order the cursor
+        indexes — restoring across them must refuse, not drift."""
+        s1 = ShardedStream(Pairs(13), shard_index=0, num_shards=2,
+                           shuffle=False, drop_remainder=True)
+        s2 = ShardedStream(Pairs(13), shard_index=0, num_shards=2,
+                           shuffle=False, drop_remainder=False)
+        with pytest.raises(ValueError, match="drop_remainder"):
+            s2.load_state_dict(s1.state_dict())
+        s3 = ShardedStream(Pairs(13), shard_index=1, num_shards=2,
+                           shuffle=False)
+        with pytest.raises(ValueError, match="OWN data state"):
+            s3.load_state_dict(s1.state_dict())
+
+    def test_iterable_resume_skips_and_counts(self):
+        class It(io.IterableDataset):
+            def __iter__(self):
+                return iter(np.arange(10, dtype=np.float32))
+
+        reg = MetricsRegistry()
+        s1 = ShardedStream(It(), shuffle=False, shard_index=0,
+                           num_shards=1, registry=reg)
+        it = iter(s1)
+        first = [float(next(it)) for _ in range(4)]
+        s2 = ShardedStream(It(), shuffle=False, shard_index=0,
+                           num_shards=1, registry=reg)
+        s2.load_state_dict(s1.state_dict())
+        rest = [float(x) for x in s2]
+        assert first + rest == list(range(10))
+        skipped = reg.get("data_skipped_on_resume_total")
+        assert skipped.total() == 4  # the fast-forwarded samples
+
+    def test_epoch_boundary_state_normalizes(self):
+        s1 = ShardedStream(Pairs(8), base_seed=1, shard_index=0,
+                           num_shards=1)
+        it = iter(s1)
+        for _ in range(8):
+            next(it)
+        # state captured at the final sample: cursor == epoch length
+        state = s1.state_dict()
+        assert state["cursor"] == 8 and state["epoch"] == 0
+        s2 = ShardedStream(Pairs(8), base_seed=1, shard_index=0,
+                           num_shards=1)
+        s2.load_state_dict(state)
+        assert s2.epoch == 1 and s2.cursor == 0
+
+
+# ============================== packer ======================================
+class TestSequencePacker:
+    def test_exactly_once_and_layout(self):
+        docs = [Docs()[i] for i in range(20)]
+        p = SequencePacker(seq_len=64, batch_size=2,
+                           registry=MetricsRegistry())
+        batches = []
+        for d in docs:
+            batches += p.add(d)
+        tail = p.flush()
+        if tail is not None:
+            batches.append(tail)
+        # every token appears exactly once, in order within its doc
+        packed = np.concatenate(
+            [b["input_ids"][b["attention_mask"] > 0] for b in batches])
+        assert len(packed) == sum(len(d) for d in docs)
+        for b in batches:
+            ids, seg, pos, lab = (b["input_ids"], b["attention_mask"],
+                                  b["position_ids"], b["labels"])
+            assert ids.shape == seg.shape == pos.shape == lab.shape
+            for r in range(seg.shape[0]):
+                for sid in np.unique(seg[r]):
+                    if sid == 0:
+                        continue
+                    span = np.where(seg[r] == sid)[0]
+                    # contiguous doc, positions restart at 0
+                    assert np.array_equal(span,
+                                          np.arange(span[0],
+                                                    span[-1] + 1))
+                    assert np.array_equal(pos[r, span],
+                                          np.arange(len(span)))
+                    # first token of each doc and padding are unlabeled
+                    assert lab[r, span[0]] == -100
+                    assert np.array_equal(lab[r, span[1:]],
+                                          ids[r, span[1:]])
+            assert np.all(lab[seg == 0] == -100)
+
+    def test_efficiency_on_synthetic_corpus(self):
+        """The bench.py --data acceptance geometry, asserted in-process:
+        first-fit reaches >= 85% density."""
+        reg = MetricsRegistry()
+        corpus = Docs(n=256, lo=24, hi=129, vocab=500)
+        pipe = DataPipeline(corpus, batch_size=2, seq_len=256, pack=True,
+                            base_seed=3, shuffle=True, drop_last=True,
+                            registry=reg)
+        n = 0
+        for _ in pipe:
+            n += 1
+            if n >= 20:
+                break
+        stats = reg.get("data_packing_efficiency").stats()
+        assert stats["count"] >= 20
+        assert stats["mean"] >= 0.85
+
+    def test_long_doc_splits(self):
+        p = SequencePacker(seq_len=16, batch_size=1)
+        batches = p.add(np.arange(1, 41, dtype=np.int32))  # 40 tokens
+        tail = p.flush()
+        got = np.concatenate(
+            [b["input_ids"][b["attention_mask"] > 0]
+             for b in batches + [tail]])
+        assert np.array_equal(got, np.arange(1, 41))
+
+    def test_carry_roundtrip(self):
+        docs = [Docs()[i] for i in range(30)]
+        ref_p = SequencePacker(seq_len=64, batch_size=2)
+        ref = []
+        for d in docs:
+            ref += [digest(b) for b in ref_p.add(d)]
+
+        p1 = SequencePacker(seq_len=64, batch_size=2)
+        got = []
+        for d in docs[:13]:
+            got += [digest(b) for b in p1.add(d)]
+        state = p1.state_dict()
+        assert any(len(bins) for bins in state["bins"])  # real carry
+        p2 = SequencePacker(seq_len=64, batch_size=2)
+        p2.load_state_dict(state)
+        for d in docs[13:]:
+            got += [digest(b) for b in p2.add(d)]
+        assert got == ref
+
+    def test_efficiency_stats_per_instance(self):
+        """The histogram is process-global; efficiency_stats() must
+        report only this packer's batches."""
+        reg = MetricsRegistry()
+        a = SequencePacker(seq_len=8, batch_size=1, registry=reg)
+        b = SequencePacker(seq_len=8, batch_size=1, registry=reg)
+        a.add(np.arange(1, 9, dtype=np.int32))   # fills, next add flushes
+        a.add(np.arange(1, 9, dtype=np.int32))   # flush: eff 1.0
+        b.add(np.arange(1, 3, dtype=np.int32))
+        assert b.flush() is not None             # eff 0.25
+        assert a.efficiency_stats() == {"mean": 1.0, "count": 1}
+        assert b.efficiency_stats()["mean"] == pytest.approx(0.25)
+
+    def test_geometry_mismatch_refused(self):
+        p1 = SequencePacker(seq_len=64, batch_size=2)
+        p2 = SequencePacker(seq_len=32, batch_size=2)
+        with pytest.raises(ValueError, match="geometry"):
+            p2.load_state_dict(p1.state_dict())
+
+
+# ============================= pipeline =====================================
+class TestDataPipeline:
+    def _digests(self, pipe, epochs=2):
+        return [digest(b) for _ in range(epochs) for b in pipe]
+
+    def test_packed_resume_matches_uninterrupted(self):
+        kw = dict(batch_size=2, seq_len=64, pack=True, base_seed=7,
+                  shuffle=True, drop_last=True)
+        ref = self._digests(DataPipeline(Docs(40), **kw))
+        p1 = DataPipeline(Docs(40), **kw)
+        it = iter(p1)
+        got = [digest(next(it)) for _ in range(4)]
+        state = p1.state_dict()
+        p2 = DataPipeline(Docs(40), **kw)
+        p2.load_state_dict(state)
+        # p2's first __iter__ finishes epoch 0's remainder, the second
+        # runs epoch 1 — same coverage as the uninterrupted reference
+        got += self._digests(p2, epochs=2)
+        assert got == ref
+
+    def test_plain_resume_matches_uninterrupted(self):
+        kw = dict(batch_size=4, shuffle=True, base_seed=5, drop_last=True)
+        ref = self._digests(DataPipeline(Pairs(), **kw))
+        p1 = DataPipeline(Pairs(), **kw)
+        it = iter(p1)
+        got = [digest(next(it)) for _ in range(3)]
+        p2 = DataPipeline(Pairs(), **kw)
+        p2.load_state_dict(p1.state_dict())
+        got += self._digests(p2, epochs=2)
+        assert got == ref
+
+    def test_prefetch_preserves_order_slow_dataset(self):
+        class Slow(Pairs):
+            def __getitem__(self, i):
+                time.sleep(0.003)
+                return super().__getitem__(i)
+
+        kw = dict(batch_size=4, shuffle=True, base_seed=3, drop_last=True)
+        sync = [digest(b) for b in DataPipeline(Slow(), **kw)]
+        pre = [digest(b) for b in
+               DataPipeline(Slow(), device_prefetch=3, **kw)]
+        assert pre == sync
+
+    def test_prefetch_commits_at_delivery(self):
+        pipe = DataPipeline(Pairs(), batch_size=4, shuffle=True,
+                            base_seed=3, drop_last=True,
+                            device_prefetch=3)
+        it = iter(pipe)
+        next(it)
+        next(it)
+        time.sleep(0.1)  # let the producer run ahead into the buffer
+        assert pipe.state_dict()["step"] == 2  # delivered, not produced
+        rest = list(it)
+        assert pipe.state_dict()["step"] == 2 + len(rest)
+
+    def test_prefetch_early_break_replays_buffered_batches(self):
+        """An early-exiting consumer (num_iters / preemption) must not
+        lose the batches the producer had buffered: re-iteration
+        re-anchors at the delivered position."""
+        kw = dict(batch_size=4, shuffle=True, base_seed=3, drop_last=True)
+        ref = [digest(b) for b in DataPipeline(Pairs(), **kw)]
+        pipe = DataPipeline(Pairs(), device_prefetch=4, **kw)
+        it = iter(pipe)
+        got = [digest(next(it))]
+        time.sleep(0.1)  # the producer buffers well past batch 1
+        del it  # consumer breaks out
+        got += [digest(b) for b in pipe]  # re-enter the epoch
+        assert got == ref
+
+    def test_checkpoint_between_multi_batch_flush(self):
+        """One long document can flush SEVERAL batches from a single
+        packer.add() while the stream cursor is already past the doc; a
+        checkpoint taken between those flushes must not lose the later
+        batches (they ride the state as `pending`)."""
+        class LongDocs:
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                return rng.randint(1, 50, 70).astype(np.int32)
+
+            def __len__(self):
+                return 4
+
+        kw = dict(batch_size=2, seq_len=8, pack=True, shuffle=False,
+                  drop_last=True)
+        ref = [digest(b) for b in DataPipeline(LongDocs(), **kw)]
+        assert len(ref) > len(LongDocs())  # multi-batch adds happened
+        for cut in range(1, len(ref)):
+            p1 = DataPipeline(LongDocs(), **kw)
+            it = iter(p1)
+            got = [digest(next(it)) for _ in range(cut)]
+            p2 = DataPipeline(LongDocs(), **kw)
+            p2.load_state_dict(p1.state_dict())
+            got += [digest(b) for b in p2]
+            assert got == ref, f"diverged after checkpoint at batch {cut}"
+
+    def test_prefetch_consumer_exit_joins_producer(self):
+        """Leaving a prefetching iteration must JOIN the producer thread:
+        a straggler still running inside the pairs generator would race
+        the re-anchoring load_state_dict of the next __iter__."""
+        import threading
+        pipe = DataPipeline(Pairs(), batch_size=4, shuffle=True,
+                            base_seed=3, drop_last=True, device_prefetch=2)
+        it = iter(pipe)
+        next(it)
+        it.close()  # early consumer exit — must synchronously stop+join
+        assert not [t for t in threading.enumerate()
+                    if t.name == "pt-data-prefetch" and t.is_alive()]
+
+    def test_external_prefetcher_on_pipeline_refused(self):
+        pipe = DataPipeline(Pairs(), batch_size=4)
+        with pytest.raises(ValueError, match="device_prefetch"):
+            DevicePrefetcher(pipe)
+
+    def test_device_prefetcher_wraps_plain_loader(self):
+        dl = io.DataLoader(Pairs(), batch_size=4, shuffle=True,
+                           base_seed=1)
+        ref = [digest(b) for b in
+               io.DataLoader(Pairs(), batch_size=4, shuffle=True,
+                             base_seed=1)]
+        got = []
+        for b in DevicePrefetcher(dl, depth=2):
+            assert isinstance(b[0], pt.Tensor)  # already device-resident
+            got.append(digest(b))
+        assert got == ref
+
+    def test_bad_samples_share_loader_budget(self):
+        class Flaky(Pairs):
+            def __getitem__(self, i):
+                if i == 3:
+                    raise IOError("shard rot")
+                return super().__getitem__(i)
+
+        reg = MetricsRegistry()
+        pipe = DataPipeline(Flaky(8), batch_size=2, shuffle=False,
+                            max_bad_samples=2, registry=reg)
+        with pytest.warns(RuntimeWarning, match="stream"):
+            n = sum(1 for _ in pipe)
+        assert n == 4  # 7 good samples -> 3 full pairs + 1 tail
+        from paddle_tpu.observability.metrics import get_registry
+        c = get_registry().get("loader_bad_samples_total")
+        assert c is not None and c.value(stage="stream") >= 1
+
+    def test_bad_sample_budget_exhausts_loudly(self):
+        class Broken(Pairs):
+            def __getitem__(self, i):
+                raise IOError("all gone")
+
+        pipe = DataPipeline(Broken(6), batch_size=2, shuffle=False,
+                            max_bad_samples=2)
+        with pytest.raises(RuntimeError, match="budget exhausted"), \
+                pytest.warns(RuntimeWarning):
+            list(pipe)
+
+
+# ========================= packed model path ================================
+class TestPackedModelPath:
+    def test_packed_attention_equals_separate_docs(self):
+        """The kernel-facing contract: packing with segment ids +
+        per-document positions is bit-identical to attending each
+        document alone (flash kernel's segment masking + RoPE gather)."""
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        pt.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        m.eval()
+        d1 = np.arange(1, 9, dtype=np.int32)
+        d2 = np.arange(20, 26, dtype=np.int32)
+        S = 16
+        ids = np.zeros((1, S), np.int32)
+        seg = np.zeros((1, S), np.int32)
+        pos = np.zeros((1, S), np.int32)
+        ids[0, :8], seg[0, :8], pos[0, :8] = d1, 1, np.arange(8)
+        ids[0, 8:14], seg[0, 8:14], pos[0, 8:14] = d2, 2, np.arange(6)
+        packed = m(pt.to_tensor(ids), attention_mask=pt.to_tensor(seg),
+                   position_ids=pt.to_tensor(pos)).numpy()
+        l1 = m(pt.to_tensor(d1[None, :])).numpy()
+        l2 = m(pt.to_tensor(d2[None, :])).numpy()
+        np.testing.assert_allclose(packed[0, :8], l1[0], atol=1e-5)
+        np.testing.assert_allclose(packed[0, 8:14], l2[0], atol=1e-5)
+
+    def test_fit_packed_dict_batches(self):
+        """Dict batches flow through Model.prepare(loss=None) as network
+        kwargs (the packed-pipeline fit contract)."""
+        net = ToyLM()
+        model = pt.hapi.Model(net)
+        model.prepare(pt.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+                      loss=None)
+        pipe = DataPipeline(Docs(24), batch_size=2, seq_len=32, pack=True,
+                            base_seed=1, drop_last=True)
+        history = model.fit(pipe, epochs=1, verbose=0)
+        assert np.isfinite(history["loss"][0])
+        assert pipe.step > 0
+
+    def test_dict_batch_with_loss_prepared_refused(self):
+        """A loss-prepared model can't consume packed dict batches — the
+        error must say so instead of dying inside jit tracing."""
+        net = ToyLM()
+        model = pt.hapi.Model(net)
+        model.prepare(pt.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+                      loss=nn.MSELoss())
+        batch = {"input_ids": np.ones((2, 8), np.int32),
+                 "labels": np.ones((2, 8), np.int32)}
+        with pytest.raises(RuntimeError, match="loss=None"):
+            model.train_batch(batch)
+        with pytest.raises(RuntimeError, match="loss=None"):
+            model.eval_batch(batch)
+
+    def test_evaluate_packed_dict_batches(self):
+        """evaluate() routes dict batches through the self-supervised
+        network too — fit(train, eval_data=packed_pipe) must work."""
+        net = ToyLM()
+        model = pt.hapi.Model(net)
+        model.prepare(pt.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters()),
+                      loss=None)
+        train = DataPipeline(Docs(16), batch_size=2, seq_len=32, pack=True,
+                             base_seed=1, drop_last=True)
+        ev = DataPipeline(Docs(12), batch_size=2, seq_len=32, pack=True,
+                          base_seed=2, drop_last=True)
+        history = model.fit(train, eval_data=ev, epochs=1, verbose=0)
+        assert np.isfinite(history["loss"][0])
+        logs = model.evaluate(ev, verbose=0)
+        assert np.isfinite(logs["loss"])
+
+
+# ===================== resilience / checkpoint integration ==================
+class TestExactlyOnceResume:
+    def _run(self, tmp_path, trip_at=None, epochs=3):
+        """One trainer 'process' (in-process): tiny fit over the
+        pipeline with FitResilience committing data state every step;
+        returns the digests of batches actually trained."""
+        from paddle_tpu.resilience import FitResilience
+
+        seen = []
+        pipe = DataPipeline(Pairs(), batch_size=4, shuffle=True,
+                            base_seed=5, drop_last=True)
+        pt.seed(11)
+        model = pt.hapi.Model(nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                            nn.Linear(8, 1)))
+        model.prepare(pt.optimizer.SGD(learning_rate=0.05,
+                                       parameters=model.parameters()),
+                      nn.MSELoss())
+        fr = FitResilience(checkpoint_dir=str(tmp_path / "ckpt"),
+                           save_every_steps=1, preemption=True,
+                           pipeline=pipe)
+        fr.restore(model)
+
+        class Trip(pt.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if trip_at is not None and fr.global_step == trip_at:
+                    fr.listener.request("test")
+
+        class Wrap:
+            def __iter__(self):
+                for b in pipe:
+                    seen.append(digest(b))
+                    yield b
+
+        remaining = epochs - pipe.epoch
+        if remaining > 0:
+            model.fit(Wrap(), epochs=remaining, verbose=0,
+                      callbacks=[fr, Trip()])
+        return seen, fr
+
+    def test_preempt_resume_is_exactly_once(self, tmp_path):
+        """The acceptance criterion: batch digests across kill+relaunch
+        equal an uninterrupted run's, and the iterator state commits in
+        the SAME step dir as model+opt."""
+        ref, _ = self._run(tmp_path / "ref")
+        first, fr1 = self._run(tmp_path / "killed", trip_at=8)
+        assert fr1.preempted and fr1.exit_code == 79
+        # the final committed step carries model+opt+data atomically
+        state = fr1.manager.restore()
+        assert set(state) >= {"model", "optimizer", "data"}
+        assert state["data"]["step"] == len(first)
+        second, fr2 = self._run(tmp_path / "killed")
+        assert not fr2.preempted
+        assert first + second == ref
+
+    def test_data_state_survives_checkpoint_roundtrip(self, tmp_path):
+        """Packer carry (numpy arrays inside aux/shards) round-trips
+        bit-exactly through the CheckpointManager layout."""
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        pipe = DataPipeline(Docs(30), batch_size=2, seq_len=64, pack=True,
+                            base_seed=2, drop_last=True)
+        it = iter(pipe)
+        for _ in range(3):
+            next(it)
+        state = pipe.state_dict()
+        assert any(len(b) for b in state["packer"]["bins"])  # live carry
+        mgr = CheckpointManager(str(tmp_path), async_=False)
+        mgr.save(1, {"data": state})
+        restored = mgr.restore()["data"]
+        p2 = DataPipeline(Docs(30), batch_size=2, seq_len=64, pack=True,
+                          base_seed=2, drop_last=True)
+        p2.load_state_dict(restored)
+        a = [digest(b) for b in it]
+        b = [digest(x) for x in p2]
+        assert b == a
+
+
+# ========================= slow integration =================================
+@pytest.mark.slow
+def test_sigkill_relaunch_digest_identical(tmp_path):
+    """Chaos SIGKILL mid-run → relaunch → the ledger of trained-batch
+    digests across both processes equals an uninterrupted run's
+    (exactly-once data through a REAL process death, not an in-process
+    simulation)."""
+    def run_job(run_dir, kill_step=None):
+        env = dict(os.environ)
+        env.update({"DATA_TEST_DIR": str(run_dir),
+                    "DATA_TEST_EPOCHS": "3",
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": ROOT})
+        if kill_step is not None:
+            env["PADDLE_TPU_CHAOS_KILL_AT_STEP"] = str(kill_step)
+            env["PADDLE_TPU_CHAOS_MARK_DIR"] = str(run_dir)
+        return subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tests",
+                                          "data_worker.py")],
+            env=env, timeout=300, capture_output=True, text=True)
+
+    def ledger(run_dir):
+        path = os.path.join(run_dir, "batches.jsonl")
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    r = run_job(ref_dir)
+    assert r.returncode == 0, r.stderr
+    ref = [e["digest"] for e in ledger(ref_dir)]
+
+    job_dir = tmp_path / "job"
+    job_dir.mkdir()
+    r1 = run_job(job_dir, kill_step=7)
+    assert r1.returncode != 0  # SIGKILL'd
+    r2 = run_job(job_dir)  # relaunch (mark dir suppresses a second kill)
+    assert r2.returncode == 0, r2.stderr
+    entries = ledger(job_dir)
+    pids = list(dict.fromkeys(e["pid"] for e in entries))
+    assert len(pids) == 2  # really two processes
+    assert [e["digest"] for e in entries] == ref
+    assert os.path.exists(os.path.join(job_dir, "done.json"))

@@ -332,6 +332,19 @@ class TestChaosSeams:
         x2 = chaos.poison_batch(3, x)
         assert not np.isnan(x2).any()  # wrong step: untouched
 
+    def test_poison_int_batch_escalates_to_loss(self):
+        """Packed-pipeline batches are all-int — nothing to NaN-fill, so
+        the poison must land on the step's loss instead of silently not
+        firing (the NaN guard still needs a fault to prove recovery)."""
+        os.environ["PADDLE_TPU_CHAOS_POISON_BATCH"] = "2"
+        chaos.refresh()
+        batch = {"input_ids": np.ones((2, 4), np.int32),
+                 "labels": np.ones((2, 4), np.int32)}
+        out = chaos.poison_batch(2, batch)
+        assert (out["input_ids"] == 1).all()  # ints stay valid tokens
+        assert np.isnan(chaos.corrupt_loss(2, 1.0))  # fault still fires
+        assert chaos.corrupt_loss(2, 1.0) == 1.0  # exactly once
+
     def test_mark_dir_fires_once_per_job(self, tmp_path):
         os.environ["PADDLE_TPU_CHAOS_CORRUPT_LOSS"] = "5"
         os.environ["PADDLE_TPU_CHAOS_MARK_DIR"] = str(tmp_path)
