@@ -95,6 +95,13 @@ class SequencePacker:
                 self._fill[0] = len(chunk)
         return out
 
+    @property
+    def has_carry(self) -> bool:
+        """True while the open bins hold tokens — i.e. ``flush()`` would
+        emit a batch (the pipeline uses this to spot an epoch tail whose
+        flush a checkpoint landed in front of)."""
+        return any(self._fill)
+
     def flush(self) -> Optional[Dict[str, np.ndarray]]:
         """Emit the open bins as a (partial) batch; None when empty."""
         if not any(self._fill):

@@ -91,8 +91,33 @@ class DataPipeline:
         self._committed = self._capture()
 
     # -- state -----------------------------------------------------------------
+    def _next_epoch(self) -> int:
+        """Epoch of the next batch this pipeline will deliver, given
+        the CURRENT stream/packer/pending state. Two corrections over
+        raw ``stream.epoch``: a normalized-to-next-epoch stream whose
+        pending batches / unflushed drop_last=False carry still owe the
+        finished epoch its tail reports the FINISHED epoch; an epoch's
+        final in-loop batch (captured before the stream's lazy
+        rollover, cursor at epoch length) reports the NEXT epoch once
+        nothing more is owed."""
+        e, cur = self.stream.epoch, self.stream.cursor
+        tail_owed = bool(self._pending or
+                         (self.pack and not self.drop_last and
+                          self.packer.has_carry))
+        if cur == 0:
+            return e - 1 if tail_owed else e
+        try:
+            n = self.stream.samples_per_epoch()
+        except TypeError:
+            return e  # iterable: no length, rollover stays lazy
+        if cur >= n and not tail_owed:
+            return e + 1
+        return e
+
     def _capture(self) -> dict:
         state = {"version": STATE_VERSION, "step": int(self._step),
+                 "epoch": self._next_epoch(),
+                 "drop_last": self.drop_last,
                  "stream": self.stream.state_dict()}
         if self.packer is not None:
             state["packer"] = self.packer.state_dict()
@@ -113,12 +138,26 @@ class DataPipeline:
                 f"unsupported pipeline state version "
                 f"{state.get('version')!r} (this build writes "
                 f"{STATE_VERSION})")
+        if bool(state.get("drop_last", self.drop_last)) != self.drop_last:
+            raise ValueError(
+                f"pipeline state was saved with drop_last="
+                f"{state['drop_last']}, this pipeline has drop_last="
+                f"{self.drop_last} — the flag decides whether a "
+                "restored epoch-tail carry flushes or rides into the "
+                "next epoch, so resuming across it would silently "
+                "change the batch sequence")
         self.stream.load_state_dict(state["stream"])
         if self.packer is not None:
             if "packer" not in state:
                 raise ValueError("state has no packer carry but this "
                                  "pipeline packs")
             self.packer.load_state_dict(state["packer"])
+        elif "packer" in state:
+            raise ValueError(
+                "state carries a packer carry but this pipeline does "
+                "not pack — the carry (and any pending batches) would "
+                "be silently dropped; rebuild with pack=True to resume "
+                "this state")
         self._pending = [
             {k: np.asarray(v) for k, v in b.items()}
             for b in state.get("pending", [])]
@@ -132,7 +171,15 @@ class DataPipeline:
 
     @property
     def epoch(self) -> int:
-        return self.stream.epoch
+        """Epoch of the NEXT batch to be delivered — read from the
+        COMMITTED state like ``step`` (under prefetch the producer's
+        live stream may already be an epoch ahead of the trainer). At a
+        restored epoch tail (stream normalized to the next epoch while
+        pending batches / an unflushed drop_last=False carry still owe
+        the finished epoch its tail) this is still the FINISHED epoch —
+        so ``epochs - pipe.epoch`` relaunch loops drive one more
+        ``__iter__`` to collect the tail instead of skipping it."""
+        return int(self._committed["epoch"])
 
     def __len__(self):
         if self.pack:
@@ -155,16 +202,26 @@ class DataPipeline:
             # can land between the flushes of one multi-batch add() (long
             # document) and the stream cursor is already past that doc —
             # these batches exist only in the saved state. cursor == 0
-            # alongside a nonempty pending means that doc was the LAST of
-            # its epoch (the stream normalized to the next epoch's start):
-            # the pending batches complete the finished epoch, so this
-            # __iter__ ends after them instead of bleeding into the next
-            # epoch's samples.
-            if self._pending:
+            # means the stream normalized to the next epoch's start, i.e.
+            # the state was captured at the FINISHED epoch's tail: any
+            # pending batches — and, with drop_last=False, the packer's
+            # still-unflushed carry — complete that epoch, so this
+            # __iter__ ends after them instead of bleeding them into the
+            # next epoch's samples.
+            if self._pending or (self.stream.cursor == 0 and
+                                 not self.drop_last and
+                                 self.packer.has_carry):
                 tail_of_epoch = self.stream.cursor == 0
                 while self._pending:
                     yield self._pair(self._pending.pop(0))
                 if tail_of_epoch:
+                    if not self.drop_last:
+                        # the restored carry is the finished epoch's tail
+                        # batch the kill landed in front of — deliver it
+                        # exactly where the uninterrupted run would have
+                        tail = self.packer.flush()
+                        if tail is not None:
+                            yield self._pair(tail)
                     return
             for sample in self.stream:
                 doc = sample if self.to_tokens is None \

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 from typing import Iterator
 
 import numpy as np
@@ -64,24 +65,53 @@ def _resolve_sharding(sharding):
         return None
 
 
+_unsharded_fallback_warned = False
+
+
+def _fits_sharding(sharding, shape) -> bool:
+    """Whether ``shape`` is evenly placeable under ``sharding`` — the one
+    legitimate reason to downgrade to an unsharded put. Everything else
+    (misconfigured sharding, device OOM) must raise, not silently
+    degrade placement."""
+    shard_shape = getattr(sharding, "shard_shape", None)
+    if shard_shape is None:
+        return True  # cannot pre-check; let device_put decide (and raise)
+    try:
+        shard_shape(tuple(shape))
+        return True
+    except (ValueError, IndexError):
+        # ValueError: uneven shard shape; IndexError: leaf rank smaller
+        # than the PartitionSpec (scalars/1-D leaves under a multi-axis
+        # sharding) — both are shape-vs-sharding mismatches that take
+        # the unsharded fallback; anything else propagates
+        return False
+
+
 def to_device(batch, sharding=None):
     """``jax.device_put`` every array leaf of ``batch`` (dict/tuple/list
-    nesting preserved), wrapped as Tensors. Non-divisible leaves fall
-    back to an unsharded put rather than failing the pipeline."""
+    nesting preserved), wrapped as Tensors. Leaves the sharding cannot
+    divide evenly fall back to an unsharded put (warned once per run);
+    any other placement failure propagates."""
     import jax
 
     from paddle_tpu.core.tensor import Tensor
 
     def put(leaf):
+        global _unsharded_fallback_warned
         if isinstance(leaf, Tensor):
             leaf = leaf.data
         if not hasattr(leaf, "shape"):
             leaf = np.asarray(leaf)
         if sharding is not None:
-            try:
+            if _fits_sharding(sharding, leaf.shape):
                 return Tensor(jax.device_put(leaf, sharding))
-            except Exception:
-                pass
+            if not _unsharded_fallback_warned:
+                _unsharded_fallback_warned = True
+                warnings.warn(
+                    f"prefetch: leaf of shape {tuple(leaf.shape)} does "
+                    f"not divide evenly under {sharding}; falling back "
+                    "to an unsharded device_put (reported once per run)",
+                    RuntimeWarning, stacklevel=3)
         return Tensor(jax.device_put(leaf))
 
     def walk(obj):

@@ -145,29 +145,49 @@ class ShardedStream:
 
     def _iter_iterable(self):
         skip = self.cursor
-        if skip:
-            self._m["skipped_on_resume"].inc(skip)
         pos = 0  # arrival position within this shard, this epoch
+        replayed = 0  # counted into the metric when the skip phase ends:
+        # a truncated source must not inflate it with samples that were
+        # never replayed, and a multi-million-sample fast-forward must
+        # not pay a counter lock per sample
         for j, sample in enumerate(self.dataset):
             if j % self.num_shards != self.shard_index:
                 continue
             if pos < skip:
                 pos += 1
+                replayed += 1
                 continue
+            if replayed:
+                self._m["skipped_on_resume"].inc(replayed)
+                replayed = 0
             pos += 1
             self.cursor = pos
             yield sample
+        if replayed:
+            self._m["skipped_on_resume"].inc(replayed)
+        if pos < skip:
+            raise RuntimeError(
+                f"iterable source exhausted after {pos} samples for "
+                f"shard {self.shard_index}/{self.num_shards} while "
+                f"fast-forwarding to resume cursor {skip} — the source "
+                "shrank or changed since the checkpoint, so the saved "
+                "position no longer exists and deterministic resume is "
+                "impossible; restart the epoch with a fresh pipeline "
+                "instead")
         self.epoch += 1
         self.cursor = 0
 
     # -- checkpointable state --------------------------------------------------
     def state_dict(self) -> dict:
-        return {"epoch": int(self.epoch), "cursor": int(self.cursor),
-                "base_seed": self.base_seed,
-                "num_shards": self.num_shards,
-                "shard_index": self.shard_index,
-                "shuffle": self.shuffle,
-                "drop_remainder": self.drop_remainder}
+        state = {"epoch": int(self.epoch), "cursor": int(self.cursor),
+                 "base_seed": self.base_seed,
+                 "num_shards": self.num_shards,
+                 "shard_index": self.shard_index,
+                 "shuffle": self.shuffle,
+                 "drop_remainder": self.drop_remainder}
+        if not self._iterable:
+            state["dataset_len"] = len(self.dataset)
+        return state
 
     def load_state_dict(self, state: dict):
         if int(state.get("num_shards", self.num_shards)) != self.num_shards:
@@ -194,6 +214,14 @@ class ShardedStream:
                 "base_seed/drop_remainder — the cursor would index a "
                 "different order; resuming would silently change the "
                 "sample sequence")
+        if not self._iterable and "dataset_len" in state and \
+                int(state["dataset_len"]) != len(self.dataset):
+            raise ValueError(
+                f"stream state was saved over a dataset of "
+                f"{state['dataset_len']} samples, this dataset has "
+                f"{len(self.dataset)} — the epoch permutation would "
+                "differ and the cursor would index different samples; "
+                "deterministic resume requires the same dataset")
         self.epoch = int(state["epoch"])
         self.cursor = int(state["cursor"])
         # a state captured with an epoch's FINAL batch has cursor at the

@@ -460,8 +460,15 @@ class Model:
                 if num_iters is not None and step >= num_iters:
                     break
                 t_fetch = _time.perf_counter()
-            logs = {"loss": float(np.mean(epoch_losses))}
-            history["loss"].append(logs["loss"])
+            if epoch_losses:
+                logs = {"loss": float(np.mean(epoch_losses))}
+                history["loss"].append(logs["loss"])
+            else:
+                # a resumed epoch can legitimately deliver zero batches
+                # (checkpoint cursor already past the last full batch
+                # with drop_last=True) — a mean over nothing would log
+                # a spurious NaN that reads as a training blow-up
+                logs = {}
             if eval_data is not None and not self._stop_training and \
                     (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_data, batch_size,

@@ -205,6 +205,46 @@ class TestShardedStream:
         skipped = reg.get("data_skipped_on_resume_total")
         assert skipped.total() == 4  # the fast-forwarded samples
 
+    def test_dataset_size_change_refused(self):
+        """A map-style dataset that grew or shrank since the checkpoint
+        reshuffles the epoch permutation — the cursor would index
+        different samples, so resume must refuse, not silently drift."""
+        s1 = ShardedStream(Pairs(10), base_seed=4, shard_index=0,
+                           num_shards=1)
+        it = iter(s1)
+        for _ in range(6):
+            next(it)
+        s2 = ShardedStream(Pairs(12), base_seed=4, shard_index=0,
+                           num_shards=1)
+        with pytest.raises(ValueError, match="same dataset"):
+            s2.load_state_dict(s1.state_dict())
+
+    def test_iterable_resume_truncated_source_raises(self):
+        """A saved cursor past the end of a shrunken iterable source
+        must fail loudly (the epoch would otherwise silently complete
+        having yielded nothing) and the skip metric must count only the
+        samples actually replayed, not the full cursor upfront."""
+        class It(io.IterableDataset):
+            def __init__(self, n):
+                self.n = n
+
+            def __iter__(self):
+                return iter(np.arange(self.n, dtype=np.float32))
+
+        reg = MetricsRegistry()
+        s1 = ShardedStream(It(10), shuffle=False, shard_index=0,
+                           num_shards=1, registry=reg)
+        it = iter(s1)
+        for _ in range(6):
+            next(it)
+        s2 = ShardedStream(It(4), shuffle=False, shard_index=0,
+                           num_shards=1, registry=reg)
+        s2.load_state_dict(s1.state_dict())
+        with pytest.raises(RuntimeError, match="exhausted"):
+            list(s2)
+        # only the 4 existing samples were replayed-and-skipped
+        assert reg.get("data_skipped_on_resume_total").total() == 4
+
     def test_epoch_boundary_state_normalizes(self):
         s1 = ShardedStream(Pairs(8), base_seed=1, shard_index=0,
                            num_shards=1)
@@ -353,6 +393,44 @@ class TestDataPipeline:
         got += self._digests(p2, epochs=2)
         assert got == ref
 
+    def test_epoch_property_owes_tail_on_resume(self):
+        """A state restored at an epoch tail (stream normalized to the
+        next epoch, carry unflushed) must still report the FINISHED
+        epoch — `epochs - pipe.epoch` relaunch loops would otherwise
+        skip the tail batch AND a whole trailing epoch."""
+        ds = Docs(13, lo=36, hi=61)
+        kw = dict(batch_size=2, seq_len=64, pack=True, base_seed=7,
+                  shuffle=True, drop_last=False)
+        ref = [digest(b) for b in DataPipeline(ds, **kw)]
+        p1 = DataPipeline(ds, **kw)
+        it = iter(p1)
+        for _ in range(len(ref) - 1):  # stop just before the tail flush
+            next(it)
+        p2 = DataPipeline(ds, **kw)
+        p2.load_state_dict(p1.state_dict())
+        assert p2.epoch == 0  # epoch 0 still owes its tail batch
+        assert [digest(b) for b in p2] == ref[-1:]
+        assert p2.epoch == 1
+
+    def test_drop_last_mismatch_refused(self):
+        """drop_last decides whether a restored epoch-tail carry flushes
+        or rides into the next epoch — resuming across a flip must
+        refuse, not silently change the batch sequence."""
+        kw = dict(batch_size=2, seq_len=64, pack=True, base_seed=1)
+        p1 = DataPipeline(Docs(8), drop_last=True, **kw)
+        p2 = DataPipeline(Docs(8), drop_last=False, **kw)
+        with pytest.raises(ValueError, match="drop_last"):
+            p2.load_state_dict(p1.state_dict())
+
+    def test_pack_state_into_nonpack_pipeline_refused(self):
+        """A packing state restored into a non-packing pipeline would
+        silently drop the carry and pending batches — refuse instead."""
+        p1 = DataPipeline(Docs(8), batch_size=2, seq_len=64, pack=True,
+                          base_seed=1)
+        p2 = DataPipeline(Docs(8), batch_size=2, base_seed=1)
+        with pytest.raises(ValueError, match="pack=True"):
+            p2.load_state_dict(p1.state_dict())
+
     def test_prefetch_preserves_order_slow_dataset(self):
         class Slow(Pairs):
             def __getitem__(self, i):
@@ -377,6 +455,21 @@ class TestDataPipeline:
         rest = list(it)
         assert pipe.state_dict()["step"] == 2 + len(rest)
 
+    def test_epoch_reads_committed_not_producer(self):
+        """Under prefetch the producer can run to the end of an epoch
+        while the trainer is still inside it — pipe.epoch must report
+        the DELIVERED position, like step, not the producer's."""
+        pipe = DataPipeline(Pairs(8), batch_size=4, shuffle=True,
+                            base_seed=3, drop_last=True,
+                            device_prefetch=4)
+        it = iter(pipe)
+        next(it)  # 1 of epoch 0's 2 batches delivered
+        time.sleep(0.15)  # producer buffers the rest of the epoch
+        assert pipe.epoch == 0
+        next(it)
+        assert list(it) == []
+        assert pipe.epoch == 1  # epoch 0 fully delivered
+
     def test_prefetch_early_break_replays_buffered_batches(self):
         """An early-exiting consumer (num_iters / preemption) must not
         lose the batches the producer had buffered: re-iteration
@@ -391,11 +484,14 @@ class TestDataPipeline:
         got += [digest(b) for b in pipe]  # re-enter the epoch
         assert got == ref
 
-    def test_checkpoint_between_multi_batch_flush(self):
+    @pytest.mark.parametrize("drop_last", [True, False])
+    def test_checkpoint_between_multi_batch_flush(self, drop_last):
         """One long document can flush SEVERAL batches from a single
         packer.add() while the stream cursor is already past the doc; a
         checkpoint taken between those flushes must not lose the later
-        batches (they ride the state as `pending`)."""
+        batches (they ride the state as `pending`). With drop_last=False
+        the epoch-tail flush after the last pending batch must survive
+        the same cut points."""
         class LongDocs:
             def __getitem__(self, i):
                 rng = np.random.RandomState(i)
@@ -405,7 +501,7 @@ class TestDataPipeline:
                 return 4
 
         kw = dict(batch_size=2, seq_len=8, pack=True, shuffle=False,
-                  drop_last=True)
+                  drop_last=drop_last)
         ref = [digest(b) for b in DataPipeline(LongDocs(), **kw)]
         assert len(ref) > len(LongDocs())  # multi-batch adds happened
         for cut in range(1, len(ref)):
@@ -415,6 +511,42 @@ class TestDataPipeline:
             p2 = DataPipeline(LongDocs(), **kw)
             p2.load_state_dict(p1.state_dict())
             got += [digest(b) for b in p2]
+            assert got == ref, f"diverged after checkpoint at batch {cut}"
+
+    def test_packed_no_drop_last_resume_any_cut(self):
+        """Epoch-tail regression: with drop_last=False, a checkpoint
+        committed after the epoch's last in-loop batch (stream cursor
+        normalized to next-epoch/0) but before the tail flush is
+        delivered left an unflushed carry that bled into the next
+        epoch's packing. Resume must deliver that tail batch exactly
+        where the uninterrupted run would have — checked at EVERY cut
+        point across two epochs."""
+        # 13 docs of 36..60 tokens at [B=2, seq=64]: no two docs share a
+        # bin, so the odd 13th doc always triggers an in-loop emit at
+        # the epoch's END (cursor at epoch length) leaving itself as the
+        # unflushed carry; a bled carry then merges with the next
+        # epoch's docs into a batch the uninterrupted run never produces
+        ds = Docs(13, lo=36, hi=61)
+        kw = dict(batch_size=2, seq_len=64, pack=True, base_seed=7,
+                  shuffle=True, drop_last=False)
+        ref = self._digests(DataPipeline(ds, **kw), epochs=2)
+        for cut in range(1, len(ref)):
+            p1 = DataPipeline(ds, **kw)
+            got = []
+            while len(got) < cut:
+                for b in p1:
+                    got.append(digest(b))
+                    if len(got) == cut:
+                        break
+            p2 = DataPipeline(ds, **kw)
+            p2.load_state_dict(p1.state_dict())
+            while len(got) < len(ref):
+                before = len(got)
+                for b in p2:
+                    got.append(digest(b))
+                    if len(got) == len(ref):
+                        break
+                assert len(got) > before  # every __iter__ makes progress
             assert got == ref, f"diverged after checkpoint at batch {cut}"
 
     def test_prefetch_consumer_exit_joins_producer(self):
@@ -429,6 +561,46 @@ class TestDataPipeline:
         it.close()  # early consumer exit — must synchronously stop+join
         assert not [t for t in threading.enumerate()
                     if t.name == "pt-data-prefetch" and t.is_alive()]
+
+    def test_to_device_nondivisible_falls_back_and_warns_once(self):
+        """Only the non-divisible case may downgrade to an unsharded
+        put, and it announces itself once per run instead of silently."""
+        import warnings as w
+
+        import paddle_tpu.data.prefetch as pf
+
+        class Odd:  # sharding whose shard_shape rejects every shape
+            def shard_shape(self, shape):
+                raise ValueError("not divisible")
+
+        class TooDeep:  # rank-mismatch: jax raises IndexError for this
+            def shard_shape(self, shape):
+                return shape[5]
+
+        pf._unsharded_fallback_warned = False
+        with pytest.warns(RuntimeWarning, match="unsharded"):
+            out = pf.to_device({"x": np.ones((3, 2), np.float32)},
+                               sharding=Odd())
+        assert isinstance(out["x"], pt.Tensor)
+        with w.catch_warnings():  # second fallback stays quiet
+            w.simplefilter("error")
+            pf.to_device(np.ones((3,), np.float32), sharding=Odd())
+            # a leaf whose rank is below the PartitionSpec falls back
+            # too instead of killing the prefetch producer
+            pf.to_device(np.float32(1.0), sharding=TooDeep())
+
+    def test_to_device_real_sharding_failure_raises(self):
+        """A sharding that claims the shape fits but fails at placement
+        is a real misconfiguration — it must raise, not silently fall
+        back to an unsharded put."""
+        from paddle_tpu.data.prefetch import to_device
+
+        class Bogus:  # passes the divisibility pre-check, not a Sharding
+            def shard_shape(self, shape):
+                return tuple(shape)
+
+        with pytest.raises(Exception):
+            to_device(np.ones((4,), np.float32), sharding=Bogus())
 
     def test_external_prefetcher_on_pipeline_refused(self):
         pipe = DataPipeline(Pairs(), batch_size=4)
@@ -602,6 +774,29 @@ class TestExactlyOnceResume:
         second, fr2 = self._run(tmp_path / "killed")
         assert not fr2.preempted
         assert first + second == ref
+
+    def test_resumed_empty_epoch_remainder_no_nan(self, tmp_path):
+        """A resumed epoch whose remainder holds no full batch
+        (drop_last=True, cursor already past the last full batch) must
+        not log a spurious NaN epoch loss in fit history."""
+        kw = dict(batch_size=4, shuffle=True, base_seed=5,
+                  drop_last=True)
+        p1 = DataPipeline(Pairs(10), **kw)
+        it = iter(p1)
+        next(it)
+        next(it)  # cursor now 8 of 10: the remainder can't fill a batch
+        p2 = DataPipeline(Pairs(10), **kw)
+        p2.load_state_dict(p1.state_dict())
+        assert p2.epoch == 0
+        pt.seed(11)
+        model = pt.hapi.Model(nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                            nn.Linear(8, 1)))
+        model.prepare(pt.optimizer.SGD(learning_rate=0.05,
+                                       parameters=model.parameters()),
+                      nn.MSELoss())
+        history = model.fit(p2, epochs=2 - p2.epoch, verbose=0)
+        assert history["loss"]  # epoch 1 really trained
+        assert all(np.isfinite(v) for v in history["loss"])
 
     def test_data_state_survives_checkpoint_roundtrip(self, tmp_path):
         """Packer carry (numpy arrays inside aux/shards) round-trips
