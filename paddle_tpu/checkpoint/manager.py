@@ -161,6 +161,11 @@ class CheckpointManager:
                 state = read_state(self.step_dir(s), verify=verify,
                                    mesh=mesh, registry=self.registry)
                 self.last_restored_step = s
+                from paddle_tpu.observability import flight_recorder
+                now = time.perf_counter_ns()
+                flight_recorder.record(
+                    flight_recorder.KIND_CKPT, f"restore:step_{s}", now,
+                    now, aux=int(s), args={"step": int(s)})
                 return state
             except CheckpointIntegrityError as e:
                 self._m["failures"].inc(kind="integrity")

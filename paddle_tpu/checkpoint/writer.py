@@ -305,6 +305,11 @@ def write_step(root: str, step: int, snap: Snapshot, *,
         shutil.rmtree(aside, ignore_errors=True)
     _fsync_dir(root)
     m["last_step"].set(int(step))
+    from paddle_tpu.observability import flight_recorder
+    now = time.perf_counter_ns()
+    flight_recorder.record(
+        flight_recorder.KIND_CKPT, f"commit:step_{int(step)}", now, now,
+        aux=int(step), args={"step": int(step), "bytes": written})
     return final_dir
 
 

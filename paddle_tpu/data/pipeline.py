@@ -264,8 +264,21 @@ class DataPipeline:
             pairs = prefetch_pairs(pairs, depth=self.device_prefetch,
                                    sharding=self.sharding,
                                    registry=self._registry)
+        from paddle_tpu.observability import flight_recorder
         for state, batch in pairs:
             # the commit point: this batch is now the trainer's problem
             self._committed = state
             self._m["batches"].inc()
+            if flight_recorder.active() is not None:
+                import time as _time
+                now = _time.perf_counter_ns()
+                # epoch rides the NAME: the native ring stores no args,
+                # and a postmortem needs the data position either way
+                flight_recorder.record(
+                    flight_recorder.KIND_DATA,
+                    f"commit:step_{int(state['step'])}"
+                    f"@epoch_{int(state['epoch'])}", now, now,
+                    aux=int(state["step"]),
+                    args={"step": int(state["step"]),
+                          "epoch": int(state["epoch"])})
             yield batch

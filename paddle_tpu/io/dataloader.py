@@ -22,7 +22,19 @@ import numpy as np
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler, RandomSampler, SequenceSampler
 
-__all__ = ["DataLoader", "default_collate_fn"]
+__all__ = ["DataLoader", "default_collate_fn", "loader_metrics"]
+
+
+def loader_metrics(registry=None) -> dict:
+    """The ``loader_*`` metric families (created on first use) — the
+    declaration point the docs-drift check instantiates."""
+    from paddle_tpu.observability.metrics import get_registry
+    r = registry if registry is not None else get_registry()
+    return {
+        "bad_samples": r.counter(
+            "loader_bad_samples_total",
+            "samples/batches skipped by the bad-sample budget"),
+    }
 
 
 def default_collate_fn(batch):
@@ -93,11 +105,7 @@ class _BadSampleBudget:
             self.used += 1
             used = self.used
         try:
-            from paddle_tpu.observability.metrics import get_registry
-            get_registry().counter(
-                "loader_bad_samples_total",
-                "samples/batches skipped by the bad-sample budget",
-            ).inc(stage=stage)
+            loader_metrics()["bad_samples"].inc(stage=stage)
         except Exception:
             pass
         import warnings

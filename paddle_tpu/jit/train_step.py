@@ -274,8 +274,13 @@ class TrainStep:
         flat_batch, _ = jax.tree_util.tree_flatten(_unwrap((args, kwargs)))
         rng_key = _gen.next_key()
 
+        from paddle_tpu.observability.comm import compute_scope
         from paddle_tpu.profiler import RecordEvent
-        with RecordEvent("TrainStep"):  # one host span per compiled step
+        # one host span per compiled step; the compute_scope marks this
+        # window for the comm tracer's exposure accounting — a collective
+        # running concurrently (bucketed async all-reduce) is overlapped,
+        # one serialized after it is exposed
+        with RecordEvent("TrainStep"), compute_scope():
             loss_val, new_train, new_states, new_bufs = compiled(
                 train, frozen, buffers, states, self._group_lrs(), rng_key,
                 flat_batch)

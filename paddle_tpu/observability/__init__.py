@@ -1,37 +1,49 @@
 """paddle_tpu.observability — production telemetry subsystem.
 
-Four pieces (see docs/OBSERVABILITY.md):
+Six pieces (see docs/OBSERVABILITY.md):
 
 - **metrics** — Counter/Gauge/Histogram registry with Prometheus-text and
-  JSON exposition; env-gated HTTP exporter (``PADDLE_TPU_METRICS_PORT``).
+  JSON exposition; env-gated HTTP exporter (``PADDLE_TPU_METRICS_PORT``);
+  label-cardinality guard (``PADDLE_TPU_METRICS_MAX_LABELSETS``).
 - **step_timer** — per-step data/compute/collective decomposition,
   samples-or-tokens/sec and an MFU estimate (surfaced by the hapi
   ``StepTelemetry`` callback).
 - **comm** — collective-communication tracing: every collective emits a
   tagged RecordEvent span (bytes + group axes), registry counters, and a
-  flight-recorder entry.
-- **flight_recorder** — always-on bounded ring of recent op/comm/step
-  events dumped as postmortem JSON on crash/SIGTERM/SIGUSR1
-  (``PADDLE_TPU_FLIGHT_RECORDER``).
+  flight-recorder entry; exposure accounting classifies each span's wall
+  time as overlapped-with-compute vs exposed.
+- **trace** — structured per-rank span files (step phases, comm spans,
+  serving request chains) plus the cross-rank merge tool
+  (``python -m paddle_tpu.observability.trace merge``), env-gated by
+  ``PADDLE_TPU_TRACE_SPANS=<dir>``.
+- **attribution** — phase-level step attribution (data / embedding+layers
+  / loss-head / optimizer / exposed-collective) with cost-analysis FLOPs
+  and an MFU-per-phase table (``bench.py --attribution``).
+- **flight_recorder** — always-on bounded ring of recent
+  op/comm/step/ckpt/data events dumped as postmortem JSON on
+  crash/SIGTERM/SIGUSR1 (``PADDLE_TPU_FLIGHT_RECORDER``).
 
 Importing this package applies the env gates (a no-op when the vars are
-unset), so ``import paddle_tpu`` alone arms the exporter/recorder in
-production jobs.
+unset), so ``import paddle_tpu`` alone arms the exporter/recorder/tracer
+in production jobs.
 """
-from . import comm, flight_recorder, metrics, step_timer  # noqa: F401
-from .comm import comm_scope, comm_totals, payload_bytes  # noqa: F401
+from . import comm, flight_recorder, metrics, step_timer, trace  # noqa: F401
+from .comm import (  # noqa: F401
+    comm_scope, comm_totals, compute_scope, payload_bytes,
+)
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, get_registry,
     maybe_start_exporter, start_exporter,
 )
 from .step_timer import StepTimer, peak_flops  # noqa: F401
 
-__all__ = ["metrics", "step_timer", "comm", "flight_recorder",
+__all__ = ["metrics", "step_timer", "comm", "flight_recorder", "trace",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "get_registry", "start_exporter", "maybe_start_exporter",
            "StepTimer", "peak_flops", "comm_scope", "comm_totals",
-           "payload_bytes"]
+           "compute_scope", "payload_bytes"]
 
-# env-gated side effects: both are no-ops unless their env var is set
+# env-gated side effects: all are no-ops unless their env var is set
 metrics.maybe_start_exporter()
 flight_recorder.maybe_enable_from_env()
+trace.maybe_enable_from_env()

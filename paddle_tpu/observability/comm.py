@@ -1,4 +1,4 @@
-"""Collective-communication tracing.
+"""Collective-communication tracing + exposure accounting.
 
 Every collective in ``distributed/collective.py`` runs under
 :func:`comm_scope`, which (1) emits a profiler RecordEvent span tagged with
@@ -9,6 +9,17 @@ counters (``comm_bytes_total`` / ``comm_calls_total`` /
 volume, and (3) feeds the flight recorder's ring so a postmortem shows the
 last collectives in flight.
 
+**Exposure accounting** (the attribution layer's signal, and the
+before/after metric for all-reduce bucketing / comm-overlap work): code
+that is actively computing wraps itself in :func:`compute_scope`
+(``jit.TrainStep`` does), and every comm span classifies its wall time
+against those compute intervals — the part that ran concurrently with
+compute is *overlapped*, the remainder is *exposed* (the step got longer
+because of it). Accumulated per axis-group into
+``comm_exposed_seconds_total`` / ``comm_overlapped_seconds_total``, and
+attached to each span's args (``exposed_s`` / ``overlapped_s``) for the
+trace layer.
+
 The span measures *host-side* time: on the compiled path that is trace
 time (the collective itself is an XLA op fused into the step program);
 eager/shard_map re-traces record every call. Bytes are per-shard payload
@@ -17,15 +28,18 @@ per-step comm-volume counter wants.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
+import itertools
 import threading
 import time
 from typing import Optional, Sequence
 
-from . import flight_recorder
+from . import flight_recorder, trace
 from .metrics import get_registry
 
-__all__ = ["comm_scope", "comm_event", "payload_bytes", "comm_totals"]
+__all__ = ["comm_scope", "comm_event", "payload_bytes", "comm_totals",
+           "compute_scope"]
 
 
 _metrics_cache = None
@@ -40,7 +54,7 @@ _chaos_hook = None
 
 
 def _metrics():
-    """The three per-collective counters, resolved once (they live in the
+    """The per-collective counters, resolved once (they live in the
     default registry for the process's lifetime — no reason to take the
     registry lock on every collective)."""
     global _metrics_cache
@@ -51,8 +65,93 @@ def _metrics():
                         "payload bytes moved by collectives"),
             reg.counter("comm_calls_total", "collective invocations"),
             reg.counter("comm_seconds_total",
-                        "host-side seconds inside collectives"))
+                        "host-side seconds inside collectives"),
+            reg.counter("comm_exposed_seconds_total",
+                        "collective seconds NOT overlapped with compute "
+                        "(the step got longer by this much), by axes"),
+            reg.counter("comm_overlapped_seconds_total",
+                        "collective seconds that ran concurrently with a "
+                        "compute_scope, by axes"))
     return _metrics_cache
+
+
+class _ComputeTracker:
+    """Bounded record of recent compute intervals (perf_counter_ns).
+
+    ``compute_scope`` regions push intervals here; a finishing comm span
+    asks how much of its own window intersected them. Memory is bounded
+    (a deque of the most recent closed intervals) — exposure is a
+    per-step quantity, so anything older than the current step's window
+    is irrelevant by the time it rotates out.
+    """
+
+    def __init__(self, keep: int = 512):
+        self._lock = threading.Lock()
+        self._open: dict = {}               # token -> start_ns
+        self._closed = collections.deque(maxlen=keep)  # (start, end)
+        self._tokens = itertools.count()
+
+    def begin(self) -> int:
+        token = next(self._tokens)
+        with self._lock:
+            self._open[token] = time.perf_counter_ns()
+        return token
+
+    def end(self, token: int):
+        now = time.perf_counter_ns()
+        with self._lock:
+            start = self._open.pop(token, None)
+            if start is not None:
+                self._closed.append((start, now))
+
+    def overlap_ns(self, t0: int, t1: int) -> int:
+        """Nanoseconds of [t0, t1] covered by the UNION of compute
+        intervals. Compute regions can nest/overlap across threads, so
+        intervals are merged before measuring — two half-covering
+        regions must not add up to "fully overlapped"."""
+        if t1 <= t0:
+            return 0
+        now = time.perf_counter_ns()
+        with self._lock:
+            # prune intervals that ended before this span started —
+            # comm spans arrive in (monotonic) time order, so they can
+            # never intersect a later query; without this, a full deque
+            # pays a 512-element copy+sort per collective forever.
+            # _closed is appended in end-time order, so popleft is safe.
+            while self._closed and self._closed[0][1] < t0:
+                self._closed.popleft()
+            intervals = list(self._closed) + \
+                [(s, now) for s in self._open.values()]
+        intervals.sort()
+        total = 0
+        cur_s = cur_e = None
+        for s, e in intervals:
+            if cur_e is None or s > cur_e:
+                if cur_e is not None:
+                    total += max(0, min(t1, cur_e) - max(t0, cur_s))
+                cur_s, cur_e = s, e
+            else:
+                cur_e = max(cur_e, e)
+        if cur_e is not None:
+            total += max(0, min(t1, cur_e) - max(t0, cur_s))
+        return min(total, t1 - t0)
+
+
+_compute = _ComputeTracker()
+
+
+@contextlib.contextmanager
+def compute_scope():
+    """Mark the caller as actively computing: any comm span that runs
+    concurrently with this region counts as *overlapped* rather than
+    *exposed*. Entered by ``jit.TrainStep`` around the compiled step;
+    background-collective machinery (all-reduce bucketing) relies on the
+    classification this enables."""
+    token = _compute.begin()
+    try:
+        yield
+    finally:
+        _compute.end(token)
 
 
 def payload_bytes(x) -> int:
@@ -88,11 +187,19 @@ def _axes_label(axes: Sequence[str]) -> str:
 
 def _emit(op: str, axes_label: str, nbytes: int, t0: int, t1: int,
           extra: Optional[dict] = None):
-    b, c, s = _metrics()
+    b, c, s, exp, ovl = _metrics()
     b.inc(nbytes, op=op, axes=axes_label)
     c.inc(1, op=op, axes=axes_label)
     s.inc((t1 - t0) / 1e9, op=op, axes=axes_label)
-    args = {"bytes": nbytes, "axes": axes_label}
+    # exposure classification: the part of this span concurrent with a
+    # compute_scope is overlapped; the rest lengthened the step (exposed)
+    overlapped_ns = _compute.overlap_ns(t0, t1)
+    exposed_ns = (t1 - t0) - overlapped_ns
+    exp.inc(exposed_ns / 1e9, axes=axes_label)
+    ovl.inc(overlapped_ns / 1e9, axes=axes_label)
+    args = {"bytes": nbytes, "axes": axes_label,
+            "exposed_s": exposed_ns / 1e9,
+            "overlapped_s": overlapped_ns / 1e9}
     if extra:
         args.update(extra)
     from paddle_tpu import profiler
@@ -101,6 +208,8 @@ def _emit(op: str, axes_label: str, nbytes: int, t0: int, t1: int,
     flight_recorder.record(flight_recorder.KIND_COMM, f"{op}@{axes_label}",
                            t0, t1, tid=threading.get_ident(), aux=nbytes,
                            args=args)
+    trace.span("comm", f"{op}@{axes_label}", t0, t1,
+               tid=threading.get_ident(), args=args)
 
 
 @contextlib.contextmanager
@@ -138,12 +247,19 @@ def comm_event(op: str, axes: Sequence[str], payload=None,
 
 
 def comm_totals(registry=None) -> dict:
-    """(bytes, calls, seconds) summed over every op/axes label — the
-    snapshot StepTimer diffs per step."""
+    """(bytes, calls, seconds, exposed, overlapped) summed over every
+    label set — the snapshot StepTimer diffs per step."""
     reg = registry or get_registry()
     out = {}
     for name in ("comm_bytes_total", "comm_calls_total",
-                 "comm_seconds_total"):
+                 "comm_seconds_total", "comm_exposed_seconds_total",
+                 "comm_overlapped_seconds_total"):
         m = reg.get(name)
         out[name] = m.total() if m is not None else 0.0
     return out
+
+
+# the comm families are core telemetry: register them eagerly so scrapes
+# and ``bench.py --emit-metrics`` show them (at zero) even before the
+# first collective runs
+_metrics()

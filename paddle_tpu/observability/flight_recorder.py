@@ -34,14 +34,20 @@ from typing import Optional
 
 __all__ = ["FlightRecorder", "enable", "disable", "active", "record",
            "maybe_enable_from_env", "KIND_OP", "KIND_COMM", "KIND_STEP",
-           "KIND_USER"]
+           "KIND_USER", "KIND_CKPT", "KIND_DATA"]
 
 KIND_OP = 0
 KIND_COMM = 1
 KIND_STEP = 2
 KIND_USER = 3
+#: checkpoint lifecycle (commit / restore) — a crash postmortem shows the
+#: last committed step right next to the ops that died
+KIND_CKPT = 4
+#: data-pipeline state commits — the postmortem's "where in the data was
+#: I" marker (docs/DATA.md exactly-once resume)
+KIND_DATA = 5
 _KIND_NAMES = {KIND_OP: "op", KIND_COMM: "comm", KIND_STEP: "step",
-               KIND_USER: "user"}
+               KIND_USER: "user", KIND_CKPT: "ckpt", KIND_DATA: "data"}
 
 DEFAULT_CAPACITY = 1024
 

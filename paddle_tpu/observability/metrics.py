@@ -26,6 +26,26 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
+#: where label-set overflow accumulates once a family hits its cap —
+#: totals stay right, memory stays bounded
+OVERFLOW_KEY: _LabelKey = (("overflow", "true"),)
+
+#: default cap on distinct label sets per metric family
+DEFAULT_MAX_LABEL_SETS = 1000
+
+
+def _max_label_sets() -> int:
+    """Env-tunable cardinality cap (``PADDLE_TPU_METRICS_MAX_LABELSETS``).
+    A long-running serving job with per-request-ish labels must not grow
+    a family unboundedly; unparsable/non-positive values fall back to
+    the default rather than disabling the guard."""
+    val = os.environ.get("PADDLE_TPU_METRICS_MAX_LABELSETS")
+    try:
+        n = int(val) if val else DEFAULT_MAX_LABEL_SETS
+    except ValueError:
+        return DEFAULT_MAX_LABEL_SETS
+    return n if n > 0 else DEFAULT_MAX_LABEL_SETS
+
 
 def _label_key(labels: Dict[str, object]) -> _LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -51,10 +71,35 @@ class _Metric:
         self.help = help
         self._lock = threading.Lock()
         self._samples: Dict[_LabelKey, object] = {}
+        self._max_label_sets = _max_label_sets()
+        self._overflow_warned = False
+
+    def _admit(self, key: _LabelKey) -> _LabelKey:
+        """Cardinality guard — call with ``self._lock`` held. Existing
+        label sets always pass; past the cap, NEW label sets fold into
+        one ``{overflow="true"}`` series (values still accumulate, the
+        family's memory stays bounded) with a loud once-per-family
+        warning."""
+        if key in self._samples or \
+                len(self._samples) < self._max_label_sets:
+            return key
+        if not self._overflow_warned:
+            self._overflow_warned = True
+            import warnings
+            warnings.warn(
+                f"metric family '{self.name}' hit its label-cardinality "
+                f"cap ({self._max_label_sets} distinct label sets); new "
+                f"label sets now fold into {{overflow=\"true\"}}. A label "
+                f"is probably carrying a per-request/per-step id — raise "
+                f"PADDLE_TPU_METRICS_MAX_LABELSETS only if the "
+                f"cardinality is intentional",
+                RuntimeWarning, stacklevel=4)
+        return OVERFLOW_KEY
 
     def clear(self):
         with self._lock:
             self._samples.clear()
+            self._overflow_warned = False
 
 
 class Counter(_Metric):
@@ -67,10 +112,12 @@ class Counter(_Metric):
             raise ValueError("counters only go up; use a Gauge")
         key = _label_key(labels)
         with self._lock:
+            key = self._admit(key)
             self._samples[key] = self._samples.get(key, 0.0) + amount
 
     def value(self, **labels) -> float:
-        return float(self._samples.get(_label_key(labels), 0.0))
+        with self._lock:
+            return float(self._samples.get(_label_key(labels), 0.0))
 
     def total(self) -> float:
         """Sum over every label set."""
@@ -86,18 +133,20 @@ class Gauge(_Metric):
     def set(self, value: float, **labels):
         key = _label_key(labels)
         with self._lock:  # exposition iterates under this lock
-            self._samples[key] = float(value)
+            self._samples[self._admit(key)] = float(value)
 
     def inc(self, amount: float = 1.0, **labels):
         key = _label_key(labels)
         with self._lock:
+            key = self._admit(key)
             self._samples[key] = self._samples.get(key, 0.0) + amount
 
     def dec(self, amount: float = 1.0, **labels):
         self.inc(-amount, **labels)
 
     def value(self, **labels) -> float:
-        return float(self._samples.get(_label_key(labels), 0.0))
+        with self._lock:
+            return float(self._samples.get(_label_key(labels), 0.0))
 
 
 #: step-time oriented default buckets (seconds)
@@ -118,6 +167,7 @@ class Histogram(_Metric):
     def observe(self, value: float, **labels):
         key = _label_key(labels)
         with self._lock:
+            key = self._admit(key)
             st = self._samples.get(key)
             if st is None:
                 st = {"counts": [0] * len(self.buckets), "sum": 0.0,
@@ -130,11 +180,12 @@ class Histogram(_Metric):
             st["count"] += 1
 
     def stats(self, **labels) -> Optional[dict]:
-        st = self._samples.get(_label_key(labels))
-        if st is None:
-            return None
-        return {"sum": st["sum"], "count": st["count"],
-                "mean": st["sum"] / max(st["count"], 1)}
+        with self._lock:  # sum/count must come from one consistent state
+            st = self._samples.get(_label_key(labels))
+            if st is None:
+                return None
+            return {"sum": st["sum"], "count": st["count"],
+                    "mean": st["sum"] / max(st["count"], 1)}
 
 
 def _snapshot(m: _Metric):
@@ -182,18 +233,28 @@ class MetricsRegistry:
         return self._metrics.get(name)
 
     def names(self):
-        return sorted(self._metrics)
+        with self._lock:
+            return sorted(self._metrics)
 
     def reset(self):
         """Zero every metric's samples (registrations are kept)."""
-        for m in list(self._metrics.values()):
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
             m.clear()
+
+    def _metric_snapshot(self):
+        """Sorted (name, metric) pairs under the registry lock — exposition
+        must never iterate the live dict while another thread registers a
+        new family (``sorted(self._metrics)`` would raise "dict changed
+        size during iteration" mid-scrape)."""
+        with self._lock:
+            return sorted(self._metrics.items())
 
     # -- exposition -----------------------------------------------------------
     def prometheus_text(self) -> str:
         lines = []
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
+        for name, m in self._metric_snapshot():
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m.kind}")
@@ -223,8 +284,7 @@ class MetricsRegistry:
         """Structured exposition: one entry per metric, samples with label
         dicts — the shared schema for BENCH_*.json rounds and postmortems."""
         out = {}
-        for name in sorted(self._metrics):
-            m = self._metrics[name]
+        for name, m in self._metric_snapshot():
             items = _snapshot(m)
             samples = []
             for key, v in items:

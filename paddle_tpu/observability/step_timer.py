@@ -15,7 +15,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from . import flight_recorder
+from . import flight_recorder, trace
 from .comm import comm_totals
 from .metrics import MetricsRegistry, get_registry
 
@@ -86,12 +86,16 @@ class StepTimer:
                                   "compute share of the last step")
         self._g_coll = r.gauge("train_step_collective_seconds",
                                "collective-comm share of the last step")
+        self._g_exposed = r.gauge(
+            "train_step_exposed_collective_seconds",
+            "non-overlapped (exposed) collective share of the last step")
         self._c_steps = r.counter("train_steps_total", "steps completed")
         self._c_samples = r.counter("train_samples_total",
                                     "samples consumed")
         self._t0 = None
         self._data_time = 0.0
         self._comm0 = None
+        self._step_index = 0
         self.last = None
 
     def begin_step(self, data_time: float = 0.0):
@@ -111,18 +115,22 @@ class StepTimer:
         comm1 = comm_totals()
         coll = max(comm1["comm_seconds_total"] -
                    self._comm0["comm_seconds_total"], 0.0)
+        exposed = max(comm1["comm_exposed_seconds_total"] -
+                      self._comm0["comm_exposed_seconds_total"], 0.0)
         comm_bytes = comm1["comm_bytes_total"] - \
             self._comm0["comm_bytes_total"]
         total = busy + self._data_time
         compute = max(busy - coll, 0.0)
         stats = {"step_time_s": total, "data_time_s": self._data_time,
-                 "compute_time_s": compute, "collective_time_s": coll}
+                 "compute_time_s": compute, "collective_time_s": coll,
+                 "exposed_collective_time_s": exposed}
         if comm_bytes:
             stats["comm_bytes"] = comm_bytes
         self._h_step.observe(total)
         self._g_data.set(self._data_time)
         self._g_compute.set(compute)
         self._g_coll.set(coll)
+        self._g_exposed.set(exposed)
         self._c_steps.inc()
         if samples is not None and total > 0:
             sps = samples / total
@@ -143,6 +151,24 @@ class StepTimer:
             flight_recorder.KIND_STEP, "train_step",
             int((t1 - total) * 1e9), int(t1 * 1e9),
             aux=int(samples or 0), args=stats)
+        self._step_index += 1
+        # the trace layer's step phases: one "step" span carrying the
+        # step id (the merge tool's skew/straggler key) plus child phase
+        # spans for the data / compute decomposition
+        if trace.active() is not None:
+            s_ns, e_ns = int((t1 - total) * 1e9), int(t1 * 1e9)
+            targs = {"step": self._step_index, **{
+                k: round(v, 6) for k, v in stats.items()
+                if isinstance(v, float)}}
+            trace.span("step", "train_step", s_ns, e_ns, args=targs)
+            d_ns = int(self._data_time * 1e9)
+            if d_ns > 0:
+                trace.span("phase", "data", s_ns, s_ns + d_ns,
+                           args={"step": self._step_index})
+            trace.span("phase", "compute", s_ns + d_ns, e_ns,
+                       args={"step": self._step_index,
+                             "collective_s": round(coll, 6),
+                             "exposed_collective_s": round(exposed, 6)})
         self.last = stats
         self._t0 = None
         return stats

@@ -1,0 +1,398 @@
+"""Structured span tracing — per-rank trace files + cross-rank merge.
+
+The performance-attribution substrate (docs/OBSERVABILITY.md): every rank
+writes a line-oriented JSON trace file (``trace_rank<r>_<pid>.jsonl``)
+whose first line is a header carrying the rank and a **clock anchor** — a
+``(perf_counter_ns, unix_ns)`` pair sampled back-to-back — and whose
+remaining lines are spans/marks timestamped on the local
+``perf_counter_ns`` clock. Sources: ``StepTimer`` (step phases), the
+collective tracer (comm spans with bytes/axes/exposure), the serving
+engine (per-request span chains), and anything else via :func:`span` /
+:func:`mark`.
+
+The merge tool aligns every rank onto one clock using the anchors
+(``aligned_ns = ts - anchor.perf_ns + anchor.unix_ns``), emits a single
+chrome trace (one process lane per rank) plus a JSON summary with
+per-rank **skew** (how far each rank's step boundaries sit from the
+fleet) and **straggler** stats (which rank finishes each step last, and
+how wide the spread is)::
+
+    python -m paddle_tpu.observability.trace merge <dir> \
+        [--out merged_trace.json] [--summary merge_summary.json]
+
+Gating mirrors the flight recorder: ``PADDLE_TPU_TRACE_SPANS=<dir>``
+arms the per-rank writer at ``import paddle_tpu``; unset keeps every
+:func:`span` call a single module-attribute read.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["TraceWriter", "enable", "disable", "active", "span", "mark",
+           "maybe_enable_from_env", "merge", "FORMAT_VERSION"]
+
+FORMAT_VERSION = 1
+
+#: the active writer — instrumentation reads this attribute on every
+#: span, so it must stay a plain module global (no function call)
+_active: Optional["TraceWriter"] = None
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+class TraceWriter:
+    """Append-only per-rank trace file (thread-safe, line-buffered).
+
+    Every line is one JSON object. The header pins the clock anchor the
+    merge tool needs; events carry raw ``perf_counter_ns`` timestamps so
+    recording never pays a clock conversion.
+    """
+
+    def __init__(self, path: str, rank: Optional[int] = None,
+                 meta: Optional[dict] = None):
+        self.path = path
+        self.rank = _rank() if rank is None else int(rank)
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "w", buffering=1)  # line-buffered: crash-safe
+        header = {"type": "header", "version": FORMAT_VERSION,
+                  "rank": self.rank, "pid": os.getpid(),
+                  "clock": {"perf_ns": time.perf_counter_ns(),
+                            "unix_ns": time.time_ns()}}
+        if meta:
+            header["meta"] = dict(meta)
+        self._write(header)
+
+    def _write(self, obj: dict):
+        line = json.dumps(obj, separators=(",", ":"))
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line + "\n")
+
+    def span(self, cat: str, name: str, start_ns: int, end_ns: int,
+             tid: int = 0, args: Optional[dict] = None):
+        ev = {"type": "span", "cat": cat, "name": name,
+              "ts": int(start_ns), "dur": max(int(end_ns - start_ns), 0),
+              "tid": int(tid)}
+        if args:
+            ev["args"] = args
+        self._write(ev)
+
+    def mark(self, cat: str, name: str, ts_ns: Optional[int] = None,
+             tid: int = 0, args: Optional[dict] = None):
+        ev = {"type": "mark", "cat": cat, "name": name,
+              "ts": int(time.perf_counter_ns() if ts_ns is None else ts_ns),
+              "tid": int(tid)}
+        if args:
+            ev["args"] = args
+        self._write(ev)
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+def enable(trace_dir: str, rank: Optional[int] = None) -> TraceWriter:
+    """Start the per-rank writer (idempotent). A second call while
+    tracing is armed keeps the existing writer; if it asked for a
+    different directory, that is almost certainly a bug (spans would
+    land where the caller isn't looking), so it warns loudly."""
+    global _active
+    if _active is not None:
+        want = os.path.abspath(trace_dir)
+        have = os.path.dirname(os.path.abspath(_active.path))
+        if want != have:
+            import warnings
+            warnings.warn(
+                f"trace.enable({trace_dir!r}) ignored: tracing is "
+                f"already writing to {have!r} — trace.disable() first "
+                f"to redirect", RuntimeWarning, stacklevel=2)
+        return _active
+    r = _rank() if rank is None else int(rank)
+    path = os.path.join(trace_dir, f"trace_rank{r}_{os.getpid()}.jsonl")
+    _active = TraceWriter(path, rank=r)
+    return _active
+
+
+def disable():
+    global _active
+    if _active is None:
+        return
+    w, _active = _active, None
+    w.close()
+
+
+def active() -> Optional[TraceWriter]:
+    return _active
+
+
+def span(cat: str, name: str, start_ns: int, end_ns: int, tid: int = 0,
+         args: Optional[dict] = None):
+    """Record one span iff tracing is on (cheap no-op otherwise)."""
+    w = _active
+    if w is not None:
+        w.span(cat, name, start_ns, end_ns, tid=tid, args=args)
+
+
+def mark(cat: str, name: str, ts_ns: Optional[int] = None, tid: int = 0,
+         args: Optional[dict] = None):
+    w = _active
+    if w is not None:
+        w.mark(cat, name, ts_ns=ts_ns, tid=tid, args=args)
+
+
+def maybe_enable_from_env() -> Optional[TraceWriter]:
+    """``PADDLE_TPU_TRACE_SPANS=<dir>`` arms the writer at import; unset
+    (or unusable dir) keeps tracing off — this runs at ``import
+    paddle_tpu`` and must never kill the process."""
+    d = os.environ.get("PADDLE_TPU_TRACE_SPANS", "").strip()
+    if not d or d in ("0", "false", "off", "no"):
+        return _active
+    try:
+        return enable(d)
+    except OSError:
+        return _active
+
+
+# ---------------------------------------------------------------------------
+# merge: N per-rank files -> one aligned chrome trace + skew summary
+# ---------------------------------------------------------------------------
+
+def _load_rank_file(path: str):
+    """(header, events) — skips torn trailing lines (a crashed writer's
+    last line may be partial; everything before it is still good)."""
+    header, events = None, []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail of a crashed writer
+            if obj.get("type") == "header":
+                header = obj
+            else:
+                events.append(obj)
+    if header is None:
+        raise ValueError(f"{path}: no trace header line")
+    return header, events
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(int(round(q * (len(s) - 1))), len(s) - 1)
+    return s[idx]
+
+
+def merge(trace_dir: str, out_trace: Optional[str] = None,
+          out_summary: Optional[str] = None,
+          pattern: str = "trace_rank*.jsonl") -> dict:
+    """Merge every per-rank trace file under ``trace_dir`` onto one
+    clock. Writes a chrome trace (default ``merged_trace.json``) and a
+    summary (default ``merge_summary.json``) into ``trace_dir`` and
+    returns the summary dict.
+
+    Alignment: each event's local ``perf_counter_ns`` timestamp is
+    shifted by its rank's header anchor onto the unix-epoch clock, then
+    the merged trace is re-zeroed at the earliest event. Skew/straggler
+    stats come from the ``step`` spans (``args.step`` ids shared across
+    ranks): per step, the spread between the first and last rank to
+    finish, and which rank was last.
+    """
+    paths = sorted(_glob.glob(os.path.join(trace_dir, pattern)))
+    if not paths:
+        raise FileNotFoundError(
+            f"no {pattern!r} files under {trace_dir!r}")
+    ranks = []
+    for p in paths:
+        header, events = _load_rank_file(p)
+        clock = header.get("clock", {})
+        offset = int(clock.get("unix_ns", 0)) - int(clock.get("perf_ns", 0))
+        ranks.append({"path": p, "rank": int(header.get("rank", 0)),
+                      "offset": offset, "events": events,
+                      "pid": header.get("pid")})
+
+    # One lane per FILE, not per rank: a crash + relaunch leaves two
+    # files for the same rank (the documented postmortem case), and
+    # folding them together would silently clobber step end-times and
+    # interleave two processes in one chrome lane. When a rank appears
+    # once its lane label/pid stay the plain rank; duplicates get
+    # "rank:pid" labels and unique synthetic chrome pids.
+    rank_seen: Dict[int, int] = {}
+    for r in ranks:
+        rank_seen[r["rank"]] = rank_seen.get(r["rank"], 0) + 1
+    next_pid = max((r["rank"] for r in ranks), default=0) + 1
+    seen_labels: Dict[str, int] = {}
+    for r in sorted(ranks, key=lambda x: (x["rank"], x["path"])):
+        if rank_seen[r["rank"]] == 1:
+            r["label"], r["chrome_pid"] = str(r["rank"]), r["rank"]
+            r["lane_name"] = f"rank {r['rank']}"
+        else:
+            r["label"] = f"{r['rank']}:{r['pid']}"
+            r["chrome_pid"], next_pid = next_pid, next_pid + 1
+            r["lane_name"] = f"rank {r['rank']} (pid {r['pid']})"
+        n = seen_labels.get(r["label"], 0)
+        seen_labels[r["label"]] = n + 1
+        if n:  # same rank AND same header pid: still one lane per file
+            r["label"] = f"{r['label']}#{n}"
+
+    # align every event onto the unix clock, then re-zero
+    aligned = []
+    for r in ranks:
+        for ev in r["events"]:
+            ts = int(ev.get("ts", 0)) + r["offset"]
+            aligned.append((ts, r, ev))
+    if not aligned:
+        raise ValueError(f"trace files under {trace_dir!r} hold no events")
+    aligned.sort(key=lambda t: t[0])
+    t_zero = aligned[0][0]
+
+    # -- chrome trace --------------------------------------------------------
+    chrome: List[dict] = []
+    for r in sorted(ranks, key=lambda r: (r["rank"], r["path"])):
+        chrome.append({"ph": "M", "name": "process_name",
+                       "pid": r["chrome_pid"],
+                       "args": {"name": r["lane_name"]}})
+    for ts, r, ev in aligned:
+        d = {"name": ev.get("name", "?"), "cat": ev.get("cat", "user"),
+             "pid": r["chrome_pid"], "tid": ev.get("tid", 0),
+             "ts": (ts - t_zero) / 1000.0}  # chrome wants microseconds
+        if ev.get("type") == "span":
+            d["ph"] = "X"
+            d["dur"] = int(ev.get("dur", 0)) / 1000.0
+        else:
+            d["ph"] = "i"
+            d["s"] = "p"  # instant event, process-scoped
+        if ev.get("args"):
+            d["args"] = dict(ev["args"])
+        chrome.append(d)
+
+    # -- skew / straggler stats over shared step ids -------------------------
+    # step end time per (step id, lane), aligned clock — lanes, not
+    # ranks, so a relaunched rank's second file can't clobber the first
+    step_ends: Dict[object, Dict[str, int]] = {}
+    step_starts: Dict[object, Dict[str, int]] = {}
+    lane_rank = {r["label"]: r["rank"] for r in ranks}
+    for ts, r, ev in aligned:
+        if ev.get("cat") != "step" or ev.get("type") != "span":
+            continue
+        sid = (ev.get("args") or {}).get("step")
+        if sid is None:
+            continue
+        step_starts.setdefault(sid, {})[r["label"]] = ts
+        step_ends.setdefault(sid, {})[r["label"]] = \
+            ts + int(ev.get("dur", 0))
+    spreads, start_spreads = [], []
+    straggler_counts: Dict[str, int] = {}
+    per_step = {}
+    for sid, ends in sorted(step_ends.items(), key=lambda kv: str(kv[0])):
+        if len(ends) < 2:
+            continue
+        last = max(ends, key=lambda k: ends[k])
+        spread = max(ends.values()) - min(ends.values())
+        spreads.append(spread)
+        starts = step_starts.get(sid, {})
+        if len(starts) >= 2:
+            start_spreads.append(max(starts.values()) - min(starts.values()))
+        straggler_counts[last] = straggler_counts.get(last, 0) + 1
+        per_step[str(sid)] = {"end_spread_ns": spread,
+                              "straggler_rank": lane_rank[last]}
+
+    # -- comm rollup (bytes / exposure by axes, across ranks) ----------------
+    comm: Dict[str, dict] = {}
+    for ts, r, ev in aligned:
+        if ev.get("cat") != "comm" or ev.get("type") != "span":
+            continue
+        a = ev.get("args") or {}
+        key = str(a.get("axes", "world"))
+        c = comm.setdefault(key, {"calls": 0, "bytes": 0, "seconds": 0.0,
+                                  "exposed_seconds": 0.0,
+                                  "overlapped_seconds": 0.0})
+        c["calls"] += 1
+        c["bytes"] += int(a.get("bytes", 0))
+        c["seconds"] += int(ev.get("dur", 0)) / 1e9
+        c["exposed_seconds"] += float(a.get("exposed_s", 0.0))
+        c["overlapped_seconds"] += float(a.get("overlapped_s", 0.0))
+
+    _ref_offset = min(ranks,
+                      key=lambda x: (x["rank"], x["path"]))["offset"]
+    summary = {
+        "trace_dir": os.path.abspath(trace_dir),
+        "ranks": sorted({r["rank"] for r in ranks}),
+        "files": [os.path.basename(r["path"]) for r in ranks],
+        "events": len(aligned),
+        # offsets are relative to the LOWEST rank's (first) lane — file
+        # order is lexicographic: trace_rank10_* sorts before
+        # trace_rank2_*, so file order must not pick the reference
+        "clock_offsets_ns": {r["label"]: r["offset"] - _ref_offset
+                             for r in ranks},
+        "steps_compared": len(spreads),
+        "skew": {
+            "step_end_spread_ns": {
+                "mean": (sum(spreads) / len(spreads)) if spreads else 0.0,
+                "max": max(spreads) if spreads else 0,
+                "p50": _percentile([float(s) for s in spreads], 0.5),
+            },
+            "step_start_spread_ns_max": (max(start_spreads)
+                                         if start_spreads else 0),
+        },
+        "straggler_counts": {str(k): v
+                             for k, v in sorted(straggler_counts.items())},
+        "per_step": per_step,
+        "comm_by_axes": comm,
+    }
+
+    out_trace = out_trace or os.path.join(trace_dir, "merged_trace.json")
+    out_summary = out_summary or os.path.join(trace_dir,
+                                              "merge_summary.json")
+    with open(out_trace, "w") as f:
+        json.dump({"traceEvents": chrome, "displayTimeUnit": "ms"}, f)
+    with open(out_summary, "w") as f:
+        json.dump(summary, f, indent=1)
+    summary["out_trace"] = out_trace
+    summary["out_summary"] = out_summary
+    return summary
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.trace",
+        description="cross-rank trace tools")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="merge per-rank trace files onto "
+                        "one clock; emit chrome trace + skew summary")
+    mp.add_argument("trace_dir")
+    mp.add_argument("--out", default=None, help="chrome trace output path")
+    mp.add_argument("--summary", default=None, help="summary JSON path")
+    args = ap.parse_args(argv)
+    if args.cmd == "merge":
+        s = merge(args.trace_dir, out_trace=args.out,
+                  out_summary=args.summary)
+        print(json.dumps({k: s[k] for k in
+                          ("ranks", "events", "steps_compared", "skew",
+                           "straggler_counts", "out_trace", "out_summary")},
+                         indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

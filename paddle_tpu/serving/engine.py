@@ -39,7 +39,64 @@ import numpy as np
 from .kv_cache import PagedKVCache
 from .scheduler import Request, RequestState, Scheduler
 
-__all__ = ["ServingEngine", "RequestHandle"]
+__all__ = ["ServingEngine", "RequestHandle", "serving_metrics"]
+
+
+_serving_metrics_cache = None
+
+
+def serving_metrics(registry=None) -> dict:
+    """The ``serving_*`` metric families (created on first use) — one
+    accessor shared by the engine, the HTTP server's shed path, and the
+    KV cache gauge (mirrors ``checkpoint.writer.ckpt_metrics``;
+    docs/SERVING.md documents names and semantics). The default-registry
+    dict is cached: the server's 503 shed path calls this per rejection,
+    exactly when every request thread is contending for the lock."""
+    global _serving_metrics_cache
+    if registry is None and _serving_metrics_cache is not None:
+        return _serving_metrics_cache
+    from paddle_tpu.observability import get_registry
+    reg = registry if registry is not None else get_registry()
+    d = _build_serving_metrics(reg)
+    if registry is None:
+        _serving_metrics_cache = d
+    return d
+
+
+def _build_serving_metrics(reg) -> dict:
+    return {
+        "requests": reg.counter(
+            "serving_requests_total", "requests by final outcome"),
+        "queue": reg.gauge(
+            "serving_queue_depth", "requests waiting for a batch slot"),
+        "running": reg.gauge(
+            "serving_requests_running", "requests holding a batch slot"),
+        "waiting": reg.gauge(
+            "serving_requests_waiting", "requests queued (incl. preempted)"),
+        "ttft": reg.histogram(
+            "serving_ttft_seconds", "submit -> first generated token"),
+        "queue_wait": reg.histogram(
+            "serving_queue_wait_seconds",
+            "submit -> first batch-slot admission (the TTFT share spent "
+            "on queueing rather than prefill/compile)"),
+        "itl": reg.histogram(
+            "serving_inter_token_seconds", "gap between streamed tokens"),
+        "latency": reg.histogram(
+            "serving_request_latency_seconds", "submit -> request finished"),
+        "tokens": reg.counter(
+            "serving_tokens_total",
+            "tokens processed, by kind (prompt incl. recompute/generated)"),
+        "preemptions": reg.counter(
+            "serving_preemptions_total", "sequences preempted (recompute)"),
+        "steps": reg.counter(
+            "serving_engine_steps_total", "compiled steps run, by kind"),
+        "rejections": reg.counter(
+            "serving_rejections_total",
+            "requests shed by graceful degradation"),
+        "kv_blocks": reg.gauge(
+            "serving_kv_blocks_in_use",
+            "KV-cache blocks currently held by live sequences"),
+    }
 
 
 class RequestHandle:
@@ -220,29 +277,18 @@ class ServingEngine:
 
     # -- metrics -----------------------------------------------------------
     def _init_metrics(self):
-        from paddle_tpu.observability import get_registry
-        reg = get_registry()
-        self._m_requests = reg.counter(
-            "serving_requests_total", "requests by final outcome")
-        self._m_queue = reg.gauge(
-            "serving_queue_depth", "requests waiting for a batch slot")
-        self._m_running = reg.gauge(
-            "serving_requests_running", "requests holding a batch slot")
-        self._m_waiting = reg.gauge(
-            "serving_requests_waiting", "requests queued (incl. preempted)")
-        self._m_ttft = reg.histogram(
-            "serving_ttft_seconds", "submit -> first generated token")
-        self._m_itl = reg.histogram(
-            "serving_inter_token_seconds", "gap between streamed tokens")
-        self._m_latency = reg.histogram(
-            "serving_request_latency_seconds", "submit -> request finished")
-        self._m_tokens = reg.counter(
-            "serving_tokens_total",
-            "tokens processed, by kind (prompt incl. recompute/generated)")
-        self._m_preempt = reg.counter(
-            "serving_preemptions_total", "sequences preempted (recompute)")
-        self._m_steps = reg.counter(
-            "serving_engine_steps_total", "compiled steps run, by kind")
+        m = serving_metrics()
+        self._m_requests = m["requests"]
+        self._m_queue = m["queue"]
+        self._m_running = m["running"]
+        self._m_waiting = m["waiting"]
+        self._m_ttft = m["ttft"]
+        self._m_queue_wait = m["queue_wait"]
+        self._m_itl = m["itl"]
+        self._m_latency = m["latency"]
+        self._m_tokens = m["tokens"]
+        self._m_preempt = m["preemptions"]
+        self._m_steps = m["steps"]
         self.cache.gauge_in_use()
 
     def _update_gauges(self):
@@ -327,6 +373,17 @@ class ServingEngine:
             return plan.prefill is not None or bool(live)
 
     def _run_prefill(self, seq: Request, n_new: int):
+        from paddle_tpu.observability import trace
+        if seq.prefill_pos == 0 and seq.slot_time is not None \
+                and not getattr(seq, "_queue_wait_observed", False):
+            # queue-wait ends at FIRST admission, observed exactly once
+            # per request — slot_time never resets, so a recompute
+            # prefill after preemption still reports the original wait
+            # (a request preempted before its first chunk must not be
+            # dropped from the histogram: overload is exactly when
+            # queue-wait matters)
+            seq._queue_wait_observed = True
+            self._m_queue_wait.observe(seq.slot_time - seq.arrival_time)
         C = self.prefill_chunk
         tokens = np.zeros((1, C), np.int32)
         tokens[0, :n_new] = seq.pending_tokens[
@@ -334,12 +391,24 @@ class ServingEngine:
         bt = self.cache.pad_block_table(seq.block_ids)[None, :]
         ctx = np.array([seq.prefill_pos], np.int32)
         nlen = np.array([n_new], np.int32)
+        t0 = time.perf_counter_ns()
+        compiles0 = self.prefill_traces
         logits, kps, vps = self._prefill_step(
             self._st, jnp.asarray(tokens), self.cache.k_pools,
             self.cache.v_pools, jnp.asarray(bt), jnp.asarray(ctx),
             jnp.asarray(nlen))
         self.cache.update_pools(kps, vps)
         self._clear_model_side_effects()
+        if trace.active() is not None:
+            # compile attribution: a first-ever chunk that traced the
+            # executable carries compiles=1 — the "slow TTFT because XLA
+            # compiled" signal, distinct from admission or preemption
+            trace.span("serving", "prefill_chunk", t0,
+                       time.perf_counter_ns(),
+                       args={"req": seq.req_id, "tokens": n_new,
+                             "pos": seq.prefill_pos,
+                             "compiles": self.prefill_traces - compiles0,
+                             "preemptions": seq.preemptions})
         seq.prefill_pos += n_new
         seq.num_cached += n_new
         self._m_tokens.inc(n_new, kind="prompt")
@@ -412,11 +481,48 @@ class ServingEngine:
             else "failed")
         if seq.latency() is not None:
             self._m_latency.observe(seq.latency())
+        self._emit_request_chain(seq, reason)
         handle = self._handles.pop(seq.req_id, None)
         if handle is not None:
             handle._done.set()
         with self._cv:
             self._cv.notify_all()
+
+    def _emit_request_chain(self, seq: Request, reason: str):
+        """The per-request span chain (docs/SERVING.md): queue_wait →
+        [prefill_chunk spans emitted live] → decode → request_done. The
+        retrospective spans use the request's recorded timestamps, so a
+        slow TTFT decomposes into admission wait vs prefill/compile time
+        vs preemption recompute right in the merged trace."""
+        from paddle_tpu.observability import trace
+        if trace.active() is None:
+            return
+
+        def ns(t):
+            return int(t * 1e9)  # perf_counter -> perf_counter_ns clock
+
+        rid = seq.req_id
+        admitted = seq.slot_time
+        if admitted is not None:
+            trace.span("serving", "queue_wait", ns(seq.arrival_time),
+                       ns(admitted), args={"req": rid})
+        if seq.first_token_time is not None:
+            end = seq.finish_time or seq.last_token_time \
+                or seq.first_token_time
+            trace.span("serving", "decode", ns(seq.first_token_time),
+                       ns(end),
+                       args={"req": rid, "tokens": len(seq.generated)})
+        args = {"req": rid, "finish_reason": reason,
+                "prompt_len": len(seq.prompt_tokens),
+                "generated": len(seq.generated),
+                "preemptions": seq.preemptions}
+        if seq.ttft() is not None:
+            args["ttft_s"] = round(seq.ttft(), 6)
+        if seq.latency() is not None:
+            args["latency_s"] = round(seq.latency(), 6)
+        trace.mark("serving", "request_done",
+                   ts_ns=ns(seq.finish_time or time.perf_counter()),
+                   args=args)
 
     def abort(self, req_id: int, reason: str = "aborted") -> bool:
         """Cancel a queued or in-flight request, releasing its batch slot

@@ -61,6 +61,8 @@ class Request:
     # -- runtime state (engine/scheduler managed) --------------------------
     state: RequestState = RequestState.WAITING
     slot: Optional[int] = None
+    #: first admission into a batch slot — the end of the queue-wait span
+    slot_time: Optional[float] = None
     block_ids: List[int] = field(default_factory=list)
     #: tokens to (re)prefill — the prompt, or prompt+generated after a
     #: preemption (recompute)
@@ -170,6 +172,8 @@ class Scheduler:
             req.slot = i
             self.slots[i] = req
             req.state = RequestState.PREFILL
+            if req.slot_time is None:
+                req.slot_time = time.perf_counter()
 
     def _plan_prefill(self) -> Optional[Tuple[Request, int]]:
         cands = [s for s in self.slotted()
@@ -252,6 +256,10 @@ class Scheduler:
         seq.state = RequestState.WAITING
         seq.preemptions += 1
         self.num_preemptions += 1
+        from paddle_tpu.observability import trace
+        trace.mark("serving", "preempted",
+                   args={"req": seq.req_id, "preemptions": seq.preemptions,
+                         "generated": len(seq.generated)})
         self.add(seq)
 
     def release_slot(self, seq: Request):

@@ -141,11 +141,8 @@ class Server:
                 if self._overloaded():
                     # shed load instead of queueing unboundedly: the
                     # client (or LB) retries against a recovering server
-                    from paddle_tpu.observability import get_registry
-                    get_registry().counter(
-                        "serving_rejections_total",
-                        "requests shed by graceful degradation",
-                    ).inc(reason="queue_full")
+                    from .engine import serving_metrics
+                    serving_metrics()["rejections"].inc(reason="queue_full")
                     self._json(
                         503, {"error": "server overloaded: scheduler "
                               "queue exceeds max_queue_depth "
@@ -227,7 +224,9 @@ class Server:
 
             def _stream_body(self, handle, tokens_q, timeout):
                 import time as _time
+                from paddle_tpu.observability import trace
 
+                t_stream0 = _time.perf_counter_ns()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -243,37 +242,54 @@ class Server:
                 # per-request deadline_s tightens it per client)
                 deadline = _time.monotonic() + timeout
                 sent = 0
-                while True:
-                    if _time.monotonic() > deadline:
-                        chunk({"done": True,
-                               "error": "stream stalled: no token for "
-                               f"{timeout}s"})
-                        self.wfile.write(b"0\r\n\r\n")
-                        self._abort(handle)
-                        return
-                    try:
-                        tok = tokens_q.get(timeout=0.05)
-                        chunk({"token": int(tok)})
-                        sent += 1
-                        deadline = _time.monotonic() + timeout
-                        continue
-                    except queue.Empty:
-                        pass
-                    if handle.wait(0):
-                        # engine done: flush any stragglers, then summary
-                        while True:
-                            try:
-                                chunk({"token": int(tokens_q.get_nowait())})
-                                sent += 1
-                            except queue.Empty:
-                                break
+                # the chain's stream phase: HTTP delivery of the tokens
+                # the engine's decode span produced. Emitted in the
+                # finally so stalls and client disconnects — the very
+                # requests a trace postmortem is opened for — still get
+                # their span (outcome says which exit was taken).
+                outcome = "disconnected"
+                try:
+                    while True:
+                        if _time.monotonic() > deadline:
+                            outcome = "stalled"
+                            chunk({"done": True,
+                                   "error": "stream stalled: no token for "
+                                   f"{timeout}s"})
+                            self.wfile.write(b"0\r\n\r\n")
+                            self._abort(handle)
+                            return
                         try:
-                            res = handle.result(0.1)
-                            chunk({"done": True, **_result_json(res)})
-                        except (TimeoutError, RuntimeError) as e:
-                            chunk({"done": True, "error": str(e)})
-                        self.wfile.write(b"0\r\n\r\n")
-                        return
+                            tok = tokens_q.get(timeout=0.05)
+                            chunk({"token": int(tok)})
+                            sent += 1
+                            deadline = _time.monotonic() + timeout
+                            continue
+                        except queue.Empty:
+                            pass
+                        if handle.wait(0):
+                            # engine done: flush stragglers, then summary
+                            while True:
+                                try:
+                                    chunk({"token":
+                                           int(tokens_q.get_nowait())})
+                                    sent += 1
+                                except queue.Empty:
+                                    break
+                            outcome = "ok"
+                            try:
+                                res = handle.result(0.1)
+                                chunk({"done": True, **_result_json(res)})
+                            except (TimeoutError, RuntimeError) as e:
+                                outcome = "error"
+                                chunk({"done": True, "error": str(e)})
+                            self.wfile.write(b"0\r\n\r\n")
+                            return
+                finally:
+                    trace.span("serving", "stream", t_stream0,
+                               _time.perf_counter_ns(),
+                               args={"req": handle.req_id,
+                                     "tokens": sent,
+                                     "outcome": outcome})
 
         self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
         self.host = host

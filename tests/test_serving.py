@@ -450,3 +450,47 @@ def test_http_concurrent_clients():
     for i, p in enumerate(prompts):
         assert results[i]["token_ids"] == _eager_continuation(model, p, 6)
     eng.cache.allocator.assert_no_leaks()
+
+
+def test_request_span_chain_in_trace(served, tmp_path):
+    """PR 6 tentpole: the engine writes a per-request span chain
+    (queue_wait -> prefill_chunk(s) -> decode -> request_done) into the
+    trace layer, so a slow TTFT decomposes into admission vs
+    compile vs preemption right in the merged trace."""
+    from paddle_tpu.observability import trace
+    model, eng = served
+    trace.disable()
+    trace.enable(str(tmp_path), rank=0)
+    try:
+        prompt = list(range(1, 7))
+        h = eng.submit(prompt, max_new_tokens=4)
+        eng.run_until_idle()
+        res = h.result(timeout=60)
+    finally:
+        writer_path = trace.active().path
+        trace.disable()
+    events = [json.loads(ln) for ln in open(writer_path)][1:]
+    mine = [e for e in events
+            if (e.get("args") or {}).get("req") == res["request_id"]]
+    names = [e["name"] for e in mine]
+    assert "queue_wait" in names
+    # prefill_chunk=4 and a 6-token prompt: two chunks
+    assert names.count("prefill_chunk") == 2
+    assert "decode" in names and "request_done" in names
+    # chain ordering: queue_wait ends before the first prefill chunk
+    # starts; decode covers first->last token; done is terminal
+    qw = next(e for e in mine if e["name"] == "queue_wait")
+    pf = [e for e in mine if e["name"] == "prefill_chunk"]
+    dec = next(e for e in mine if e["name"] == "decode")
+    done = next(e for e in mine if e["name"] == "request_done")
+    assert qw["ts"] + qw["dur"] <= pf[0]["ts"]
+    assert pf[-1]["ts"] + pf[-1]["dur"] <= dec["ts"] + dec["dur"]
+    assert done["args"]["finish_reason"] == "length"
+    assert done["args"]["generated"] == 4
+    assert done["args"]["ttft_s"] > 0
+    # compile attribution rides the chunk spans (engine is warm: 0)
+    assert all("compiles" in e["args"] for e in pf)
+    # and the queue-wait histogram got its observation
+    from paddle_tpu.observability import get_registry
+    qwh = get_registry().get("serving_queue_wait_seconds")
+    assert qwh is not None and qwh.stats()["count"] >= 1
