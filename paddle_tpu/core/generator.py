@@ -31,6 +31,7 @@ class Generator:
         self._seed = int(seed)
         self._count = 0
         self._base_override = None  # traced key installed by rng_guard
+        self._base_cache = None     # (seed, key): jax.random.key is pure
 
     def manual_seed(self, seed: int) -> "Generator":
         self._seed = int(seed)
@@ -46,15 +47,32 @@ class Generator:
     def set_state(self, state):
         self._seed, self._count = int(state[0]), int(state[1])
 
+    def _base_key(self):
+        if self._base_override is not None:
+            return self._base_override
+        # cache the base key per seed: rebuilding it is an eager XLA
+        # dispatch that measurably taxes every compiled train step
+        # (next_key runs once per step on the hot path)
+        if self._base_cache is None or self._base_cache[0] != self._seed:
+            self._base_cache = (self._seed, jax.random.key(self._seed))
+        return self._base_cache[1]
+
     def next_key(self):
         """Return the next PRNG key in this generator's stream."""
-        if self._base_override is not None:
-            base = self._base_override
-        else:
-            base = jax.random.key(self._seed)
-        k = jax.random.fold_in(base, self._count)
+        k = jax.random.fold_in(self._base_key(), self._count)
         self._count += 1
         return k
+
+    def next_key_parts(self):
+        """``(base_key, count)`` with the counter advanced — for hot
+        paths that run ``fold_in(base, count)`` INSIDE their compiled
+        program instead of paying an eager dispatch per step.
+        ``fold_in(base, count)`` equals what ``next_key()`` would have
+        returned."""
+        base = self._base_key()
+        c = self._count
+        self._count += 1
+        return base, c
 
 
 default_generator = Generator(0)
@@ -81,6 +99,13 @@ def next_key():
     """Next key from whichever generator is active (tracker state or default)."""
     gen = getattr(_tls, "active_generator", None) or default_generator
     return gen.next_key()
+
+
+def next_key_parts():
+    """``(base_key, count)`` from the active generator — fold inside a
+    compiled program instead of paying an eager per-step dispatch."""
+    gen = getattr(_tls, "active_generator", None) or default_generator
+    return gen.next_key_parts()
 
 
 @contextlib.contextmanager

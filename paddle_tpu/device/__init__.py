@@ -8,7 +8,8 @@ API compatibility and report absence honestly (this build has no CUDA by
 constraint, BASELINE.md)."""
 from __future__ import annotations
 
-from typing import List, Optional
+import os
+from typing import Dict, List, Optional
 
 from . import cuda  # noqa: F401
 
@@ -18,9 +19,132 @@ __all__ = ["set_device", "get_device", "get_all_custom_device_type",
            "is_compiled_with_xpu", "is_compiled_with_npu",
            "is_compiled_with_custom_device", "device_count", "synchronize",
            "cuda", "memory_stats", "memory_allocated",
-           "max_memory_allocated"]
+           "max_memory_allocated", "apply_xla_tuning",
+           "applied_xla_tuning"]
 
 _state = {"device": None}
+
+# --- TPU XLA performance flags (docs/PERFORMANCE.md#xla-flags) --------------
+# Applied to XLA_FLAGS at import when a TPU is plausibly present, BEFORE the
+# first jax backend initialization reads them. Each entry: flag name ->
+# (value, why). The set is the standard compute/communication-overlap tuning
+# the bucketed-collective train step (jit/bucketing.py) is designed for:
+# async collectives are only a win if the scheduler is allowed to move
+# compute between their start/done pair.
+XLA_TUNING_FLAGS: Dict[str, tuple] = {
+    "--xla_tpu_enable_latency_hiding_scheduler": (
+        "true",
+        "reorder the program so async collective start/done pairs straddle "
+        "independent compute — the scheduler that actually hides the "
+        "bucketed dp all-reduces behind remaining backward work"),
+    "--xla_tpu_enable_async_collective_fusion": (
+        "true",
+        "split eligible collectives into async start/done ops the "
+        "latency-hiding scheduler can move apart"),
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather": (
+        "true",
+        "extend async collective fusion to all-gathers (ZeRO param "
+        "gathers, TP activation gathers)"),
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps": (
+        "true",
+        "let one async collective span several scheduling steps instead "
+        "of forcing completion at the next step boundary"),
+    "--xla_tpu_overlap_compute_collective_tc": (
+        "true",
+        "allow collectives to run on the transfer cores concurrently with "
+        "TensorCore compute"),
+    "--xla_enable_async_all_gather": (
+        "true", "emit all-gathers as async start/done pairs"),
+    "--xla_enable_async_collective_permute": (
+        "true",
+        "emit collective-permutes (pipeline-parallel edges) as async "
+        "start/done pairs"),
+    "--xla_tpu_data_parallel_opt_different_sized_ops": (
+        "true",
+        "enable pipelining of data-parallel ops across iterations even "
+        "when their sizes differ (the size-targeted grad buckets are "
+        "rarely equal)"),
+}
+
+
+def apply_xla_tuning(env: Optional[dict] = None,
+                     force: Optional[bool] = None) -> List[str]:
+    """Append the TPU tuning flags to ``env['XLA_FLAGS']``.
+
+    Additive and user-respecting: a flag whose name already appears in the
+    user's ``XLA_FLAGS`` is left alone. ``PADDLE_TPU_NO_XLA_TUNING=1``
+    disables the whole mechanism. TPU-gated: the flags only apply when a
+    TPU is plausibly present (``JAX_PLATFORMS`` mentions tpu, a TPU_*
+    runtime env var is set, or libtpu is importable) — CPU/GPU runs are
+    untouched. Must run before jax initializes its backend, which is why
+    ``paddle_tpu.device`` calls it at import; importing jax and running a
+    computation *before* paddle_tpu makes it a no-op for that process.
+
+    Returns the list of flags applied (empty when gated off). ``env``
+    defaults to ``os.environ``; pass a dict to test without process-global
+    effects. ``force`` overrides the TPU-presence probe (tests).
+    """
+    env = os.environ if env is None else env
+    disabled = env.get("PADDLE_TPU_NO_XLA_TUNING") == "1"
+    if force is None:
+        force = not disabled and _tpu_plausible(env)
+    if disabled or not force:
+        # not applying (kill switch or gate off): strip any tuning flags
+        # a TPU-side PARENT process appended — a CPU-forced child
+        # (JAX_PLATFORMS=cpu subprocess of a TPU job) inherits the
+        # parent's XLA_FLAGS, and its CPU XLA client aborts on the
+        # unknown --xla_tpu_* entries. Only our exact name=value pairs
+        # are removed; a user's own setting of the same flag name
+        # (different value) is left alone.
+        ours = {f"{name}={value}"
+                for name, (value, _w) in XLA_TUNING_FLAGS.items()}
+        existing = env.get("XLA_FLAGS", "")
+        if existing:
+            kept = [tok for tok in existing.split() if tok not in ours]
+            if len(kept) != len(existing.split()):
+                env["XLA_FLAGS"] = " ".join(kept)
+        return []
+    existing = env.get("XLA_FLAGS", "")
+    # exact flag-name match (token before '='): a plain substring test
+    # would let a longer user flag shadow a shorter tuning flag whose
+    # name is its prefix (e.g. ..._fusion vs ..._fusion_fuse_all_gather)
+    existing_names = {tok.split("=", 1)[0] for tok in existing.split()}
+    applied = []
+    for name, (value, _why) in XLA_TUNING_FLAGS.items():
+        if name in existing_names:
+            continue  # user already set it (either value): theirs wins
+        applied.append(f"{name}={value}")
+    if applied:
+        env["XLA_FLAGS"] = " ".join([existing] + applied).strip()
+    return applied
+
+
+def _tpu_plausible(env) -> bool:
+    """Cheap TPU-presence probe that must not initialize a jax backend.
+
+    Deliberately conservative: the tpu-only flags make a CPU/GPU XLA
+    client ABORT at backend init ("Unknown flags in XLA_FLAGS"), so an
+    explicit non-TPU ``JAX_PLATFORMS`` always wins, and merely having
+    libtpu installed (common in mixed images) is not evidence — only a
+    platform selection naming the TPU (or its tunnel plugin) or a TPU
+    runtime env var is."""
+    platforms = env.get("JAX_PLATFORMS", "").lower()
+    if platforms:
+        # "axon" is the TPU-tunnel PJRT plugin this sandbox boots with
+        return "tpu" in platforms or "axon" in platforms
+    return any(k in env for k in ("TPU_NAME", "TPU_ACCELERATOR_TYPE",
+                                  "TPU_WORKER_ID", "TPU_SKU",
+                                  "TPU_CHIPS_PER_HOST_BOUNDS"))
+
+
+_applied_xla_tuning = apply_xla_tuning()
+
+
+def applied_xla_tuning() -> List[str]:
+    """The tuning flags this process's import actually added (empty on
+    CPU/GPU, when the user pre-set them, or under
+    ``PADDLE_TPU_NO_XLA_TUNING=1``)."""
+    return list(_applied_xla_tuning)
 
 
 def _devices():

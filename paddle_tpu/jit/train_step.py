@@ -16,6 +16,24 @@ Usage::
 Parameters, optimizer accumulators and batch-norm buffers are updated in
 place (storage replacement) after each call; the LR is threaded as a runtime
 scalar so schedulers never retrigger compilation.
+
+Step-glue fast paths (docs/PERFORMANCE.md):
+
+- **Fused multi-tensor optimizer** (``jit.fused_update``): instead of
+  tracing the update rule once per parameter (~100s of tiny elementwise
+  kernels + N small clip reductions), a precomputed flat-buffer layout runs
+  one update per (group, dtype, master, sharding) bucket over concatenated
+  1-D buffers, with global-norm clip as one dot per bucket. Per-parameter
+  state layout is preserved at the step boundary. ``fused=False`` or
+  ``PADDLE_TPU_FUSED_OPTIMIZER=0`` restores the per-param loop.
+- **Bucketed dp gradient collectives** (``jit.bucketing``): for a pure-dp
+  ``DataParallel`` model the step computes per-shard gradients under
+  ``shard_map`` and reduces them in size-targeted buckets (one ``pmean``
+  per bucket, reverse registration order) instead of GSPMD's one
+  all-reduce per parameter — giving the latency-hiding scheduler a handful
+  of large, early-issuable async collectives to overlap with the rest of
+  backward. ``bucketed=False`` or ``PADDLE_TPU_BUCKETED_GRADS=0`` restores
+  pure GSPMD.
 """
 from __future__ import annotations
 
@@ -30,24 +48,55 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.nn.layer_base import Layer
 from .functional import functional_state, swap_state
 from .api import _sig_of, _unwrap, _wrap
+from .fused_update import (_flat, build_flat_states, build_layout,
+                           fused_clip_and_update, fused_enabled,
+                           split_flat_states)
+from .bucketing import (bucketed_eligibility, bucketed_enabled,
+                        plan_comm_buckets)
+
+#: key under which the fused buckets' flat state rides the compiled step's
+#: ``states`` pytree (cannot collide with parameter names, which are
+#: dotted attribute paths)
+FUSED_KEY = "__fused__"
 
 __all__ = ["TrainStep"]
 
 
 class TrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate: bool = True, mesh=None, input_spec=None):
+                 donate: bool = True, mesh=None, input_spec=None,
+                 fused=None, bucketed=None):
         """``mesh``/``input_spec`` activate SPMD compilation: every batch
         leaf is placed with ``input_spec`` (a PartitionSpec, default: shard
         dim 0 on the mesh's ``dp`` axis; a ``DataParallel`` wrapper supplies
         its ``batch_spec``), parameters keep their ``_sharding_spec``
         annotations (replicated when unannotated — plain DP; sharded for
-        TP/ZeRO), and XLA inserts all gradient/activation collectives."""
+        TP/ZeRO), and XLA inserts all gradient/activation collectives.
+
+        ``fused``/``bucketed`` override the env defaults for the fused
+        multi-tensor optimizer and bucketed dp gradient collectives (None
+        = follow ``PADDLE_TPU_FUSED_OPTIMIZER`` /
+        ``PADDLE_TPU_BUCKETED_GRADS``)."""
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
         self._donate = donate
         self._cache = {}
+        self._fused = fused_enabled() if fused is None else bool(fused)
+        self._bucketed = bucketed_enabled() if bucketed is None \
+            else bool(bucketed)
+        # per-compile-key plan: (FlatLayout|None, comm buckets|None, reason)
+        self._plans = {}
+        # fused flat optimizer state: (layout_sig, layout, [per-bucket
+        # {state_key: flat array}], [per-bucket {name: id(installed
+        # per-param dict)}]). Keyed by the layout's STRUCTURE (not the
+        # compile key) so compile keys that share a trainable set — e.g.
+        # alternating batch signatures — reuse one set of flats instead of
+        # flushing/rebuilding per step. The flats are the authoritative
+        # hot-path state between steps; _flush_flat re-materializes the
+        # per-parameter layout on demand (state_dict, eager step, another
+        # TrainStep) — see docs/PERFORMANCE.md.
+        self._flat_cache = None
         from paddle_tpu.distributed.parallel import DataParallel
         if mesh is None and isinstance(model, DataParallel):
             mesh = model._mesh
@@ -103,15 +152,18 @@ class TrainStep:
         clipped = clip(pairs)
         return {n: c.data for n, (_, c) in zip(names, clipped)}
 
-    def _update_pure(self, train, grads, states, group_lrs):
-        """Apply the optimizer's pure rule per parameter (same code the eager
-        step() runs — see optimizer.py module doc). ``group_lrs`` holds one
-        traced effective-LR scalar per param group (scheduler values are
-        resolved host-side each call, never baked into the trace)."""
+    def _update_loop(self, names, train, grads, states, group_lrs):
+        """The classic per-parameter update (same rule the eager step()
+        runs). ``group_lrs`` holds one traced effective-LR scalar per param
+        group (scheduler values are resolved host-side each call, never
+        baked into the trace); per-param kwargs come from the host-side
+        ``_param_group_kwargs`` hook — nothing on ``opt`` is mutated
+        inside the trace."""
         opt = self._opt
         new_train, new_states = {}, {}
-        for name, p_arr in train.items():
+        for name in names:
             p = self._params[name]
+            p_arr = train[name]
             g = grads[name]
             state = states[name]
             gi = self._group_index[id(p)]
@@ -125,8 +177,7 @@ class TrainStep:
                 g = decay(p_arr, g)
             dcoeff = opt._decay_coeff_for(p, decay) \
                 if opt._decoupled_decay else 0.0
-            opt._cur_param = p
-            kw = opt._group_kwargs(group)
+            kw = opt._param_group_kwargs(p, group)
             new_p, new_s = opt._update(p_arr, g, state,
                                        opt._param_lr(p, eff_lr),
                                        weight_decay=dcoeff, **kw)
@@ -137,14 +188,142 @@ class TrainStep:
             new_states[name] = new_s
         return new_train, new_states
 
+    def _apply_updates(self, train, grads, states, group_lrs, layout):
+        """Clip + optimizer update for every train param: fused buckets
+        through ``fused_update`` (flat state rides ``states[FUSED_KEY]``),
+        everything else (or ``fused=False``) through the per-param loop."""
+        if layout is None or not layout.buckets:
+            grads = self._clip_pure(grads)
+            return self._update_loop(list(train), train, grads, states,
+                                     group_lrs)
+        new_train, new_flats, res_grads = fused_clip_and_update(
+            self._opt, layout, train, grads, states[FUSED_KEY], group_lrs,
+            self._clip_pure)
+        new_states = {FUSED_KEY: new_flats}
+        if layout.residue:
+            rt, rs = self._update_loop(layout.residue, train, res_grads,
+                                       states, group_lrs)
+            new_train.update(rt)
+            new_states.update(rs)
+        return new_train, new_states
+
+    # -- fused flat-state lifecycle -------------------------------------------
+    @staticmethod
+    def _layout_sig(layout):
+        """Structural identity of a layout: two layouts with the same
+        signature index identical flat buffers (bucket membership, order,
+        state keys), so their compile keys can share one flat cache."""
+        return tuple((b.names, b.vector_keys, b.scalar_keys, b.master)
+                     for b in layout.buckets)
+
+    def _flat_ids_ok(self, layout, src_ids):
+        opt = self._opt
+        return all(id(opt._state.get(id(self._params[n]))) == ids[n]
+                   for b, ids in zip(layout.buckets, src_ids)
+                   for n in b.names)
+
+    def _release_per_param(self, layout):
+        """Drop the per-parameter accumulator arrays while the flats are
+        authoritative (dict identity preserved — the ids-based
+        invalidation still works; the arrays themselves would otherwise
+        duplicate the whole optimizer state in device memory). Readers
+        always come back through ``_flush_flat``, which re-installs full
+        dicts first."""
+        opt = self._opt
+        for b in layout.buckets:
+            for n in b.names:
+                d = opt._state.get(id(self._params[n]))
+                if d:
+                    d.clear()
+
+    def _flat_states_for(self, layout):
+        """The per-bucket flat state buffers for this layout — reused
+        while nothing external rewrote the per-parameter entries
+        (identity check against the dicts recorded at the last
+        build/flush), rebuilt from ``opt._state`` otherwise."""
+        opt = self._opt
+        sig = self._layout_sig(layout)
+        if self._flat_cache is not None:
+            csig, clayout, flats, src_ids = self._flat_cache
+            ids_ok = self._flat_ids_ok(clayout, src_ids)
+            if csig == sig and ids_ok:
+                self._release_per_param(clayout)
+                return flats
+            if ids_ok:
+                # layout changed (e.g. a param unfroze) with our flats
+                # still the newest values: persist them, then rebuild
+                self._flush_flat()
+            else:
+                # something external (set_state_dict, rollback restore,
+                # another TrainStep's flush) replaced per-param entries
+                # AFTER our last flush — those values win; flushing now
+                # would clobber them with stale flats
+                self._flat_cache = None
+        flats = build_flat_states(opt, layout, self._params)
+        src_ids = [{n: id(opt._state[id(self._params[n])])
+                    for n in b.names} for b in layout.buckets]
+        self._flat_cache = (sig, layout, flats, src_ids)
+        self._release_per_param(layout)
+        opt._register_state_sync(self)
+        return flats
+
+    def _flush_flat(self):
+        """Materialize the flat buffers back into ``opt._state``'s
+        per-parameter layout (slice + reshape — bitwise the values the
+        per-param loop would have stored). Invoked through the
+        optimizer's ``_sync_state`` seam by ``state_dict`` /
+        ``set_state_dict`` / eager ``step()`` / other TrainSteps; cheap
+        no-op when no fused step ran since the last flush. When the
+        per-param entries were replaced externally AFTER our last flush
+        (an eager step's own writes, a restore), those values are newer —
+        the cache is dropped instead of installed."""
+        if self._flat_cache is None:
+            return
+        sig, layout, flats, src_ids = self._flat_cache
+        opt = self._opt
+        if not self._flat_ids_ok(layout, src_ids):
+            self._flat_cache = None
+            return
+        # eval_context: a flush can fire at GC time (__del__) WHILE some
+        # other function is being traced — under omnistaging the split's
+        # jnp ops would then stage into that trace and leak tracers into
+        # opt._state (observed: poisoned state_dict after test-ordered
+        # GC). Escape to the eval trace so the split always runs eagerly.
+        with jax.core.eval_context():
+            per = split_flat_states(layout, flats)
+        new_ids = []
+        for b, dicts in zip(layout.buckets, per):
+            ids = {}
+            for n, st in zip(b.names, dicts):
+                opt._state[id(self._params[n])] = st
+                ids[n] = id(st)
+            new_ids.append(ids)
+        # flats stay valid (flush is a read) — re-anchor the identity
+        # record to the dicts just installed
+        self._flat_cache = (sig, layout, flats, new_ids)
+
+    def __del__(self):
+        # a TrainStep discarded without a final state read must not take
+        # the only copy of the fused accumulators with it
+        try:
+            self._flush_flat()
+        except Exception:
+            pass
+
     # -- compile --------------------------------------------------------------
-    def _compile(self, treedef):
+    def _grads_gspmd(self, treedef):
+        """Gradient closure for the default path: one value_and_grad over
+        the global batch; GSPMD inserts whatever collectives the shardings
+        imply (per-param grad all-reduces under dp)."""
         model, loss_fn = self._model, self._loss_fn
 
-        def pure(train, frozen, buffers, states, group_lrs, rng_key,
-                 flat_batch):
+        def run(train, frozen, buffers, rng, flat_batch):
             args = jax.tree_util.tree_unflatten(treedef, flat_batch)
             args = _wrap(args)
+            # the step key folds from (base, count) INSIDE the program —
+            # same key next_key() would produce, without the eager
+            # per-step dispatch (measurable step-glue on small steps)
+            rng_key = jax.random.fold_in(rng[0], rng[1])
 
             def loss_of(train_arrs):
                 state = {**train_arrs, **frozen, **buffers}
@@ -156,9 +335,91 @@ class TrainStep:
 
             (loss_val, new_bufs), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train)
-            grads = self._clip_pure(grads)
-            new_train, new_states = self._update_pure(train, grads, states,
-                                                      group_lrs)
+            return loss_val, grads, new_bufs
+        return run
+
+    def _grads_bucketed(self, treedef, comm, flat_example):
+        """Gradient closure for the bucketed-collective path: shard_map
+        over ``dp`` computes per-shard gradients with no implicit
+        collectives, then reduces them as ONE ``pmean`` per planned bucket
+        (reverse registration order — first-complete grads reduce first)
+        plus one for the scalar loss. The resulting HLO carries
+        ``len(comm) + 1`` all-reduces whose explicit dependencies let the
+        latency-hiding scheduler overlap them with remaining backward
+        compute (the flags ``paddle_tpu.device`` enables on TPU)."""
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.distributed.fleet.utils import shard_map_compat
+
+        model, loss_fn = self._model, self._loss_fn
+        mesh = self._mesh
+        seg = {}  # name -> (size, shape) for the post-reduce split
+        for names in comm:
+            for n in names:
+                shape = tuple(self._params[n].data.shape)
+                seg[n] = (int(np.prod(shape)) if shape else 1, shape)
+
+        def local(train, frozen, rng, flat_batch):
+            # step key folds from (base, count) in-program; each dp shard
+            # additionally folds its axis index so per-shard randomness
+            # (dropout) decorrelates
+            key = jax.random.fold_in(
+                jax.random.fold_in(rng[0], rng[1]),
+                jax.lax.axis_index("dp"))
+            args = jax.tree_util.tree_unflatten(treedef, flat_batch)
+            args = _wrap(args)
+
+            def loss_of(train_arrs):
+                state = {**train_arrs, **frozen}
+                with no_grad(), _gen.rng_guard(key), \
+                        swap_state(model, state) as out_bufs:
+                    loss = loss_fn(model, *args[0], **args[1])
+                    val = loss.data if isinstance(loss, Tensor) else loss
+                return val, out_bufs
+
+            (loss_val, new_bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train)
+            flats = []
+            for names in comm:
+                flat = _flat(jnp, [grads[n] for n in names])
+                flats.append(jax.lax.pmean(flat, "dp"))
+            loss_val = jax.lax.pmean(loss_val, "dp")
+            return loss_val, flats, new_bufs
+
+        def batch_spec(leaf):
+            return P("dp") if getattr(leaf, "ndim", 0) > 0 else P()
+
+        sm = shard_map_compat(
+            local, mesh,
+            in_specs=(P(), P(), P(), [batch_spec(a) for a in flat_example]),
+            out_specs=P())
+
+        def run(train, frozen, buffers, rng, flat_batch):
+            loss_val, flats, new_bufs = sm(train, frozen, rng,
+                                           flat_batch)
+            grads = {}
+            for names, flat in zip(comm, flats):
+                off = 0
+                for n in names:
+                    size, shape = seg[n]
+                    grads[n] = jnp.reshape(flat[off:off + size], shape)
+                    off += size
+            # restore registration order so clip/update see the same
+            # iteration order as the GSPMD path
+            grads = {n: grads[n] for n in train}
+            return loss_val, grads, new_bufs
+        return run
+
+    def _compile(self, treedef, layout, comm, flat_example):
+        grads_of = self._grads_bucketed(treedef, comm, flat_example) \
+            if comm is not None else self._grads_gspmd(treedef)
+
+        def pure(train, frozen, buffers, states, group_lrs, rng_key,
+                 flat_batch):
+            loss_val, grads, new_bufs = grads_of(train, frozen, buffers,
+                                                 rng_key, flat_batch)
+            new_train, new_states = self._apply_updates(
+                train, grads, states, group_lrs, layout)
             return loss_val, new_train, new_states, new_bufs
 
         donate = (0, 3) if self._donate else ()
@@ -189,8 +450,14 @@ class TrainStep:
         zero_axis = getattr(self._opt, "_shard_states_axis", None)
         zero_n = mesh.shape.get(zero_axis, 1) if zero_axis in \
             getattr(mesh, "axis_names", ()) else 1
+        # per-param states only for the residue when a fused layout is
+        # active — bucket flats ride states[FUSED_KEY], always replicated
+        # (build_layout only fuses replicated params, and ZeRO disables
+        # the layout entirely so accumulator sharding is untouched)
+        per_param_names = layout.residue if layout is not None \
+            and layout.buckets else list(train)
         states_sh = {}
-        for n in train:
+        for n in per_param_names:
             p = self._params[n]
             st = self._opt._ensure_state(p)
             pspec = getattr(p, "_sharding_spec", None)
@@ -207,6 +474,14 @@ class TrainStep:
                 else:
                     sh[k] = rep
             states_sh[n] = sh
+        if layout is not None and layout.buckets:
+            bucket_keys = []
+            for b in layout.buckets:
+                keys = list(b.vector_keys) + list(b.scalar_keys)
+                if b.master:
+                    keys.append("master_weight")
+                bucket_keys.append({k: rep for k in keys})
+            states_sh[FUSED_KEY] = bucket_keys
         in_spec = self._input_spec
         if in_spec is None and "dp" in mesh.axis_names:
             in_spec = PartitionSpec("dp")
@@ -216,7 +491,6 @@ class TrainStep:
                 return rep
             return ns(in_spec)
 
-        flat_example, _ = jax.tree_util.tree_flatten(self._example_batch)
         batch_sh = [batch_sharding(a) for a in flat_example]
         lr_sh = [rep] * len(self._opt._param_groups)
         in_shardings = (train_sh, frozen_sh, buf_sh, states_sh, lr_sh, rep,
@@ -252,8 +526,14 @@ class TrainStep:
         return out
 
     # -- call -----------------------------------------------------------------
-    def __call__(self, *args, **kwargs):
+    def _prepare(self, args, kwargs):
+        """Resolve (compile if needed) the executable for this batch
+        signature and assemble its call arguments."""
         model, opt = self._model, self._opt
+        # other holders of flat state (another TrainStep on this
+        # optimizer) must flush before we read accumulators; our own
+        # flats stay authoritative
+        opt._sync_state(exclude=self)
         treedef, sig = _sig_of((args, kwargs))
         train, frozen, buffers = self._split_state()
         # the trainable-name set keys the cache too: unfreezing a param
@@ -266,13 +546,46 @@ class TrainStep:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
                 if hasattr(a, "shape") and hasattr(a, "dtype") else a,
                 _unwrap((args, kwargs)))
-            self._cache[key] = self._compile(treedef)
-        compiled = self._cache[key]
+            flat_example, _ = jax.tree_util.tree_flatten(self._example_batch)
+            # accumulators (incl. any param unfrozen after construction)
+            # must exist — with their real contents, not the released
+            # husks the flat cache leaves behind — before the layout
+            # reads their shapes and scalar values
+            self._flush_flat()
+            for name in train:
+                opt._ensure_state(self._params[name])
+            layout = build_layout(opt, self._params, list(train)) \
+                if self._fused else None
+            comm, reason = None, "disabled"
+            if self._bucketed:
+                reason = bucketed_eligibility(
+                    model, opt, self._mesh, self._input_spec, self._params,
+                    buffers, flat_example)
+                if reason is None:
+                    comm = plan_comm_buckets(train)
+            self._plans[key] = (layout, comm, reason)
+            self._cache[key] = self._compile(treedef, layout, comm,
+                                             flat_example)
+        layout, comm, reason = self._plans[key]
+        self._layout, self._comm_buckets, self._bucketed_reason = \
+            layout, comm, reason
 
-        states = {name: opt._ensure_state(self._params[name])
-                  for name in train}
+        if layout is not None and layout.buckets:
+            states = {name: opt._ensure_state(self._params[name])
+                      for name in layout.residue}
+            states[FUSED_KEY] = self._flat_states_for(layout)
+        else:
+            states = {name: opt._ensure_state(self._params[name])
+                      for name in train}
         flat_batch, _ = jax.tree_util.tree_flatten(_unwrap((args, kwargs)))
-        rng_key = _gen.next_key()
+        base_key, count = _gen.next_key_parts()
+        return train, self._cache[key], (
+            train, frozen, buffers, states, self._group_lrs(),
+            (base_key, np.uint32(count)), flat_batch)
+
+    def __call__(self, *args, **kwargs):
+        model, opt = self._model, self._opt
+        train, compiled, call_args = self._prepare(args, kwargs)
 
         from paddle_tpu.observability.comm import compute_scope
         from paddle_tpu.profiler import RecordEvent
@@ -281,9 +594,7 @@ class TrainStep:
         # running concurrently (bucketed async all-reduce) is overlapped,
         # one serialized after it is exposed
         with RecordEvent("TrainStep"), compute_scope():
-            loss_val, new_train, new_states, new_bufs = compiled(
-                train, frozen, buffers, states, self._group_lrs(), rng_key,
-                flat_batch)
+            loss_val, new_train, new_states, new_bufs = compiled(*call_args)
 
         # write back (storage replacement — same semantics as eager step())
         opt._step_count += 1
@@ -291,7 +602,16 @@ class TrainStep:
             p = self._params[name]
             p._data = arr
             p._version += 1
-            opt._state[id(p)] = new_states[name]
+            if name in new_states:
+                opt._state[id(p)] = new_states[name]
+        if FUSED_KEY in new_states:
+            # fused accumulators stay flat between steps (donated buffers
+            # updated in place); per-param opt._state entries are
+            # re-materialized lazily by _flush_flat when something reads
+            # them — identity record unchanged, the flats stay newest
+            sig, layout, _, src_ids = self._flat_cache
+            self._flat_cache = (sig, layout, new_states[FUSED_KEY],
+                                src_ids)
         named_bufs = dict(model.named_buffers())
         for name, arr in new_bufs.items():
             b = named_bufs.get(name)
@@ -299,5 +619,22 @@ class TrainStep:
                 b._data = arr
         return Tensor(loss_val)
 
+    def compiled_hlo(self, *args, **kwargs) -> str:
+        """Compiled-HLO text of the step for this batch (inspection seam:
+        the bucketed-collective acceptance test counts ``all-reduce`` ops
+        here instead of guessing from timings). RNG-neutral: the step is
+        never executed, so the key _prepare drew is handed back — an
+        inspection must not shift the subsequent training key stream
+        (resume == uninterrupted digest equality depends on it)."""
+        rng_state = _gen.get_rng_state()
+        try:
+            _, compiled, call_args = self._prepare(args, kwargs)
+            return compiled.lower(*call_args).compile().as_text()
+        finally:
+            _gen.set_rng_state(rng_state)
+
     def clear_cache(self):
+        self._flush_flat()
+        self._flat_cache = None
         self._cache.clear()
+        self._plans.clear()

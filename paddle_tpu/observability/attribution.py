@@ -186,7 +186,8 @@ def attribute_train_step(model, optimizer, batch, *,
                          data_time_s: float = 0.0,
                          peak: Optional[float] = None,
                          registry=None,
-                         config: Optional[dict] = None
+                         config: Optional[dict] = None,
+                         fused: Optional[bool] = None
                          ) -> AttributionReport:
     """Measure the phase table for one (model, optimizer, batch) triple.
 
@@ -197,7 +198,10 @@ def attribute_train_step(model, optimizer, batch, *,
     the backbone to its final hidden states WITHOUT the loss head
     (default: ``model.model(x)`` — the zoo's ``ForCausalLM.model``
     attribute). ``data_time_s`` is the per-step loader wait to report as
-    the data phase (``StepTimer`` measures it in a real fit).
+    the data phase (``StepTimer`` measures it in a real fit). ``fused``
+    threads into ``TrainStep`` (None = env default) — running the
+    attribution once per setting is how ``bench.py --attribution`` prints
+    its fused-vs-looped optimizer-phase comparison.
     """
     import jax
     import jax.numpy as jnp
@@ -227,6 +231,12 @@ def attribute_train_step(model, optimizer, batch, *,
     x_arr = x_t.data
 
     train, frozen, buffers = functional_state(model)
+    # the hidden/grad probe programs run interleaved with the REAL
+    # TrainStep, whose buffer donation consumes the live param arrays —
+    # give the probes their own copies (also keeps their weights fixed
+    # while the full step trains)
+    train = {k: v.copy() if hasattr(v, "copy") else v
+             for k, v in train.items()}
     key = jnp.zeros((2,), jnp.uint32)  # fixed key: timing, not training
 
     def pure_of(fn):
@@ -253,7 +263,8 @@ def attribute_train_step(model, optimizer, batch, *,
     flops_hidden = _cost_flops(hidden_c)
     flops_full = _cost_flops(grad_c)
 
-    full_step = TrainStep(model, lambda m, t: loss_fn(m, t), optimizer)
+    full_step = TrainStep(model, lambda m, t: loss_fn(m, t), optimizer,
+                          fused=fused)
 
     def sync_pair(out):
         np.asarray(out[0])

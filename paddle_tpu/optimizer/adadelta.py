@@ -17,6 +17,7 @@ class Adadelta(Optimizer):
     """
 
     _group_opts = ("rho", "epsilon")
+    _fusable_update = True  # elementwise: safe over concatenated buffers
 
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None,
@@ -31,13 +32,11 @@ class Adadelta(Optimizer):
         return {"avg_squared_grad": jnp.zeros(p.data.shape, dt),
                 "avg_squared_update": jnp.zeros(p.data.shape, dt)}
 
-    def _update(self, param, grad, state, lr, weight_decay=0.0, rho=0.95,
-                epsilon=1e-6):
-        g = grad.astype(param.dtype)
-        asg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+    def _update_delta(self, grad, state, lr, rho=0.95, epsilon=1e-6):
+        asg = rho * state["avg_squared_grad"] + (1 - rho) * grad * grad
         update = -jnp.sqrt(
-            (state["avg_squared_update"] + epsilon) / (asg + epsilon)) * g
+            (state["avg_squared_update"] + epsilon) / (asg + epsilon)) * grad
         asu = rho * state["avg_squared_update"] + (1 - rho) * update * update
         ns = dict(state)
         ns.update(avg_squared_grad=asg, avg_squared_update=asu)
-        return param + update, ns
+        return -update, ns

@@ -12,6 +12,7 @@ class Adagrad(Optimizer):
     """moment += grad^2; param -= lr * grad / (sqrt(moment) + eps)."""
 
     _group_opts = ("epsilon",)
+    _fusable_update = True  # elementwise: safe over concatenated buffers
 
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
                  weight_decay=None, grad_clip=None,
@@ -27,10 +28,9 @@ class Adagrad(Optimizer):
         return {"moment": jnp.full(p.data.shape,
                                    self._initial_accumulator_value, dt)}
 
-    def _update(self, param, grad, state, lr, weight_decay=0.0, epsilon=1e-6):
-        g = grad.astype(param.dtype)
-        moment = state["moment"] + g * g
-        new_p = param - lr * g / (jnp.sqrt(moment) + epsilon)
+    def _update_delta(self, grad, state, lr, epsilon=1e-6):
+        moment = state["moment"] + grad * grad
+        delta = lr * grad / (jnp.sqrt(moment) + epsilon)
         ns = dict(state)
         ns["moment"] = moment
-        return new_p, ns
+        return delta, ns

@@ -19,6 +19,7 @@ class Adam(Optimizer):
     """
 
     _group_opts = ("beta1", "beta2", "epsilon")
+    _fusable_update = True  # elementwise: safe over concatenated buffers
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
@@ -39,17 +40,14 @@ class Adam(Optimizer):
             "beta2_pow": jnp.ones((), jnp.float32),
         }
 
-    def _update(self, param, grad, state, lr, weight_decay=0.0, beta1=0.9,
-                beta2=0.999, epsilon=1e-8):
-        g = grad.astype(param.dtype)
-        m = beta1 * state["moment1"] + (1 - beta1) * g
-        v = beta2 * state["moment2"] + (1 - beta2) * g * g
+    def _update_delta(self, grad, state, lr, beta1=0.9, beta2=0.999,
+                      epsilon=1e-8):
+        m = beta1 * state["moment1"] + (1 - beta1) * grad
+        v = beta2 * state["moment2"] + (1 - beta2) * grad * grad
         b1p = state["beta1_pow"] * beta1
         b2p = state["beta2_pow"] * beta2
         lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
-        if weight_decay:  # decoupled path (AdamW sets _decoupled_decay)
-            param = param * (1.0 - lr * weight_decay)
-        new_p = param - (lr_t * m / (jnp.sqrt(v) + epsilon)).astype(param.dtype)
+        delta = lr_t * m / (jnp.sqrt(v) + epsilon)
         ns = dict(state)
         ns.update(moment1=m, moment2=v, beta1_pow=b1p, beta2_pow=b2p)
-        return new_p, ns
+        return delta, ns

@@ -14,6 +14,7 @@ class Adamax(Optimizer):
     """
 
     _group_opts = ("beta1", "beta2", "epsilon")
+    _fusable_update = True  # elementwise: safe over concatenated buffers
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
@@ -30,13 +31,12 @@ class Adamax(Optimizer):
                 "inf_norm": jnp.zeros(p.data.shape, dt),
                 "beta1_pow": jnp.ones((), jnp.float32)}
 
-    def _update(self, param, grad, state, lr, weight_decay=0.0, beta1=0.9,
-                beta2=0.999, epsilon=1e-8):
-        g = grad.astype(param.dtype)
-        m = beta1 * state["moment"] + (1 - beta1) * g
-        u = jnp.maximum(jnp.abs(g), beta2 * state["inf_norm"] + epsilon)
+    def _update_delta(self, grad, state, lr, beta1=0.9, beta2=0.999,
+                      epsilon=1e-8):
+        m = beta1 * state["moment"] + (1 - beta1) * grad
+        u = jnp.maximum(jnp.abs(grad), beta2 * state["inf_norm"] + epsilon)
         b1p = state["beta1_pow"] * beta1
-        new_p = param - (lr / (1 - b1p)).astype(param.dtype) * m / u
+        delta = (lr / (1 - b1p)).astype(grad.dtype) * m / u
         ns = dict(state)
         ns.update(moment=m, inf_norm=u, beta1_pow=b1p)
-        return new_p, ns
+        return delta, ns

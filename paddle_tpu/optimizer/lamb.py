@@ -38,11 +38,17 @@ class Lamb(Optimizer):
                 "beta1_pow": jnp.ones((), jnp.float32),
                 "beta2_pow": jnp.ones((), jnp.float32)}
 
+    def _param_group_kwargs(self, p, group):
+        # per-param decay exclusion resolved host-side (the rule itself
+        # must stay a pure function — no optimizer-attribute reads of
+        # per-param context inside a trace)
+        kw = super()._param_group_kwargs(p, group)
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            kw["lamb_weight_decay"] = 0.0
+        return kw
+
     def _update(self, param, grad, state, lr, weight_decay=0.0, beta1=0.9,
                 beta2=0.999, epsilon=1e-6, lamb_weight_decay=0.01):
-        if self._exclude_fn is not None and \
-                self._exclude_fn(getattr(self, "_cur_param", None)):
-            lamb_weight_decay = 0.0
         g = grad.astype(param.dtype)
         m = beta1 * state["moment1"] + (1 - beta1) * g
         v = beta2 * state["moment2"] + (1 - beta2) * g * g

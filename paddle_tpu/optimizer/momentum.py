@@ -14,6 +14,7 @@ class Momentum(Optimizer):
     """
 
     _group_opts = ("momentum",)
+    _fusable_update = True  # elementwise: safe over concatenated buffers
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
@@ -29,13 +30,10 @@ class Momentum(Optimizer):
     def _acc_dtype(self, p):
         return jnp.float32 if self._needs_master(p) else p.data.dtype
 
-    def _update(self, param, grad, state, lr, weight_decay=0.0, momentum=0.9):
-        g = grad.astype(param.dtype)
-        v = momentum * state["velocity"] + g
-        if self._use_nesterov:
-            new_p = param - lr * (g + momentum * v)
-        else:
-            new_p = param - lr * v
+    def _update_delta(self, grad, state, lr, momentum=0.9):
+        v = momentum * state["velocity"] + grad
+        delta = lr * (grad + momentum * v) if self._use_nesterov \
+            else lr * v
         ns = dict(state)
         ns["velocity"] = v
-        return new_p, ns
+        return delta, ns

@@ -28,6 +28,12 @@ class Optimizer:
     # subclasses list per-group hyperparameter names (beyond learning_rate /
     # weight_decay) that _update receives as keyword args
     _group_opts: Sequence[str] = ()
+    # True when _update is elementwise/shape-polymorphic: the identical rule
+    # applied to a concatenated 1-D buffer gives bitwise the same result per
+    # element, so jit.fused_update may run one call per bucket instead of
+    # one per parameter. Rules with per-tensor reductions (Lamb's trust
+    # ratio) must leave this False.
+    _fusable_update: bool = False
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=False):
@@ -68,6 +74,11 @@ class Optimizer:
         # list keeps ids stable for the optimizer's lifetime
         self._state: Dict[int, Dict[str, jnp.ndarray]] = {}
         self._step_count = 0
+        # external holders of authoritative state (a TrainStep's fused
+        # flat-bucket buffers) register here; _sync_state flushes them
+        # back into the per-parameter layout before any reader/writer of
+        # self._state runs (state_dict / set_state_dict / eager step)
+        self._state_sync_hooks: List[object] = []
 
     # -- decay/lr plumbing -----------------------------------------------------
     @staticmethod
@@ -94,6 +105,26 @@ class Optimizer:
         self._lr = scheduler
 
     # -- accumulators ----------------------------------------------------------
+    def _register_state_sync(self, holder):
+        """``holder._flush_flat()`` will be invoked before state reads —
+        idempotent registration (one entry per holder). Held by weakref:
+        a discarded TrainStep must not be pinned alive (its own
+        ``__del__`` flushes any flat state it still holds)."""
+        import weakref
+        self._state_sync_hooks = [
+            r for r in self._state_sync_hooks if r() is not None]
+        if not any(r() is holder for r in self._state_sync_hooks):
+            self._state_sync_hooks.append(weakref.ref(holder))
+
+    def _sync_state(self, exclude=None):
+        """Flush every registered flat-state holder into ``self._state``
+        (per-parameter layout). ``exclude`` skips the calling holder — its
+        own flat buffers stay authoritative for its next step."""
+        for r in list(self._state_sync_hooks):
+            holder = r()
+            if holder is not None and holder is not exclude:
+                holder._flush_flat()
+
     def _ensure_state(self, p: Tensor) -> Dict[str, jnp.ndarray]:
         s = self._state.get(id(p))
         if s is None:
@@ -112,8 +143,28 @@ class Optimizer:
         return {}
 
     # -- the update ------------------------------------------------------------
-    def _update(self, param, grad, state, lr, **opts):
-        """Pure update rule over jax arrays: returns (new_param, new_state)."""
+    def _update(self, param, grad, state, lr, weight_decay=0.0, **opts):
+        """Pure update rule over jax arrays: returns (new_param, new_state).
+
+        Default implementation: decoupled decay + subtract the rule's
+        :meth:`_update_delta`. Factoring the rule into a param-independent
+        delta is what makes it *shape-polymorphic*: the fused multi-tensor
+        path (``jit.fused_update``) runs ``_update_delta`` once per flat
+        bucket and applies the per-parameter subtraction on slices. Rules
+        whose step direction needs the parameter value itself (Lamb's
+        trust ratio) override ``_update`` wholesale and stay unfusable.
+        """
+        g = grad.astype(param.dtype)
+        delta, ns = self._update_delta(g, state, lr, **opts)
+        if weight_decay:  # decoupled path (AdamW sets _decoupled_decay)
+            param = param * (1.0 - lr * weight_decay)
+        return param - delta.astype(param.dtype), ns
+
+    def _update_delta(self, grad, state, lr, **opts):
+        """Pure rule core: ``new_param = param - delta`` (before any
+        decoupled decay). ``grad`` arrives pre-cast to the accumulator
+        dtype; ``delta`` must be elementwise in ``grad`` and ``state``
+        only — no reductions, no parameter reads."""
         raise NotImplementedError
 
     def _group_kwargs(self, group) -> dict:
@@ -124,6 +175,15 @@ class Optimizer:
             else:
                 kw[name] = getattr(self, "_" + name)
         return kw
+
+    def _param_group_kwargs(self, p: Tensor, group) -> dict:
+        """``_update`` keyword args for one (param, group) pair, resolved
+        host-side BEFORE the rule runs (subclass hook — Lamb zeroes its
+        decay for excluded params here). This replaced the old
+        ``self._cur_param`` side channel, which was a stateful write inside
+        the jitted train-step trace; rules must stay pure functions of
+        their arguments."""
+        return self._group_kwargs(group)
 
     @property
     def _parameter_list(self) -> List[Tensor]:
@@ -136,6 +196,7 @@ class Optimizer:
         ``_apply_optimize``: collect (param, grad), run grad-clip, fold
         regularization into the grad, then the rule.
         """
+        self._sync_state()  # mixed eager/fused use: read current state
         self._step_count += 1
         for group in self._param_groups:
             params_grads = [(p, p.grad) for p in group["params"]
@@ -150,7 +211,6 @@ class Optimizer:
             lr = lr * self.get_lr() if "learning_rate" in group else \
                 self.get_lr()
             decay = group.get("weight_decay", self.regularization)
-            kw = self._group_kwargs(group)
             for p, g in params_grads:
                 state = self._ensure_state(p)
                 g_arr = g.data.astype(jnp.float32) if "master_weight" in state \
@@ -160,7 +220,7 @@ class Optimizer:
                     g_arr = decay(p_arr, g_arr)
                 dcoeff = self._decay_coeff_for(p, decay) \
                     if self._decoupled_decay else 0.0
-                self._cur_param = p  # visible to per-param rule hooks (Lamb)
+                kw = self._param_group_kwargs(p, group)
                 new_p, new_state = self._update(
                     p_arr, g_arr, state, self._param_lr(p, lr),
                     weight_decay=dcoeff, **kw)
@@ -234,6 +294,7 @@ class Optimizer:
         return p.name if p.name else f"param_{idx}"
 
     def state_dict(self) -> dict:
+        self._sync_state()  # fused flat buffers -> per-parameter layout
         sd: dict = {}
         for idx, p in enumerate(self._parameter_list):
             s = self._state.get(id(p))
@@ -249,6 +310,10 @@ class Optimizer:
         return sd
 
     def set_state_dict(self, state_dict: dict):
+        # flush first so params absent from ``state_dict`` keep their
+        # current (possibly flat-held) values; the overwrite below then
+        # invalidates any fused cache by replacing the state dicts
+        self._sync_state()
         sd = dict(state_dict)
         self._step_count = int(sd.pop("@step_count", self._step_count))
         lr_state = sd.pop("LR_Scheduler", None)

@@ -20,6 +20,7 @@ class RMSProp(Optimizer):
     """
 
     _group_opts = ("rho", "epsilon", "momentum")
+    _fusable_update = True  # elementwise: safe over concatenated buffers
 
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, weight_decay=None,
@@ -39,18 +40,17 @@ class RMSProp(Optimizer):
             s["mean_grad"] = jnp.zeros(p.data.shape, dt)
         return s
 
-    def _update(self, param, grad, state, lr, weight_decay=0.0, rho=0.95,
-                epsilon=1e-6, momentum=0.0):
-        g = grad.astype(param.dtype)
-        ms = rho * state["mean_square"] + (1 - rho) * g * g
+    def _update_delta(self, grad, state, lr, rho=0.95, epsilon=1e-6,
+                      momentum=0.0):
+        ms = rho * state["mean_square"] + (1 - rho) * grad * grad
         ns = dict(state)
         ns["mean_square"] = ms
         if self._centered:
-            mg = rho * state["mean_grad"] + (1 - rho) * g
+            mg = rho * state["mean_grad"] + (1 - rho) * grad
             ns["mean_grad"] = mg
             denom = ms - mg * mg + epsilon
         else:
             denom = ms + epsilon
-        mom = momentum * state["momentum_acc"] + lr * g / jnp.sqrt(denom)
+        mom = momentum * state["momentum_acc"] + lr * grad / jnp.sqrt(denom)
         ns["momentum_acc"] = mom
-        return param - mom, ns
+        return mom, ns

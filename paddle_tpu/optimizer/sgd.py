@@ -9,6 +9,7 @@ __all__ = ["SGD"]
 class SGD(Optimizer):
     """param = param - lr * grad."""
 
-    def _update(self, param, grad, state, lr, weight_decay=0.0):
-        new_p = param - lr * grad.astype(param.dtype)
-        return new_p, dict(state)
+    _fusable_update = True  # elementwise: safe over concatenated buffers
+
+    def _update_delta(self, grad, state, lr):
+        return lr * grad, dict(state)

@@ -163,8 +163,12 @@ class TestTrainStep:
         step = pt.jit.TrainStep(m, lambda mm, a, b: nn.MSELoss()(mm(a), b), o)
         step(t(X), t(y))
         p0 = m[0].weight
+        # _sync_state flushes the fused path's flat accumulators into the
+        # per-parameter layout (state_dict() does this implicitly)
+        o._sync_state()
         v1 = np.asarray(o._state[id(p0)]["velocity"]).copy()
         step(t(X), t(y))
+        o._sync_state()
         v2 = np.asarray(o._state[id(p0)]["velocity"])
         assert not np.allclose(v1, v2)
 
