@@ -3,8 +3,9 @@
 Starts ``serving.Server`` (HTTP front-end + background engine loop) on a
 tiny Llama, fires a handful of CONCURRENT ``/generate`` requests with
 mixed prompt/output lengths, and prints each request's TTFT and total
-latency plus the engine's final stats — note ``decode_compiles: 1``:
-every request rode one compiled decode executable. Run:
+latency plus the engine's final stats — note ``step_compiles: 1``:
+every request, prefill chunks and decode alike, rode ONE compiled
+unified step (the Ragged-Paged-Attention layout, docs/SERVING.md). Run:
 
     python examples/serve_llama.py
 """
@@ -58,7 +59,7 @@ def main():
         health = json.loads(urllib.request.urlopen(
             server.url + "/healthz", timeout=10).read())
         print("engine stats:", {k: health[k] for k in
-                                ("decode_compiles", "prefill_compiles",
+                                ("step_compiles", "attn_impl", "kv_headroom",
                                  "preemptions", "kv_blocks_in_use")})
 
 

@@ -28,7 +28,8 @@ from paddle_tpu.core.autograd import apply_op
 from paddle_tpu import ops
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
-from paddle_tpu.ops.paged_attention import PagedLayerCache
+from paddle_tpu.ops.paged_attention import (PagedLayerCache,
+                                            RaggedLayerCache)
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]
 
@@ -204,7 +205,20 @@ class LlamaAttention(nn.Layer):
         the BLOCK-PAGED path (the continuous-batching serving engine's
         cache form): per-row positions from ``context_lens``, scatter into
         the shared block pools, gather-based attention over each row's
-        block table."""
+        block table. A
+        :class:`~paddle_tpu.ops.paged_attention.RaggedLayerCache` is the
+        TOKEN-PACKED form of the same pools (the engine's one unified
+        prefill+decode step): ``x`` is ``[1, total_tokens, hidden]``,
+        per-token RoPE positions come from the cache, and the read path
+        is the Ragged-Paged-Attention Pallas kernel (or its gather
+        fallback — ``ops/paged_attention.py``'s impl knob)."""
+        if isinstance(cache, RaggedLayerCache):
+            if attention_mask is not None or pos_offsets is not None \
+                    or position_ids is not None:
+                raise NotImplementedError(
+                    "the ragged paged path derives per-token positions "
+                    "and key liveness from the cache itself")
+            return self._ragged_paged_forward(x, cache)
         if isinstance(cache, PagedLayerCache):
             if attention_mask is not None or pos_offsets is not None:
                 raise NotImplementedError(
@@ -373,6 +387,49 @@ class LlamaAttention(nn.Layer):
             cache.context_lens, cache.new_lens, op_name="paged_kv_attention")
         return self.o_proj(out), pa.PagedLayerCache(
             kp2, vp2, cache.block_tables, cache.context_lens, cache.new_lens)
+
+    def _ragged_paged_forward(self, x, cache):
+        """Token-packed block-paged attention (the unified serving
+        step): ``x`` [1, T, hidden] carries every scheduled sequence's
+        new tokens back to back; RoPE at the cache's per-token absolute
+        positions; scatter the new K/V into the shared pools; then the
+        RPA Pallas kernel (or gather fallback) streams each sequence's
+        real pages (ops/paged_attention.py dispatches on the impl knob
+        at trace time)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import paged_attention as pa
+
+        T = x.shape[1]
+        q = ops.reshape(self.q_proj(x), [T, self.n_heads, self.head_dim])
+        k = ops.reshape(self.k_proj(x), [T, self.n_kv, self.head_dim])
+        v = ops.reshape(self.v_proj(x), [T, self.n_kv, self.head_dim])
+        hd = self.head_dim
+        theta = self.cfg.rope_theta
+        table_len = self.cfg.max_position_embeddings
+        scale = 1.0 / math.sqrt(hd)
+
+        def f(qa, ka, va, kp, vp, bt, cu, ctx, sid, pos, ssq, sbk):
+            pidx = jnp.clip(pos.astype(jnp.int32), 0, table_len - 1)
+            cos, sin = _gather_rope(pidx[None, :], hd, theta,
+                                    str(qa.dtype), table_len)
+            cos, sin = cos[0], sin[0]          # [T, 1, hd/2]
+            return pa.ragged_paged_attention_step(
+                _rot_interleaved(qa, cos, sin),
+                _rot_interleaved(ka, cos, sin), va, kp, vp,
+                bt, cu, ctx, sid, pos, ssq, sbk, scale=scale)
+
+        out, kp2, vp2 = apply_op(
+            f, q, k, v, cache.k_pool, cache.v_pool, cache.block_tables,
+            cache.cu_seqlens, cache.context_lens, cache.seq_ids,
+            cache.positions, cache.step_seq, cache.step_blk,
+            op_name="ragged_paged_kv_attention")
+        # back to [1, T, hidden] for the backbone's residual stream
+        return self.o_proj(ops.reshape(out, [1, T, -1])), \
+            pa.RaggedLayerCache(
+                kp2, vp2, cache.block_tables, cache.cu_seqlens,
+                cache.context_lens, cache.seq_ids, cache.positions,
+                cache.step_seq, cache.step_blk)
 
 
 class LlamaMLP(nn.Layer):

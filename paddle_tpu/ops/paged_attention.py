@@ -21,21 +21,40 @@ it, so padding can never clobber a live sequence's cache. The allocator
 
 This mirrors the vLLM / Ragged-Paged-Attention layout (see
 ``/opt/skills/guides/boom_attention_tricks.md`` §8: per-sequence
-``page_indices`` over non-contiguous pages). Here the read path is a
-plain XLA gather (``pool[block_tables]``) + masked softmax — correct on
-every backend and the seam where a Pallas kernel with async per-page DMA
-slots in later without touching the serving layer above it.
+``page_indices`` over non-contiguous pages). Two read paths share it:
+
+* **gather** — a plain XLA gather (``pool[block_tables]``) + masked
+  softmax. Correct on every backend; materializes each row's whole
+  padded context, which is exactly the cost the kernel path removes.
+  It stays as the backend-portable fallback and the parity oracle.
+* **rpa** — the Ragged-Paged-Attention Pallas kernel
+  (``ops/pallas/ragged_paged_attention.py``): the token-packed batch
+  streams each sequence's KV page by page with online softmax, only
+  the real ``context_len`` worth of pages, no dense score tensor.
+
+``PADDLE_TPU_PAGED_ATTN_IMPL={rpa,gather,auto}`` picks the path
+(``auto``, the default: rpa on TPU, gather elsewhere);
+:func:`impl_override` pins it programmatically (the engine's
+``attn_impl=`` knob, and how parity tests compare both). The serving
+engine feeds the ragged token-packed form (:class:`RaggedLayerCache`);
+the per-row ``[B, S]`` form (:class:`PagedLayerCache`) remains for
+non-engine callers.
 """
 from __future__ import annotations
 
+import contextlib
 import math
+import os
+import threading
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["PagedLayerCache", "write_to_pool", "gather_pool",
-           "paged_attention_step"]
+__all__ = ["PagedLayerCache", "RaggedLayerCache", "write_to_pool",
+           "write_tokens_to_pool", "gather_pool", "paged_attention_step",
+           "ragged_gather_attention", "ragged_paged_attention_step",
+           "paged_attention_impl", "impl_override"]
 
 
 class PagedLayerCache(NamedTuple):
@@ -124,3 +143,140 @@ def paged_attention_step(q, k, v, k_pool, v_pool, block_tables,
     w = jax.nn.softmax(s, axis=-1).astype(vals.dtype)
     out = jnp.einsum("bskgl,blkh->bskgh", w, vals)
     return out.reshape(B, S, n_heads * hd), k_pool, v_pool
+
+
+# ===================== ragged token-packed form ==============================
+class RaggedLayerCache(NamedTuple):
+    """One layer's view of the paged KV state in the TOKEN-PACKED form
+    the unified serving step uses (ISSUE 8): the step's input is a flat
+    ``[1, total_tokens]`` axis holding every scheduled sequence's new
+    tokens back to back — prefill chunks (S>1) and decode rows (S=1)
+    together. ``block_tables`` carries an extra all-null sentinel row
+    (index ``max_seqs``) that padding tokens resolve through; metadata
+    rows beyond the live sequences point at it. The ``step_seq`` /
+    ``step_blk`` work maps are built host-side per step
+    (``ops.pallas.ragged_paged_attention.build_step_maps``) and are
+    traced INPUTS — shapes never change, so the engine's one executable
+    serves every batch mix."""
+    k_pool: object        # [num_blocks + 1, block_size, n_kv, hd]
+    v_pool: object        # [num_blocks + 1, block_size, n_kv, hd]
+    block_tables: object  # [max_seqs + 1, max_blocks_per_seq] int32
+    cu_seqlens: object    # [max_seqs + 2] int32 token-span prefix sums
+    context_lens: object  # [max_seqs + 1] int32 cached tokens per seq
+    seq_ids: object       # [T] int32 token -> sequence (max_seqs = pad)
+    positions: object     # [T] int32 absolute position per token
+    step_seq: object      # [num_q_tiles, max_steps] int32 kernel work map
+    step_blk: object      # [num_q_tiles, max_steps] int32 kernel work map
+
+
+# thread-local: two engines may trace their unified steps concurrently
+# on their background threads, each under its own attn_impl pin — a
+# process-global would let one trace leak its impl into the other
+_impl_local = threading.local()
+
+
+def paged_attention_impl() -> str:
+    """Resolve the paged read-path implementation: an
+    :func:`impl_override` in effect on THIS thread, else
+    ``PADDLE_TPU_PAGED_ATTN_IMPL`` (``rpa`` | ``gather`` | ``auto``),
+    else auto — rpa on TPU, gather elsewhere. Read at TRACE time: a
+    compiled serving step keeps whatever was resolved when it traced."""
+    override = getattr(_impl_local, "value", None)
+    if override is not None:
+        return override
+    v = os.environ.get("PADDLE_TPU_PAGED_ATTN_IMPL", "auto").lower()
+    if v in ("rpa", "gather"):
+        return v
+    if v != "auto":
+        raise ValueError(
+            f"PADDLE_TPU_PAGED_ATTN_IMPL={v!r} (want rpa|gather|auto)")
+    return "rpa" if jax.default_backend() == "tpu" else "gather"
+
+
+@contextlib.contextmanager
+def impl_override(value):
+    """Pin the read-path impl for the calls traced inside the block on
+    the current thread (``None`` = no-op). The engine wraps its unified
+    step's trace in this so ``ServingEngine(attn_impl=...)`` wins over
+    the env."""
+    if value is not None and value not in ("rpa", "gather"):
+        raise ValueError(f"attn impl {value!r} (want rpa|gather|None)")
+    prev = getattr(_impl_local, "value", None)
+    _impl_local.value = value
+    try:
+        yield
+    finally:
+        _impl_local.value = prev
+
+
+def write_tokens_to_pool(pool, new, block_tables, seq_ids, positions):
+    """Scatter ``new`` [T, n_kv, hd] into ``pool`` at each token's
+    ``positions`` through its sequence's block-table row. Padding tokens
+    (sentinel ``seq_ids`` → the all-null table row) land in the null
+    block, exactly like the per-row form's invalid-token redirection."""
+    bs, nblk = pool.shape[1], block_tables.shape[1]
+    blk = jnp.clip(positions.astype(jnp.int32) // bs, 0, nblk - 1)
+    phys = block_tables[seq_ids, blk]
+    slot = jnp.where(phys == 0, 0, positions.astype(jnp.int32) % bs)
+    return pool.at[phys, slot].set(new.astype(pool.dtype))
+
+
+def ragged_gather_attention(q, k_pool, v_pool, block_tables, seq_ids,
+                            positions, *, scale):
+    """Token-packed GQA attention via the XLA-gather fallback: gather
+    every sequence's whole padded context, pick each token's row, dense
+    masked softmax. Semantically identical to the rpa kernel (the parity
+    oracle); costs the [T, L_max] materialization the kernel removes."""
+    T, n_heads, hd = q.shape
+    n_kv = k_pool.shape[2]
+    grp = n_heads // n_kv
+    keys = gather_pool(k_pool, block_tables)   # [max_seqs+1, L, n_kv, hd]
+    vals = gather_pool(v_pool, block_tables)
+    kt = keys[seq_ids]                         # [T, L, n_kv, hd]
+    vt = vals[seq_ids]
+    L = kt.shape[1]
+    qg = q.reshape(T, n_kv, grp, hd)
+    s = jnp.einsum("tkgh,tlkh->tkgl", qg.astype(jnp.float32),
+                   kt.astype(jnp.float32)) * scale
+    visible = jnp.arange(L, dtype=jnp.int32)[None, :] <= \
+        positions.astype(jnp.int32)[:, None]            # [T, L]
+    s = jnp.where(visible[:, None, None, :], s,
+                  jnp.finfo(jnp.float32).min)
+    w = jax.nn.softmax(s, axis=-1).astype(vt.dtype)
+    out = jnp.einsum("tkgl,tlkh->tkgh", w, vt)
+    return out.reshape(T, n_heads, hd)
+
+
+def ragged_paged_attention_step(q, k, v, k_pool, v_pool, block_tables,
+                                cu_seqlens, context_lens, seq_ids,
+                                positions, step_seq, step_blk, *,
+                                scale=None):
+    """One unified serving step over the token-packed ragged layout.
+
+    ``q`` [T, n_heads, hd] and ``k``/``v`` [T, n_kv, hd] are the
+    (already position-encoded) projections of the step's flat tokens.
+    Writes the new K/V into the pools (padding to the null block), then
+    dispatches the read path on :func:`paged_attention_impl`: the
+    Pallas RPA kernel (page-streamed, online softmax) or the gather
+    fallback. Returns ``(out [T, n_heads*hd], k_pool', v_pool')``;
+    outputs at padding tokens are garbage (gather) or 0 (rpa) and must
+    be discarded by the caller either way.
+    """
+    T, n_heads, hd = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    k_pool = write_tokens_to_pool(k_pool, k, block_tables, seq_ids,
+                                  positions)
+    v_pool = write_tokens_to_pool(v_pool, v, block_tables, seq_ids,
+                                  positions)
+    if paged_attention_impl() == "rpa":
+        from paddle_tpu.ops.pallas.ragged_paged_attention import \
+            ragged_paged_attention
+        out = ragged_paged_attention(
+            q, k_pool, v_pool, block_tables, cu_seqlens, context_lens,
+            step_seq, step_blk, sm_scale=scale)
+    else:
+        out = ragged_gather_attention(
+            q, k_pool, v_pool, block_tables, seq_ids, positions,
+            scale=scale)
+    return out.reshape(T, n_heads * hd), k_pool, v_pool

@@ -6,20 +6,21 @@ zoo's ``generate`` surface and below an HTTP front-end:
 - **kv_cache** — block-paged KV-cache manager: fixed-size token blocks,
   per-sequence block tables, refcounted alloc/free, per-layer device
   pools threaded functionally through the compiled step.
-- **scheduler** — FCFS continuous batching: chunked-prefill/decode
-  interleaving, slot swapping between steps, preemption-by-recompute
-  when the block pool runs dry.
-- **engine** — :class:`ServingEngine`: ONE compiled prefill executable +
-  ONE compiled decode executable over a fixed batch-slot layout,
-  streaming token callbacks, drain/graceful shutdown, serving_*
-  metrics through ``observability.metrics``.
+- **scheduler** — FCFS continuous batching: token-budget packing of all
+  decode slots plus multiple prefill chunks per step, slot swapping
+  between steps, preemption-by-recompute when the block pool runs dry.
+- **engine** — :class:`ServingEngine`: ONE compiled unified step
+  executable over a token-packed mixed prefill+decode layout, streaming
+  token callbacks, drain/graceful shutdown, serving_* metrics through
+  ``observability.metrics``.
 - **server** — stdlib HTTP front-end: ``POST /generate`` (optionally
   chunked streaming), ``GET /healthz``, ``GET /metrics[.json]``.
 
-The attention read path is the gather-based paged attention in
-``ops/paged_attention.py`` — the seam a Ragged-Paged-Attention Pallas
-kernel (PAPERS.md, arxiv 2604.15464) later replaces without touching
-this layer.
+The attention read path is the Ragged-Paged-Attention Pallas kernel
+(``ops/pallas/ragged_paged_attention.py``, the RPA paper — PAPERS.md,
+arxiv 2604.15464) on TPU, with the gather-based fallback in
+``ops/paged_attention.py`` as the backend-portable parity oracle
+(``PADDLE_TPU_PAGED_ATTN_IMPL`` / ``ServingEngine(attn_impl=...)``).
 """
 from . import engine, kv_cache, scheduler, server  # noqa: F401
 from .engine import RequestHandle, ServingEngine  # noqa: F401

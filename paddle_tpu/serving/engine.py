@@ -8,19 +8,22 @@ stalls everyone, KV memory is worst-case), the engine keeps a FIXED
 requests between steps — so the decode step is compiled EXACTLY ONCE and
 requests enter/leave the batch continuously.
 
-Two executables, both traced a single time:
+ONE executable, traced a single time (ISSUE 8): every engine iteration
+runs a **unified step** over a token-packed ragged batch — a flat
+``[1, step_tokens]`` axis holding all live decode slots (one token
+each) plus as many prefill chunks as the budget covers, back to back.
+Attention reads go through the Ragged-Paged-Attention Pallas kernel on
+TPU (``ops/pallas/ragged_paged_attention.py``; the XLA-gather fallback
+elsewhere or via ``attn_impl=``/``PADDLE_TPU_PAGED_ATTN_IMPL``), which
+streams each sequence's real pages instead of materializing padded
+contexts — and because one kernel covers every prefill/decode mix,
+chunked prefill no longer needs its own compiled executable.
 
-* **prefill step** — ``[1, prefill_chunk]`` tokens of one sequence
-  (chunked prefill: long prompts advance one chunk per engine iteration,
-  interleaved with decode so they never starve running requests);
-* **decode step** — ``[max_batch, 1]`` tokens, one per active slot
-  (inactive slots run on the null block and their outputs are ignored).
-
-Both thread the per-layer block pools functionally (pools in → pools
-out), with per-row positions and block tables as traced inputs — no
-shape ever changes, so recompilation is structurally impossible; the
-``prefill_traces`` / ``decode_traces`` counters (incremented at trace
-time) make that checkable from tests.
+The step threads the per-layer block pools functionally (pools in →
+pools out), with block tables, token→sequence maps, and the kernel's
+work lists as traced inputs — no shape ever changes, so recompilation
+is structurally impossible; the ``step_traces`` counter (incremented at
+trace time) makes that checkable from tests.
 
 Telemetry goes through ``observability.metrics`` (queue depth,
 running/waiting gauges, TTFT and inter-token-latency histograms,
@@ -145,9 +148,14 @@ class ServingEngine:
     def __init__(self, model, max_batch: int = 8, max_blocks: int = 64,
                  block_size: int = 16, prefill_chunk: int = 16,
                  max_blocks_per_seq: Optional[int] = None,
-                 warm_start_from: Optional[str] = None):
+                 warm_start_from: Optional[str] = None,
+                 attn_impl: Optional[str] = None):
         from paddle_tpu.jit.functional import functional_state
         from paddle_tpu.models.generation import decode_surfaces
+        from paddle_tpu.ops import paged_attention as pa
+        from paddle_tpu.ops.pallas.ragged_paged_attention import (
+            DEFAULT_TILE_Q, build_step_maps, rpa_max_steps, rpa_tile_q)
+        self._build_step_maps = build_step_maps  # hot path: import once
 
         model.eval()
         if warm_start_from is not None:
@@ -178,14 +186,44 @@ class ServingEngine:
         self.max_model_len = min(self.cache.max_seq_len, max_pos)
         self.max_batch = int(max_batch)
         self.prefill_chunk = int(prefill_chunk)
+        #: attention read path, pinned at construction (None = env/auto:
+        #: rpa on TPU, gather elsewhere — docs/SERVING.md)
+        self.attn_impl = attn_impl if attn_impl is not None \
+            else pa.paged_attention_impl()
+        if self.attn_impl not in ("rpa", "gather"):
+            raise ValueError(
+                f"attn_impl {self.attn_impl!r} (want rpa|gather)")
+        # unified-step geometry: the flat token budget covers every
+        # decode slot plus one full prefill chunk, rounded up to the RPA
+        # kernel's q-tile height (autotunable on chip); max_steps is the
+        # kernel's static per-tile work-list bound. A gather-pinned
+        # engine keeps the default tile — sweeping RPA kernel candidates
+        # it will never execute would be pure startup cost
+        n_heads = cfg.num_attention_heads
+        self._tile_q = DEFAULT_TILE_Q if self.attn_impl == "gather" \
+            else rpa_tile_q(
+                self.max_batch + self.prefill_chunk, n_heads, n_kv, hd,
+                block_size, self.cache.max_blocks_per_seq, max_blocks,
+                dtype=str(jnp.dtype(dtype)))
+        budget = self.max_batch + self.prefill_chunk
+        self.step_tokens = -(-budget // self._tile_q) * self._tile_q
+        self._max_steps = rpa_max_steps(
+            self._tile_q, self.cache.max_blocks_per_seq, max_blocks)
+        # all-sentinel work lists for the gather path (same traced
+        # shapes, ignored by the gather read — built once, not per step)
+        self._null_step_maps = (
+            np.full((self.step_tokens // self._tile_q, self._max_steps),
+                    self.max_batch, np.int32),
+            np.zeros((self.step_tokens // self._tile_q, self._max_steps),
+                     np.int32))
         self.scheduler = Scheduler(self.cache, self.max_batch,
-                                   self.prefill_chunk)
+                                   self.prefill_chunk,
+                                   step_tokens=self.step_tokens)
 
-        #: executable-compilation counters — incremented at TRACE time,
-        #: so each equals the number of compiles of that step
-        self.prefill_traces = 0
-        self.decode_traces = 0
-        self._prefill_step, self._decode_step = self._build_steps()
+        #: executable-compilation counter — incremented at TRACE time,
+        #: so it equals the number of compiles of the ONE unified step
+        self.step_traces = 0
+        self._step = self._build_step()
 
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
@@ -216,8 +254,8 @@ class ServingEngine:
         """Warm-start: swap in weights from a checkpoint — a training
         ``CheckpointManager`` directory (latest or explicit ``step``), a
         single ``step_N`` dir, or a flat ``.pdparams`` file. The compiled
-        prefill/decode executables are untouched (the state dict is a
-        traced input, same shapes/dtypes), so no recompilation happens —
+        unified step is untouched (the state dict is a traced input,
+        same shapes/dtypes), so no recompilation happens —
         this is the serving warm-start seam (docs/CHECKPOINT.md).
 
         Refuses while requests are in flight: their KV cache was computed
@@ -235,45 +273,42 @@ class ServingEngine:
             train, frozen, buffers = functional_state(self.model)
             self._st = {**train, **frozen, **buffers}
 
-    # -- compiled steps ----------------------------------------------------
-    def _build_steps(self):
+    # -- the one compiled step ---------------------------------------------
+    def _build_step(self):
         from paddle_tpu.core.autograd import no_grad
         from paddle_tpu.core.tensor import Tensor
         from paddle_tpu.jit.functional import swap_state
-        from paddle_tpu.ops.paged_attention import PagedLayerCache
+        from paddle_tpu.ops import paged_attention as pa
 
         model, backbone, project = self.model, self._backbone, self._project
         nl = self.model.cfg.num_hidden_layers
+        impl = self.attn_impl
 
-        def make(counter_name):
-            def step(stt, tokens, k_pools, v_pools, bt, ctx, nlen):
-                # executes at trace time only — counts compiles
-                setattr(self, counter_name,
-                        getattr(self, counter_name) + 1)
-                caches = [PagedLayerCache(Tensor(k_pools[i]),
-                                          Tensor(v_pools[i]), Tensor(bt),
-                                          Tensor(ctx), Tensor(nlen))
-                          for i in range(nl)]
-                with no_grad(), swap_state(model, stt,
-                                           collect_buffers=False):
-                    h, new_caches = backbone(Tensor(tokens), caches=caches)
-                    if tokens.shape[1] > 1:
-                        # prefill (B=1): logits at the last VALID position
-                        idx = jnp.clip(nlen[0].astype(jnp.int32) - 1, 0,
-                                       tokens.shape[1] - 1)
-                        h = Tensor(jax.lax.dynamic_slice_in_dim(
-                            h.data, idx, 1, axis=1))
-                    logits = project(h)            # [B, 1, V]
-                kps = tuple(c.k_pool.data for c in new_caches)
-                vps = tuple(c.v_pool.data for c in new_caches)
-                return logits.data[:, 0].astype(jnp.float32), kps, vps
-            return step
+        def step(stt, tokens, k_pools, v_pools, bt, cu, ctx, sid, pos,
+                 ssq, sbk, last_idx):
+            # executes at trace time only — counts compiles
+            self.step_traces += 1
+            caches = [pa.RaggedLayerCache(
+                Tensor(k_pools[i]), Tensor(v_pools[i]), Tensor(bt),
+                Tensor(cu), Tensor(ctx), Tensor(sid), Tensor(pos),
+                Tensor(ssq), Tensor(sbk)) for i in range(nl)]
+            with no_grad(), swap_state(model, stt,
+                                       collect_buffers=False), \
+                    pa.impl_override(impl):
+                h, new_caches = backbone(Tensor(tokens), caches=caches)
+                # logits at each sequence's LAST packed token (rows of
+                # empty metadata slots gather token 0 — discarded by the
+                # host-side harvest)
+                hsel = Tensor(h.data[0][last_idx][:, None, :])
+                logits = project(hsel)             # [max_batch, 1, V]
+            kps = tuple(c.k_pool.data for c in new_caches)
+            vps = tuple(c.v_pool.data for c in new_caches)
+            return logits.data[:, 0].astype(jnp.float32), kps, vps
 
         # donating the pools lets XLA update them in place on TPU; the
         # CPU backend can't honor donation (harmless warning), so gate it
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
-        return (jax.jit(make("prefill_traces"), donate_argnums=donate),
-                jax.jit(make("decode_traces"), donate_argnums=donate))
+        return jax.jit(step, donate_argnums=donate)
 
     # -- metrics -----------------------------------------------------------
     def _init_metrics(self):
@@ -351,99 +386,127 @@ class ServingEngine:
 
     # -- one engine iteration ----------------------------------------------
     def step(self) -> bool:
-        """Plan + run one prefill chunk and/or one decode step. Returns
-        whether any work happened."""
+        """Plan + run one unified token-packed step (all live decode
+        slots + the packed prefill chunks). Returns whether any work
+        happened."""
         with self._lock:
             plan = self.scheduler.schedule()
             # belt-and-braces against plan staleness: never act on a
-            # sequence that lost its slot during planning
-            if plan.prefill is not None:
-                seq, n = plan.prefill
-                if (seq.slot is not None
-                        and seq.state is RequestState.PREFILL):
-                    self._run_prefill(seq, n)
-                else:
-                    plan.prefill = None
-            live = [s for s in plan.decode
-                    if s.slot is not None
-                    and s.state is RequestState.RUNNING]
-            if live:
-                self._run_decode(live)
+            # sequence that lost its slot/blocks during planning (a
+            # later allocation in the same plan may have preempted it)
+            decode = [s for s in plan.decode
+                      if s.slot is not None
+                      and s.state is RequestState.RUNNING]
+            prefills = [(s, n) for (s, n) in plan.prefills
+                        if s.slot is not None
+                        and s.state is RequestState.PREFILL]
+            if decode or prefills:
+                self._run_unified(decode, prefills)
             self._update_gauges()
-            return plan.prefill is not None or bool(live)
+            return bool(decode or prefills)
 
-    def _run_prefill(self, seq: Request, n_new: int):
+    def _run_unified(self, decode: List[Request],
+                     prefills: List[tuple]):
+        """Pack the planned work into the flat token budget, build the
+        step's ragged metadata (token→sequence map, per-token positions,
+        the RPA kernel's work lists) host-side, run the ONE compiled
+        step, and harvest per-sequence results."""
         from paddle_tpu.observability import trace
-        if seq.prefill_pos == 0 and seq.slot_time is not None \
-                and not getattr(seq, "_queue_wait_observed", False):
-            # queue-wait ends at FIRST admission, observed exactly once
-            # per request — slot_time never resets, so a recompute
-            # prefill after preemption still reports the original wait
-            # (a request preempted before its first chunk must not be
-            # dropped from the histogram: overload is exactly when
-            # queue-wait matters)
-            seq._queue_wait_observed = True
-            self._m_queue_wait.observe(seq.slot_time - seq.arrival_time)
-        C = self.prefill_chunk
-        tokens = np.zeros((1, C), np.int32)
-        tokens[0, :n_new] = seq.pending_tokens[
-            seq.prefill_pos:seq.prefill_pos + n_new]
-        bt = self.cache.pad_block_table(seq.block_ids)[None, :]
-        ctx = np.array([seq.prefill_pos], np.int32)
-        nlen = np.array([n_new], np.int32)
-        t0 = time.perf_counter_ns()
-        compiles0 = self.prefill_traces
-        logits, kps, vps = self._prefill_step(
-            self._st, jnp.asarray(tokens), self.cache.k_pools,
-            self.cache.v_pools, jnp.asarray(bt), jnp.asarray(ctx),
-            jnp.asarray(nlen))
-        self.cache.update_pools(kps, vps)
-        self._clear_model_side_effects()
-        if trace.active() is not None:
-            # compile attribution: a first-ever chunk that traced the
-            # executable carries compiles=1 — the "slow TTFT because XLA
-            # compiled" signal, distinct from admission or preemption
-            trace.span("serving", "prefill_chunk", t0,
-                       time.perf_counter_ns(),
-                       args={"req": seq.req_id, "tokens": n_new,
-                             "pos": seq.prefill_pos,
-                             "compiles": self.prefill_traces - compiles0,
-                             "preemptions": seq.preemptions})
-        seq.prefill_pos += n_new
-        seq.num_cached += n_new
-        self._m_tokens.inc(n_new, kind="prompt")
-        self._m_steps.inc(kind="prefill")
-        if seq.prefill_pos == len(seq.pending_tokens):
-            # prompt fully cached: sample the continuation (this is the
-            # request's first token — or, after a preemption, the next)
-            tok = self._sample(np.asarray(logits)[0], seq)
-            seq.state = RequestState.RUNNING
-            self._emit_token(seq, tok)
 
-    def _run_decode(self, seqs: List[Request]):
-        B = self.max_batch
-        tokens = np.zeros((B, 1), np.int32)
-        bt = np.zeros((B, self.cache.max_blocks_per_seq), np.int32)
-        ctx = np.zeros((B,), np.int32)
-        nlen = np.zeros((B,), np.int32)
-        for seq in seqs:
-            s = seq.slot
-            tokens[s, 0] = seq.last_token()
-            bt[s] = self.cache.pad_block_table(seq.block_ids)
-            ctx[s] = seq.num_cached
-            nlen[s] = 1
-        logits, kps, vps = self._decode_step(
+        for seq, _ in prefills:
+            if seq.prefill_pos == 0 and seq.slot_time is not None \
+                    and not getattr(seq, "_queue_wait_observed", False):
+                # queue-wait ends at FIRST admission, observed exactly
+                # once per request — slot_time never resets, so a
+                # recompute prefill after preemption still reports the
+                # original wait (a request preempted before its first
+                # chunk must not be dropped from the histogram: overload
+                # is exactly when queue-wait matters)
+                seq._queue_wait_observed = True
+                self._m_queue_wait.observe(
+                    seq.slot_time - seq.arrival_time)
+
+        entries = [(seq, 1, False) for seq in decode] + \
+                  [(seq, n, True) for seq, n in prefills]
+        T, S = self.step_tokens, self.max_batch
+        assert len(entries) <= S and \
+            sum(n for _, n, _ in entries) <= T, "scheduler over-packed"
+        tokens = np.zeros((1, T), np.int32)
+        bt = np.zeros((S + 1, self.cache.max_blocks_per_seq), np.int32)
+        cu = np.zeros((S + 2,), np.int32)
+        ctx = np.zeros((S + 1,), np.int32)
+        sid = np.full((T,), S, np.int32)   # sentinel = padding
+        pos = np.zeros((T,), np.int32)
+        last_idx = np.zeros((S,), np.int32)
+        kv_lens = []
+        off = 0
+        for i, (seq, n, is_prefill) in enumerate(entries):
+            if is_prefill:
+                tokens[0, off:off + n] = seq.pending_tokens[
+                    seq.prefill_pos:seq.prefill_pos + n]
+                c = seq.prefill_pos
+            else:
+                tokens[0, off] = seq.last_token()
+                c = seq.num_cached
+            bt[i] = self.cache.pad_block_table(seq.block_ids)
+            ctx[i] = c
+            sid[off:off + n] = i
+            pos[off:off + n] = c + np.arange(n)
+            cu[i + 1] = off + n
+            last_idx[i] = off + n - 1
+            kv_lens.append(c + n)
+            off += n
+        cu[len(entries) + 1:] = off
+        if self.attn_impl == "rpa":
+            ssq, sbk = self._build_step_maps(
+                cu[:len(entries) + 1], kv_lens, total_tokens=T,
+                tile_q=self._tile_q, block_size=self.cache.block_size,
+                max_steps=self._max_steps, max_seqs=S)
+        else:
+            # the gather path ignores the kernel work lists; feed the
+            # cached all-sentinel maps instead of rebuilding per step
+            ssq, sbk = self._null_step_maps
+
+        t0 = time.perf_counter_ns()
+        compiles0 = self.step_traces
+        logits, kps, vps = self._step(
             self._st, jnp.asarray(tokens), self.cache.k_pools,
-            self.cache.v_pools, jnp.asarray(bt), jnp.asarray(ctx),
-            jnp.asarray(nlen))
+            self.cache.v_pools, jnp.asarray(bt), jnp.asarray(cu),
+            jnp.asarray(ctx), jnp.asarray(sid), jnp.asarray(pos),
+            jnp.asarray(ssq), jnp.asarray(sbk), jnp.asarray(last_idx))
         self.cache.update_pools(kps, vps)
         self._clear_model_side_effects()
-        self._m_steps.inc(kind="decode")
+        t1 = time.perf_counter_ns()
+        compiled = self.step_traces - compiles0
+        self._m_steps.inc(kind="unified")
         arr = np.asarray(logits)
-        for seq in seqs:
-            seq.num_cached += 1
-            tok = self._sample(arr[seq.slot], seq)
-            self._emit_token(seq, tok)
+
+        for i, (seq, n, is_prefill) in enumerate(entries):
+            if is_prefill:
+                if trace.active() is not None:
+                    # compile attribution: a chunk that rode the step
+                    # that traced the executable carries compiles=1 —
+                    # the "slow TTFT because XLA compiled" signal,
+                    # distinct from admission or preemption
+                    trace.span("serving", "prefill_chunk", t0, t1,
+                               args={"req": seq.req_id, "tokens": n,
+                                     "pos": seq.prefill_pos,
+                                     "compiles": compiled,
+                                     "preemptions": seq.preemptions})
+                seq.prefill_pos += n
+                seq.num_cached += n
+                self._m_tokens.inc(n, kind="prompt")
+                if seq.prefill_pos == len(seq.pending_tokens):
+                    # prompt fully cached: sample the continuation (the
+                    # request's first token — or, after preemption, the
+                    # next)
+                    tok = self._sample(arr[i], seq)
+                    seq.state = RequestState.RUNNING
+                    self._emit_token(seq, tok)
+            else:
+                seq.num_cached += 1
+                tok = self._sample(arr[i], seq)
+                self._emit_token(seq, tok)
 
     def _sample(self, logits_row: np.ndarray, seq: Request) -> int:
         if seq.temperature == 0:
@@ -649,8 +712,15 @@ class ServingEngine:
             "kv_blocks_in_use": self.cache.allocator.blocks_in_use(),
             "kv_blocks_free": self.cache.allocator.num_free(),
             "preemptions": self.scheduler.num_preemptions,
-            "prefill_compiles": self.prefill_traces,
-            "decode_compiles": self.decode_traces,
+            "step_compiles": self.step_traces,
+            "attn_impl": self.attn_impl,
+            "step_tokens": self.step_tokens,
+            # pool pressure BEFORE preemption-by-recompute starts
+            # churning: fraction of KV blocks still free (the /healthz
+            # field operators watch)
+            "kv_headroom": round(
+                self.cache.allocator.num_free()
+                / max(self.cache.allocator.capacity, 1), 4),
             "max_batch": self.max_batch,
             "max_model_len": self.max_model_len,
             "block_size": self.cache.block_size,

@@ -1,15 +1,18 @@
-"""Continuous-batching scheduler: FCFS admission, prefill/decode
-interleaving, preemption-by-recompute.
+"""Continuous-batching scheduler: FCFS admission, token-budget packing
+of mixed prefill+decode steps, preemption-by-recompute.
 
 The scheduler owns request queues and KV-block accounting; the engine
-owns the compiled steps. Each engine iteration asks for a
-:class:`StepPlan`, which names at most ONE prefill chunk (chunked
-prefill: a long prompt advances ``prefill_chunk`` tokens per iteration
-so it can never starve running decoders) plus the set of running
-sequences to decode this step. Slots are the engine's fixed batch
-positions — a finished request's slot is handed to the next waiting
-request between steps, which is what keeps the decode executable's
-shapes (and therefore its compilation) constant.
+owns the ONE compiled unified step (ISSUE 8). Each engine iteration asks
+for a :class:`StepPlan` that packs work into the engine's fixed
+``step_tokens`` budget: **every** running sequence decodes one token
+(decode is planned FIRST, so a streaming long prefill can never starve
+running decoders), then prefill chunks fill the remaining budget FCFS —
+several sequences' chunks may ride one step, each capped at
+``prefill_chunk`` tokens per iteration (chunked prefill). Slots are the
+engine's fixed metadata rows — a finished request's slot is handed to
+the next waiting request between steps, which (together with the fixed
+token budget) keeps the unified executable's shapes, and therefore its
+single compilation, constant.
 
 When the block pool can't cover a needed allocation, the sequence with
 the LATEST arrival is preempted (vLLM's recompute policy, protecting
@@ -17,7 +20,14 @@ FCFS order): its blocks are freed, and it re-enters the waiting queue
 with ``prompt + generated-so-far`` as its new prefill text. On
 readmission the recompute-prefill rebuilds its KV state and the sampled
 continuation picks up exactly where it left off — under greedy decoding
-the final output is identical to the unpreempted run.
+the final output is identical to the unpreempted run. Because decode is
+planned before prefill and victims are always strictly YOUNGER than the
+sequence needing blocks, a plan can never direct the engine at a
+sequence whose blocks a later planning stage of the same plan took: an
+already-planned victim is knocked back to WAITING (slot released), and
+the engine filters such stale entries before acting — the
+protected-victim guarantee (no chunk is ever written through an
+all-null block table).
 """
 from __future__ import annotations
 
@@ -104,26 +114,41 @@ class Request:
 
 @dataclass
 class StepPlan:
-    #: (sequence, number of prompt tokens to prefill this step)
-    prefill: Optional[Tuple[Request, int]] = None
+    #: prefill chunks packed into this step's token budget, FCFS order:
+    #: (sequence, number of prompt tokens to prefill)
+    prefills: List[Tuple[Request, int]] = field(default_factory=list)
     #: running sequences to advance one decode token
     decode: List[Request] = field(default_factory=list)
 
     @property
     def empty(self) -> bool:
-        return self.prefill is None and not self.decode
+        return not self.prefills and not self.decode
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.decode) + sum(n for _, n in self.prefills)
 
 
 class Scheduler:
-    """FCFS continuous-batching policy over ``max_batch`` engine slots."""
+    """FCFS continuous-batching policy over ``max_batch`` engine slots
+    and a ``step_tokens`` per-step token budget."""
 
     def __init__(self, cache: PagedKVCache, max_batch: int,
-                 prefill_chunk: int):
+                 prefill_chunk: int, step_tokens: Optional[int] = None):
         if prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         self.cache = cache
         self.max_batch = max_batch
         self.prefill_chunk = prefill_chunk
+        # default budget: every decode slot plus one full chunk — the
+        # worst mix the old two-executable engine could run per
+        # iteration, now in one step
+        self.step_tokens = int(step_tokens if step_tokens is not None
+                               else max_batch + prefill_chunk)
+        if self.step_tokens < max_batch + 1:
+            raise ValueError(
+                f"step_tokens {self.step_tokens} can't cover "
+                f"{max_batch} decode slots plus any prefill")
         self.waiting: List[Request] = []   # sorted by arrival_time
         self.slots: List[Optional[Request]] = [None] * max_batch
         self.num_preemptions = 0
@@ -150,18 +175,19 @@ class Scheduler:
 
     # -- planning ----------------------------------------------------------
     def schedule(self) -> StepPlan:
-        """Admit, pick one prefill chunk, and collect the decode batch
-        (preempting by recompute where the block pool falls short). The
-        planned prefill sequence is PROTECTED from decode-side
-        preemption for this plan — otherwise a decode allocation could
-        evict the very sequence the same plan tells the engine to
-        prefill, and the engine would write its chunk through an
-        all-null block table (silently corrupting the recompute)."""
+        """Admit, collect the decode batch, then pack prefill chunks
+        into the remaining token budget (preempting by recompute where
+        the block pool falls short). Decode plans FIRST — running
+        requests advance every step no matter how many prompts are
+        streaming (starvation-freedom), and FCFS-senior prefill
+        allocations that evict a younger just-planned decode sequence
+        merely turn its plan entry stale (the engine filters on
+        slot/state before acting — the protected-victim guarantee)."""
         self._admit()
         plan = StepPlan()
-        plan.prefill = self._plan_prefill()
-        protect = plan.prefill[0] if plan.prefill else None
-        plan.decode = self._plan_decode(protect)
+        plan.decode = self._plan_decode()
+        plan.prefills = self._plan_prefills(
+            self.step_tokens - len(plan.decode))
         return plan
 
     def _admit(self):
@@ -175,43 +201,62 @@ class Scheduler:
             if req.slot_time is None:
                 req.slot_time = time.perf_counter()
 
-    def _plan_prefill(self) -> Optional[Tuple[Request, int]]:
-        cands = [s for s in self.slotted()
-                 if s.state is RequestState.PREFILL]
-        if not cands:
-            return None
-        seq = min(cands, key=lambda r: r.arrival_time)
-        n = min(self.prefill_chunk,
-                len(seq.pending_tokens) - seq.prefill_pos)
-        if not self._ensure_blocks(seq, seq.prefill_pos + n):
-            return None  # pool contended even after preemption; retry later
-        return (seq, n)
+    def _plan_prefills(self, budget: int) -> List[Tuple[Request, int]]:
+        """FCFS prefill packing: each PREFILL-state sequence gets up to
+        ``prefill_chunk`` tokens (chunked prefill — long prompts stream
+        across steps), as many sequences as the budget covers. Stops at
+        the first sequence the pool can't serve even after preemption:
+        letting a YOUNGER prompt's chunk jump it would invert FCFS with
+        the pool under pressure, exactly when order matters."""
+        out: List[Tuple[Request, int]] = []
+        cands = sorted((s for s in self.slotted()
+                        if s.state is RequestState.PREFILL),
+                       key=lambda r: r.arrival_time)
+        for seq in cands:
+            if budget <= 0:
+                break
+            if seq.slot is None or seq.state is not RequestState.PREFILL:
+                # preempted mid-loop by a senior candidate's allocation:
+                # planning it anyway would attach fresh blocks to a
+                # slotless WAITING request (unreclaimable by
+                # _pick_victim) or spuriously evict a third sequence
+                continue
+            n = min(self.prefill_chunk, budget,
+                    len(seq.pending_tokens) - seq.prefill_pos)
+            if n <= 0:
+                continue
+            if not self._ensure_blocks(seq, seq.prefill_pos + n):
+                break  # pool contended; retry later, keep FCFS order
+            out.append((seq, n))
+            budget -= n
+        return out
 
-    def _plan_decode(self, protect: Optional[Request] = None
-                     ) -> List[Request]:
+    def _plan_decode(self) -> List[Request]:
         batch = []
         # earliest arrivals first: preemption victims come from the tail,
         # so a seq preempted mid-planning is simply never reached
         for seq in sorted(self.slotted(), key=lambda r: r.arrival_time):
             if seq.state is not RequestState.RUNNING or seq.slot is None:
                 continue
-            if self._ensure_blocks(seq, seq.num_cached + 1,
-                                   protect=protect):
+            if self._ensure_blocks(seq, seq.num_cached + 1):
                 batch.append(seq)
         return batch
 
     # -- block management --------------------------------------------------
-    def _ensure_blocks(self, seq: Request, total_tokens: int,
-                       protect: Optional[Request] = None) -> bool:
+    def _ensure_blocks(self, seq: Request, total_tokens: int) -> bool:
         """Grow ``seq``'s block table to cover ``total_tokens`` cached
         positions, preempting latest-arrival sequences as needed.
-        ``protect`` (this plan's prefill target) is never evicted."""
+        Victims are always strictly younger than ``seq`` (FCFS-senior
+        requests are never evicted for junior ones). A victim that was
+        already planned this step is knocked to WAITING with its slot
+        released, which is exactly what the engine's stale-entry filter
+        checks — it can never be executed against freed blocks."""
         alloc = self.cache.allocator
         need = self.cache.blocks_for(total_tokens) - len(seq.block_ids)
         if need <= 0:
             return True
         while not alloc.can_allocate(need):
-            victim = self._pick_victim(after=seq, protect=protect)
+            victim = self._pick_victim(after=seq)
             if victim is None:
                 holders = [s for s in self.slotted()
                            if s is not seq and s.block_ids]
@@ -228,16 +273,12 @@ class Scheduler:
         seq.block_ids.extend(alloc.allocate(need))
         return True
 
-    def _pick_victim(self, after: Request,
-                     protect: Optional[Request] = None
-                     ) -> Optional[Request]:
+    def _pick_victim(self, after: Request) -> Optional[Request]:
         """Latest-arrival slotted sequence strictly younger than
         ``after`` — preemption never evicts an earlier (FCFS-senior)
-        request, which also guarantees a decode batch member planned this
-        step can't be yanked out from under the plan; ``protect`` is
-        excluded outright."""
+        request."""
         cands = [s for s in self.slotted()
-                 if s is not after and s is not protect and s.block_ids
+                 if s is not after and s.block_ids
                  and s.arrival_time > after.arrival_time]
         if not cands:
             return None

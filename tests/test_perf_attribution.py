@@ -247,9 +247,15 @@ _FAMILY_PREFIXES = ("comm_", "train_", "serving_", "ckpt_",
 
 #: backticked doc tokens that look like families but are not registry
 #: metrics: `comm_bytes` is the chrome-trace counter-track name,
-#: `comm_scope` an API
+#: `comm_scope` an API; the two `serving_*` names are bench.py --serve
+#: report-gate headlines (stdout {"metric","value"} lines gated by
+#: --report, ISSUE 8) — percentile aggregates of the registry's
+#: serving_ttft_seconds / serving_tokens_total families, not families
+#: themselves
 _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
-                          "comm_totals", "data_time_s"}
+                          "comm_totals", "data_time_s",
+                          "serving_p99_ttft_seconds",
+                          "serving_decode_tokens_per_sec"}
 
 
 def _documented_families():

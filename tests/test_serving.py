@@ -1,11 +1,15 @@
 """Continuous-batching serving subsystem (paddle_tpu.serving).
 
-Coverage contract (ISSUE 2): block alloc/free/refcount invariants (no
-leak after preemption), a short request admitted while a long one is
-mid-decode with both matching their sequential baselines, the HTTP
-``/generate`` round trip, and a compile-exactly-once guard over the
-decode executable. The full ≥8-concurrent-request acceptance run is
-marked ``slow``; a single-request smoke stays in tier-1.
+Coverage contract (ISSUE 2, upgraded by ISSUE 8): block
+alloc/free/refcount invariants (no leak after preemption), a short
+request admitted while a long one is mid-decode with both matching
+their sequential baselines, the HTTP ``/generate`` round trip, a
+compile-exactly-once guard over the ONE unified token-packed step
+executable, and unified-step scheduler invariants (decode-first
+starvation-freedom, multi-chunk budget packing, stale-entry preemption
+safety). The full ≥8-concurrent-request acceptance run is marked
+``slow``; a single-request smoke stays in tier-1. RPA-vs-gather kernel
+parity lives in ``test_ragged_paged_attention.py``.
 """
 import json
 import threading
@@ -123,11 +127,12 @@ def test_paged_cache_matches_concat_cache():
     np.testing.assert_allclose(g2.numpy(), h2.numpy(), atol=2e-5)
 
 
-def test_plan_never_preempts_its_own_prefill_target():
-    """Regression: with the pool drained by the plan's own prefill
-    allocation, the decode planner must not evict the prefill target in
-    the same schedule() call — the engine would then write the chunk
-    through an all-null block table and silently corrupt the recompute."""
+def test_decode_outranks_prefill_for_the_last_block():
+    """Unified-step planning order (ISSUE 8): decode plans FIRST, so an
+    OLDER running request takes the pool's last block ahead of a younger
+    prompt's prefill chunk — FCFS holds exactly when the pool is the
+    contended resource, and the running request is never starved by a
+    streaming prompt."""
     from paddle_tpu.serving import PagedKVCache
     from paddle_tpu.serving.scheduler import Request, Scheduler
 
@@ -144,14 +149,134 @@ def test_plan_never_preempts_its_own_prefill_target():
     a.state = RequestState.RUNNING
     a.generated = [5]
     plan = sch.schedule()
-    # B's prefill chunk takes the last free block; A's decode then finds
-    # the pool empty — it must WAIT, not evict the planned prefill
-    assert plan.prefill is not None
-    seq, n = plan.prefill
-    assert seq is b and seq.slot is not None
-    assert seq.state is RequestState.PREFILL
+    # A's decode takes the last free block; B's chunk finds the pool
+    # empty and must WAIT (evicting would require a victim younger than
+    # B — there is none) — never run through an all-null block table
+    assert a in plan.decode and len(a.block_ids) == 3
+    assert plan.prefills == []
+    assert b.slot is not None and b.state is RequestState.PREFILL
+    assert b.block_ids == []             # waiting, not corrupted
+
+
+def test_multi_chunk_packing_and_budget():
+    """Several prompts' chunks ride ONE step up to the token budget,
+    FCFS order, each capped at prefill_chunk; running decoders are all
+    planned first and never skipped while prompts stream
+    (starvation-freedom under the unified step)."""
+    from paddle_tpu.serving import PagedKVCache
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+
+    cache = PagedKVCache(num_layers=1, num_blocks=32, block_size=4,
+                         num_kv_heads=1, head_dim=4)
+    sch = Scheduler(cache, max_batch=4, prefill_chunk=4, step_tokens=8)
+    d = Request(prompt_tokens=[9] * 4)          # oldest: mid-decode
+    sch.add(d)
+    p1 = Request(prompt_tokens=[1] * 10)        # long prompt, streams
+    p2 = Request(prompt_tokens=[2] * 3)
+    p3 = Request(prompt_tokens=[3] * 6)
+    for r in (p1, p2, p3):
+        sch.add(r)
+    sch._admit()
+    d.block_ids = cache.allocator.allocate(1)
+    d.prefill_pos = d.num_cached = 4
+    d.state = RequestState.RUNNING
+    d.generated = [7]
+    plan = sch.schedule()
+    # decode first, then chunks FCFS into the remaining 7-token budget:
+    # p1 gets its full 4-token chunk, p2 its whole 3-token prompt; p3
+    # must wait for the next step
+    assert plan.decode == [d]
+    assert [(r is p1 or r is p2 or r is p3, n)
+            for r, n in plan.prefills] == [(True, 4), (True, 3)]
+    assert plan.prefills[0][0] is p1 and plan.prefills[1][0] is p2
+    assert plan.total_tokens == 8 <= sch.step_tokens
+    # the long prompt streams: next plan gives its SECOND chunk and p3
+    # enters; decode is still never skipped
+    for seq, n in plan.prefills:
+        seq.prefill_pos += n
+        seq.num_cached += n
+    p2.state = RequestState.RUNNING          # p2's prompt is complete
+    p2.generated = [1]
+    plan2 = sch.schedule()
+    assert d in plan2.decode and p2 in plan2.decode
+    assert plan2.prefills[0][0] is p1 and plan2.prefills[0][1] == 4
+    assert plan2.total_tokens <= sch.step_tokens
+
+
+def test_prefill_candidate_preempted_mid_loop_is_skipped():
+    """A prefill candidate evicted by a SENIOR candidate's allocation
+    earlier in the same _plan_prefills loop must be skipped, not
+    planned: planning it would attach fresh blocks to a slotless WAITING
+    request (invisible to _pick_victim, so senior requests would starve
+    on an unreclaimable block) or spuriously evict a third sequence for
+    a plan entry the engine discards anyway."""
+    import time as _time
+
+    from paddle_tpu.serving import PagedKVCache
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+
+    cache = PagedKVCache(num_layers=1, num_blocks=3, block_size=4,
+                         num_kv_heads=1, head_dim=4)
+    sch = Scheduler(cache, max_batch=2, prefill_chunk=4, step_tokens=8)
+    senior = Request(prompt_tokens=[1] * 4)
+    sch.add(senior)
+    _time.sleep(0.001)
+    junior = Request(prompt_tokens=[2] * 12)  # mid-prefill, holds blocks
+    sch.add(junior)
+    sch._admit()
+    junior.block_ids = cache.allocator.allocate(2)
+    junior.prefill_pos = junior.num_cached = 8
+    cache.allocator.allocate(1)               # drain the last free block
+    plan = sch.schedule()
+    # senior's chunk evicts junior (frees 2, takes 1, 1 left); the loop
+    # then reaches junior — now WAITING/slotless — and must skip it
+    assert [r for r, _ in plan.prefills] == [senior]
+    assert junior.state is RequestState.WAITING and junior.slot is None
+    assert junior.block_ids == []             # no blocks parked on it
+    assert cache.allocator.num_free() == 1
+
+
+def test_evicted_plan_entry_goes_stale_not_corrupt():
+    """Protected-victim guarantee under the unified step: when a
+    senior prefill's allocation preempts a younger request that the SAME
+    plan already scheduled for decode, the victim's entry is left stale
+    (slot released, state WAITING) — exactly what the engine's
+    stale-entry filter checks — and its blocks are returned, never
+    written through."""
+    import time as _time
+
+    from paddle_tpu.serving import PagedKVCache
+    from paddle_tpu.serving.scheduler import Request, Scheduler
+
+    cache = PagedKVCache(num_layers=1, num_blocks=2, block_size=4,
+                         num_kv_heads=1, head_dim=4)
+    sch = Scheduler(cache, max_batch=2, prefill_chunk=4, step_tokens=5)
+    old = Request(prompt_tokens=[1] * 4)     # senior, needs 1 block
+    sch.add(old)
+    _time.sleep(0.001)
+    young = Request(prompt_tokens=[2] * 4)   # junior: running on 1 block
+    sch.add(young)
+    sch._admit()
+    young.block_ids = cache.allocator.allocate(1)
+    young.prefill_pos = young.num_cached = 3  # 4th token fits block 1
+    young.state = RequestState.RUNNING
+    young.generated = [5]
+    cache.allocator.allocate(1)               # drain the rest of the pool
+    plan = sch.schedule()
+    # young decodes within its block -> planned; old's 4-token chunk
+    # then needs a block -> evicts young (the only junior victim)
+    assert young in plan.decode
+    assert sch.num_preemptions == 1
+    assert young.slot is None and young.state is RequestState.WAITING
+    assert young.block_ids == []              # returned, not dangling
+    # the engine-side stale filter must drop it
+    live = [s for s in plan.decode
+            if s.slot is not None and s.state is RequestState.RUNNING]
+    assert live == []
+    # and the senior prefill got real blocks for its planned chunk
+    assert plan.prefills and plan.prefills[0][0] is old
+    seq, n = plan.prefills[0]
     assert cache.blocks_for(seq.prefill_pos + n) <= len(seq.block_ids)
-    assert a not in plan.decode and a.block_ids  # skipped, not evicted
 
 
 # ---------------- engine: tier-1 smoke ---------------------------------------
@@ -166,7 +291,7 @@ def test_engine_single_request_matches_eager(served):
     assert res["finish_reason"] == "length"
     assert res["ttft_s"] > 0 and res["latency_s"] >= res["ttft_s"]
     assert eng.cache.allocator.blocks_in_use() == 0
-    assert eng.decode_traces == 1 and eng.prefill_traces == 1
+    assert eng.step_traces == 1  # ONE unified executable, traced once
 
 
 def test_engine_streaming_and_eos(served):
@@ -203,7 +328,7 @@ def test_short_request_joins_mid_decode(served):
     assert h_long.result(30)["token_ids"] == \
         _eager_continuation(model, long_p, 16)
     assert h_short._req.finish_time < h_long._req.finish_time
-    assert eng.decode_traces == 1  # the newcomer reused the executable
+    assert eng.step_traces == 1  # the newcomer reused the executable
 
 
 @pytest.mark.slow
@@ -224,7 +349,7 @@ def test_preemption_recompute_no_leak():
             _eager_continuation(model, p, 8)
     assert eng.scheduler.num_preemptions >= 1
     eng.cache.allocator.assert_no_leaks()
-    assert eng.decode_traces == 1 and eng.prefill_traces == 1
+    assert eng.step_traces == 1
 
 
 def test_submit_validation(served):
@@ -277,7 +402,11 @@ def test_http_generate_roundtrip(served):
 
         hz = json.loads(urllib.request.urlopen(
             srv.url + "/healthz", timeout=10).read())
-        assert hz["status"] == "ok" and hz["decode_compiles"] == 1
+        assert hz["status"] == "ok" and hz["step_compiles"] == 1
+        # KV-pool pressure is visible to operators before preemption
+        # starts churning (ISSUE 8 satellite)
+        assert 0.0 <= hz["kv_headroom"] <= 1.0
+        assert hz["attn_impl"] in ("rpa", "gather")
 
         # streaming: one NDJSON line per token, then the summary
         req = urllib.request.Request(
@@ -409,7 +538,7 @@ def test_serving_acceptance_concurrent_mixed():
     for hd, p, mn in zip(handles, prompts, mnts):
         assert hd.result(30)["token_ids"] == \
             _eager_continuation(model, p, mn)
-    assert eng.decode_traces == 1 and eng.prefill_traces == 1
+    assert eng.step_traces == 1
     eng.cache.allocator.assert_no_leaks()
     eng.shutdown()
 
