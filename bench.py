@@ -941,7 +941,15 @@ REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        "train_step_exposed_collective_seconds",
                        # serving tail latency under the RPA kernel
                        # (ISSUE 8): bench.py --serve p99 TTFT
-                       "serving_p99_ttft_seconds"}
+                       "serving_p99_ttft_seconds",
+                       # static program-audit headlines (ISSUE 9,
+                       # bench.py --audit / paddle_tpu.analysis): dp
+                       # collective census, bytes the step keeps
+                       # double-buffered (undonated), and the largest
+                       # intermediate (the fused-CE before/after metric)
+                       "train_step_allreduce_count",
+                       "train_step_undonated_bytes",
+                       "train_step_largest_intermediate_bytes"}
 #: absolute ceilings: current must stay under max(baseline, bound) —
 #: step-time spread is a stability gate, not a race
 REPORT_BOUNDED = {"spread_pct_of_mean": 1.5}
@@ -1272,6 +1280,58 @@ def bench_attribution():
     return out
 
 
+def bench_audit():
+    """Static program audit (--audit): compiled-HLO invariants on the
+    committed geometry, as report-gate headlines (docs/ANALYSIS.md).
+
+    Three LOWER_BETTER numbers: ``train_step_allreduce_count`` (the
+    dp collective census — buckets+1 when the bucketed path holds, a
+    storm when it regresses), ``train_step_undonated_bytes`` (buffers
+    the step keeps two copies of), and
+    ``train_step_largest_intermediate_bytes`` (the giant-intermediate
+    watermark; the ROADMAP fused-CE item must move it). Off-TPU the
+    metrics ride the ``_cpu_smoke`` suffix like every other bench mode.
+    Nothing executes — programs are lowered and compiled only, so this
+    runs in seconds even on the full chip geometry."""
+    # the dp census needs a multi-device mesh: arm the 8-virtual-device
+    # CPU platform BEFORE the backend initializes (no-op on TPU)
+    from paddle_tpu.analysis.driver import ensure_cpu_mesh, \
+        run_default_audit
+    ensure_cpu_mesh()
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+
+    if on_tpu:
+        # the committed bench geometry (bench_full_model's shape), bf16
+        # with f32 masters — the donation/upcast/intermediate subject
+        from paddle_tpu.models.llama import LlamaConfig
+        cfg = LlamaConfig(
+            vocab_size=128256, hidden_size=2048, intermediate_size=7168,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=4096,
+            tie_word_embeddings=True)
+        result = run_default_audit(include_serving=False, bf16=True,
+                                   batch=(4, 2048), llama_cfg=cfg)
+    else:
+        result = run_default_audit(include_serving=True)
+
+    findings = result.pop("findings", [])
+    result["findings"] = [f.to_json() for f in findings]
+    for rep in result["reports"]:
+        print(f"  {rep['label']:<14} all_reduce={rep['all_reduce_count']} "
+              f"donation_coverage={rep['donation_coverage']} "
+              f"undonated={rep['undonated_bytes']}B "
+              f"largest={rep['largest_intermediate_bytes']}B "
+              f"upcasts={rep['upcast_count']}", file=sys.stderr)
+    suffix = "" if on_tpu else "_cpu_smoke"
+    for name in ("train_step_allreduce_count",
+                 "train_step_undonated_bytes",
+                 "train_step_largest_intermediate_bytes"):
+        print(json.dumps({"metric": f"{name}{suffix}",
+                          "value": result.get(name)}))
+    return result
+
+
 def main():
     if "--chaos-worker" in sys.argv:
         _chaos_worker()
@@ -1310,6 +1370,13 @@ def main():
         print(json.dumps({"attribution": attribution}))
         if metrics_out:
             emit_metrics({"attribution": attribution}, metrics_out)
+        return
+
+    if "--audit" in sys.argv:
+        audit = bench_audit()
+        print(json.dumps({"audit": audit}))
+        if metrics_out:
+            emit_metrics({"audit": audit}, metrics_out)
         return
 
     if "--serve" in sys.argv:

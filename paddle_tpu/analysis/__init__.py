@@ -1,0 +1,34 @@
+"""Program auditor + trace-safety linter (docs/ANALYSIS.md).
+
+Compiler-style static analysis over the two artifacts this repo
+actually ships: the **compiled HLO** of its hot-path programs
+(``TrainStep`` / ``ServingEngine`` through their ``compiled_hlo()``
+seams) and the **framework Python** itself. Treat the lowered program
+as an analyzable artifact, not a black box (MPK / TPU-MLIR,
+PAPERS.md) — every invariant here was previously checked by eyeballing
+HLO dumps or paid for at runtime.
+
+CLI::
+
+    python -m paddle_tpu.analysis audit   # compiled-program audit
+    python -m paddle_tpu.analysis lint    # AST trace-safety lint
+    python -m paddle_tpu.analysis knobs   # env-knob registry + drift
+
+Findings gate against the committed ``analysis/baseline.json``
+(fingerprint ledger — new findings fail, known debt is tracked);
+``bench.py --audit`` exposes the headline numbers to the perf
+regression gate.
+"""
+from .audit import (ProgramAudit, audit_program, audit_serving_engine,
+                    audit_train_step, diff_compile_keys, recompile_report)
+from .findings import Baseline, Finding, baseline_path, load_baseline
+from .knobs import collect_code_knobs, collect_doc_knobs, drift
+from .lint import lint_file, lint_tree
+
+__all__ = [
+    "ProgramAudit", "audit_program", "audit_train_step",
+    "audit_serving_engine", "diff_compile_keys", "recompile_report",
+    "Baseline", "Finding", "baseline_path", "load_baseline",
+    "collect_code_knobs", "collect_doc_knobs", "drift",
+    "lint_file", "lint_tree",
+]

@@ -1,0 +1,135 @@
+"""CLI: ``python -m paddle_tpu.analysis {audit,lint,knobs}``.
+
+Exit codes: 0 clean, 1 new findings / drift, 2 usage error. The gate
+semantics (new-vs-baseline) match the tier-1 tests, so a green local
+run means a green CI lint job.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .findings import load_baseline, repo_root as _repo_root
+
+
+def _pkg_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_path():
+    p = os.path.join(_repo_root(), "bench.py")
+    return (p,) if os.path.exists(p) else ()
+
+
+def _gate(findings, args, kind: str, extra: dict = None) -> int:
+    """Shared baseline gate: print new/known/stale, optionally accept
+    the new findings into the baseline file."""
+    base = load_baseline(args.baseline)
+    new, known, stale = base.split(findings)
+    if args.json:
+        doc = dict(extra or {})
+        doc[kind] = {"new": [f.to_json() for f in new],
+                     "known": len(known), "stale": sorted(stale)}
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in new:
+            print("NEW  " + f.format())
+        if known and not args.quiet:
+            print(f"{len(known)} known finding(s) accepted by baseline "
+                  f"{base.path}", file=sys.stderr)
+        for fp in sorted(stale):
+            meta = stale[fp]
+            print(f"stale baseline entry {fp} "
+                  f"({meta.get('rule')} @ {meta.get('path')}) — fixed? "
+                  f"prune it", file=sys.stderr)
+    if args.update_baseline and new:
+        base.accept(new, note="accepted via --update-baseline")
+        base.save()
+        print(f"accepted {len(new)} finding(s) into {base.path}",
+              file=sys.stderr)
+        return 0
+    return 1 if new else 0
+
+
+def cmd_lint(args) -> int:
+    from .lint import lint_tree
+    findings = lint_tree(args.root, extra_files=_bench_path())
+    findings.sort(key=lambda f: (f.severity, f.path, f.line))
+    return _gate(findings, args, "lint")
+
+
+def cmd_audit(args) -> int:
+    from .driver import ensure_cpu_mesh, run_default_audit
+    ensure_cpu_mesh()
+    result = run_default_audit(include_serving=not args.no_serving)
+    findings = result.pop("findings")
+    if not args.json:
+        for rep in result["reports"]:
+            print(f"-- {rep['label']}: all_reduce={rep['all_reduce_count']} "
+                  f"donated={rep['donated_bytes']}B "
+                  f"undonated={rep['undonated_bytes']}B "
+                  f"coverage={rep['donation_coverage']} "
+                  f"upcasts={rep['upcast_count']} "
+                  f"largest={rep['largest_intermediate_bytes']}B",
+                  file=sys.stderr)
+    return _gate(findings, args, "audit", extra=result)
+
+
+def cmd_knobs(args) -> int:
+    from .knobs import drift
+    d = drift(extra_files=_bench_path())
+    if args.json:
+        print(json.dumps(d, indent=1))
+    else:
+        for name, sites in d["code"].items():
+            # drift() owns coverage semantics (incl. prefix families);
+            # the table must agree with the exit code
+            mark = "UNDOCUMENTED" if name in d["undocumented"] else "ok "
+            site = f"{sites[0][0]}:{sites[0][1]}"
+            print(f"{mark:>13}  {name:<38} {site} "
+                  f"(+{len(sites) - 1} more)" if len(sites) > 1 else
+                  f"{mark:>13}  {name:<38} {site}")
+        for name in d["ghosts"]:
+            print(f"        GHOST  {name:<38} documented in "
+                  f"{', '.join(d['docs'][name])} but never read")
+    bad = d["undocumented"] or d["ghosts"]
+    if bad and not args.json:
+        print(f"drift: undocumented={d['undocumented']} "
+              f"ghosts={d['ghosts']}", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    lint = sub.add_parser("lint", help="AST trace-safety lint")
+    lint.add_argument("--root", default=None,
+                      help="tree to lint (default: the installed package)")
+    audit = sub.add_parser("audit",
+                           help="compiled-program audit (committed "
+                                "geometry)")
+    audit.add_argument("--no-serving", action="store_true",
+                       help="skip the serving-engine program")
+    knobs = sub.add_parser("knobs", help="env-knob registry + doc drift")
+    for sp in (lint, audit):
+        sp.add_argument("--baseline", default=None,
+                        help="baseline.json path (default: committed, or "
+                             "$PADDLE_TPU_ANALYSIS_BASELINE)")
+        sp.add_argument("--update-baseline", action="store_true",
+                        help="accept the new findings into the baseline")
+        sp.add_argument("--quiet", action="store_true")
+        sp.add_argument("--json", action="store_true")
+    knobs.add_argument("--json", action="store_true")
+
+    args = p.parse_args(argv)
+    return {"lint": cmd_lint, "audit": cmd_audit,
+            "knobs": cmd_knobs}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Committed audit geometries — ONE definition for CLI, bench and tests.
+
+The audit's regression value comes from pinning numbers on a *fixed*
+program; these builders are that fixture. Three programs cover the
+contracts:
+
+- :func:`dp8_bucketed_step`: the bucketed-dp ``TrainStep`` whose HLO
+  must carry exactly ``buckets + 1`` all-reduces (needs an 8-device
+  mesh — virtual on CPU, real on chip).
+- :func:`tiny_llama_step`: a single-device causal-LM train step — the
+  donation-coverage and giant-intermediate ([B, seq, vocab] logits)
+  subject.
+- :func:`tiny_serving_engine`: the unified serving step behind
+  ``ServingEngine.compiled_hlo()``.
+
+Everything is sized for the 1-CPU smoke box (a few seconds per
+compile); ``bench.py --audit`` swaps in the committed bench geometry on
+a real TPU.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+__all__ = ["ensure_cpu_mesh", "dp8_bucketed_step", "tiny_llama_step",
+           "tiny_serving_engine", "run_default_audit"]
+
+
+def ensure_cpu_mesh(devices: int = 8) -> bool:
+    """Arm an N-virtual-device CPU platform when no TPU is plausibly
+    present (same discipline as tests/conftest.py / BENCH_FORCE_CPU:
+    the env must be set before the jax backend initializes). Returns
+    whether the CPU override was applied."""
+    env = os.environ
+    from paddle_tpu.device import _tpu_plausible
+    if _tpu_plausible(env):
+        return False
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return True
+
+
+def dp8_bucketed_step(dp: Optional[int] = None):
+    """(step, (x, y)) — pure-dp ``DataParallel`` MLP with the bucketed
+    collective path active (the PR 7 HLO-contract geometry)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import nn
+
+    if dp is None:
+        import jax
+        dp = jax.device_count()
+    mesh = dist.init_mesh({"dp": dp})
+    pt.seed(3)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    m = dist.DataParallel(net, mesh=mesh)
+    o = pt.optimizer.AdamW(learning_rate=0.01, parameters=m.parameters())
+
+    def loss_fn(model, x, y):
+        return ((model(x) - y) ** 2).mean()
+
+    step = pt.jit.TrainStep(m, loss_fn, o)
+    rng = np.random.RandomState(0)
+    X = rng.randn(8 * dp, 16).astype(np.float32)
+    Y = X @ rng.randn(16, 4).astype(np.float32)
+    return step, (pt.to_tensor(X), pt.to_tensor(Y))
+
+
+def _tiny_llama(bf16: bool = False, cfg=None):
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if cfg is None:
+        cfg = LlamaConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=448,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=512,
+            tie_word_embeddings=True)
+    pt.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if bf16:
+        model.bfloat16()
+    return model, cfg
+
+
+def tiny_llama_step(bf16: bool = False, donate: bool = True,
+                    batch: Tuple[int, int] = (2, 64), cfg=None):
+    """(step, (tokens,)) — single-device causal-LM ``TrainStep``, by
+    default on the CPU-smoke geometry (the donation /
+    giant-intermediate subject); ``bench.py --audit`` passes the
+    committed bench config on chip."""
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    model, cfg = _tiny_llama(bf16, cfg)
+    opt = pt.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(),
+        multi_precision=bf16,
+        grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
+    step = pt.jit.TrainStep(model, lambda m, t: m(t, labels=t)[1], opt,
+                            donate=donate)
+    B, S = batch
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randint(0, cfg.vocab_size, (B, S))
+                     .astype(np.int64))
+    return step, (x,)
+
+
+def tiny_serving_engine(attn_impl: Optional[str] = None):
+    """A small real ``ServingEngine`` (gather path off-TPU) for the
+    serving-step audit."""
+    from paddle_tpu.serving import ServingEngine
+
+    model, _ = _tiny_llama()
+    return ServingEngine(model, max_batch=2, max_blocks=16, block_size=4,
+                         prefill_chunk=8, attn_impl=attn_impl)
+
+
+def run_default_audit(include_serving: bool = True,
+                      dp: Optional[int] = None, bf16: bool = False,
+                      batch: Tuple[int, int] = (2, 64),
+                      llama_cfg=None) -> dict:
+    """The full committed-geometry audit: every report's summary plus
+    the three headline numbers ``bench.py --audit`` emits. ``dp`` None
+    = all local devices (dp census skipped when fewer than 2); the
+    llama kwargs let the bench swap in the committed chip geometry."""
+    import jax
+
+    from .audit import audit_serving_engine, audit_train_step
+
+    out = {"reports": [], "findings": []}
+    n_dev = jax.device_count()
+    if dp is None:
+        dp = n_dev if n_dev >= 2 else 0
+
+    if dp >= 2:
+        step, dp_batch = dp8_bucketed_step(dp)
+        rep = audit_train_step(step, *dp_batch,
+                               label=f"train_step[dp{dp}]")
+        assert step._comm_buckets is not None, (
+            "bucketed path ineligible on the committed geometry: "
+            f"{step._bucketed_reason}")
+        out["reports"].append(rep.summary())
+        out["findings"].extend(rep.findings)
+        out["train_step_allreduce_count"] = rep.all_reduce_count
+        out["expected_allreduce_count"] = len(step._comm_buckets) + 1
+    else:
+        out["train_step_allreduce_count"] = None
+
+    step, batch = tiny_llama_step(bf16=bf16, batch=batch, cfg=llama_cfg)
+    rep = audit_train_step(step, *batch)
+    out["reports"].append(rep.summary())
+    out["findings"].extend(rep.findings)
+    out["train_step_undonated_bytes"] = rep.undonated_bytes
+    out["train_step_donation_coverage"] = round(rep.donation_coverage, 4)
+    out["train_step_largest_intermediate_bytes"] = \
+        rep.largest_intermediate_bytes
+
+    if include_serving:
+        engine = tiny_serving_engine()
+        rep = audit_serving_engine(engine)
+        out["reports"].append(rep.summary())
+        out["findings"].extend(rep.findings)
+    return out

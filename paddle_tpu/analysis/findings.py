@@ -1,0 +1,161 @@
+"""Findings, severities, fingerprints and the committed baseline.
+
+Every analysis pass (HLO audit or AST lint) reports :class:`Finding`
+records. A finding's **fingerprint** is a stable hash of *what* it is —
+rule id, file, enclosing definition and a rule-specific anchor — and
+deliberately excludes line numbers, so unrelated edits above a known
+finding don't churn the baseline.
+
+The committed ``paddle_tpu/analysis/baseline.json`` is the accepted-debt
+ledger: a finding whose fingerprint is listed there is *known* (tracked,
+with a note saying why it's allowed or what the TODO is); any finding
+NOT in the baseline is **new** and fails CI. This is the same workflow
+as a lint-suppress file, but content-addressed — moving code around
+doesn't silently re-admit a fixed bug class.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["Finding", "Baseline", "baseline_path", "load_baseline",
+           "SEVERITIES", "P0", "P1", "P2", "repo_root", "iter_py_files"]
+
+
+def repo_root() -> str:
+    """The checkout root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def iter_py_files(root: str) -> List[str]:
+    """Deterministic ``.py`` walk shared by the lint and knob passes —
+    ONE place decides what gets scanned (sorted, ``__pycache__``
+    skipped), so the two registries can't silently diverge."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+        out.extend(os.path.join(dirpath, f) for f in sorted(filenames)
+                   if f.endswith(".py"))
+    return out
+
+#: severity model (docs/ANALYSIS.md): P0 = a paid-for bug class
+#: (deadlock, trace leak, silent wrong numbers, memory doubling);
+#: P1 = performance/memory smell worth a look; P2 = hygiene.
+P0, P1, P2 = "P0", "P1", "P2"
+SEVERITIES = (P0, P1, P2)
+
+#: default committed baseline, next to this module; override with
+#: PADDLE_TPU_ANALYSIS_BASELINE or an explicit --baseline path.
+_DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def baseline_path(explicit: Optional[str] = None) -> str:
+    if explicit:
+        return explicit
+    return os.environ.get("PADDLE_TPU_ANALYSIS_BASELINE", _DEFAULT_BASELINE)
+
+
+@dataclass
+class Finding:
+    """One analysis result.
+
+    ``anchor`` is the rule-specific identity fragment (an attribute
+    name, a parameter path, a shape) that — together with rule, path and
+    ``where`` (the enclosing class/function or program label) — makes
+    the fingerprint stable across line-number drift.
+    """
+    rule: str
+    severity: str
+    path: str          # repo-relative file, or a program label for audits
+    where: str         # qualname of the enclosing def / program section
+    message: str
+    anchor: str = ""
+    line: int = 0      # 1-based source line (0 for HLO-level findings)
+    data: dict = field(default_factory=dict)
+
+    @property
+    def fingerprint(self) -> str:
+        key = "\x1f".join((self.rule, self.path, self.where, self.anchor))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return (f"[{self.severity}] {self.rule} {loc} ({self.where}) "
+                f"{self.message}  fp={self.fingerprint}")
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "where": self.where, "line": self.line,
+                "message": self.message, "anchor": self.anchor,
+                "fingerprint": self.fingerprint, **(
+                    {"data": self.data} if self.data else {})}
+
+
+class Baseline:
+    """The accepted-findings ledger (``baseline.json``).
+
+    Layout::
+
+        {"version": 1,
+         "findings": {"<fingerprint>": {"rule": ..., "path": ...,
+                                        "note": "why this is accepted"}},
+         "audit": {"<metric>": <pinned number>, ...}}
+
+    ``findings`` gates both prongs; ``audit`` additionally pins headline
+    numbers for the committed bench geometry (consumed by the regression
+    tests, informational for the CLI).
+    """
+
+    def __init__(self, doc: Optional[dict] = None, path: Optional[str] = None):
+        doc = doc or {}
+        self.path = path
+        self.findings: Dict[str, dict] = dict(doc.get("findings", {}))
+        self.audit: Dict[str, float] = dict(doc.get("audit", {}))
+
+    # -- gating ------------------------------------------------------------
+    def split(self, findings: List[Finding]):
+        """(new, known, stale): findings not in the ledger, findings in
+        it, and ledger entries no fresh finding matched (fixed debt that
+        can be pruned)."""
+        seen = set()
+        new, known = [], []
+        for f in findings:
+            fp = f.fingerprint
+            seen.add(fp)
+            (known if fp in self.findings else new).append(f)
+        stale = {fp: meta for fp, meta in self.findings.items()
+                 if fp not in seen}
+        return new, known, stale
+
+    # -- mutation ----------------------------------------------------------
+    def accept(self, findings: List[Finding], note: str = ""):
+        for f in findings:
+            self.findings[f.fingerprint] = {
+                "rule": f.rule, "severity": f.severity, "path": f.path,
+                "where": f.where, "note": note or f.message}
+
+    def to_json(self) -> dict:
+        return {"version": 1, "findings": self.findings, "audit": self.audit}
+
+    def save(self, path: Optional[str] = None):
+        path = path or self.path
+        if not path:
+            raise ValueError("no baseline path to save to")
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+            f.write("\n")
+
+
+def load_baseline(path: Optional[str] = None) -> Baseline:
+    """Load the committed baseline (missing file = empty ledger, so a
+    fresh checkout without one simply reports everything as new)."""
+    p = baseline_path(path)
+    try:
+        with open(p) as f:
+            return Baseline(json.load(f), path=p)
+    except FileNotFoundError:
+        return Baseline({}, path=p)

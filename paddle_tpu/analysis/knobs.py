@@ -1,0 +1,128 @@
+"""Static env-knob registry: every ``PADDLE_TPU_*`` knob, from the AST.
+
+The repo grew ~22 scattered ``PADDLE_TPU_*`` environment knobs across
+seven subsystems; nothing guaranteed a knob stayed documented after a
+refactor, or that docs didn't advertise a knob whose read site was
+deleted. This module collects knobs *statically* — string literals in
+non-docstring positions, i.e. actual ``os.environ`` reads, default
+tables, and ``startswith`` prefix scans — so the registry needs no
+imports and can't miss a knob behind an import guard.
+
+A name ending in ``_`` (``PADDLE_TPU_CHAOS_``) is a *prefix family*:
+the code scans for it with ``startswith`` and docs document it as
+``PADDLE_TPU_CHAOS_*``.
+
+``drift()`` is the tier-1 contract (modeled on the metrics
+``TestDocsMetricDrift``): every knob read in code must appear in
+``docs/*.md``/``README.md``, and every documented knob must still have
+a read site.
+"""
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .findings import iter_py_files, repo_root as _repo_root
+
+__all__ = ["collect_code_knobs", "collect_doc_knobs", "drift",
+           "KNOB_RE"]
+
+KNOB_RE = re.compile(r"PADDLE_TPU_[A-Z0-9_]+")
+
+
+def _docstring_ids(tree) -> set:
+    """ids of Constant nodes that are docstrings (skipped: a knob only
+    *mentioned* in prose is not a read site)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def collect_code_knobs(package_root: Optional[str] = None,
+                       extra_files: Tuple[str, ...] = ()
+                       ) -> Dict[str, List[Tuple[str, int]]]:
+    """knob name -> [(repo-relative file, line)] read/reference sites.
+
+    A literal counts when the *whole* string is one knob name (an env
+    read, a dict key, a ``startswith`` prefix) — names embedded in
+    messages or docstrings don't create registry entries."""
+    if package_root is None:
+        package_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+    base = _repo_root()
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    targets = iter_py_files(package_root) + list(extra_files)
+    for path in targets:
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        doc_ids = _docstring_ids(tree)
+        rel = os.path.relpath(path, base)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and id(node) not in doc_ids \
+                    and KNOB_RE.fullmatch(node.value):
+                out.setdefault(node.value, []).append((rel, node.lineno))
+    return out
+
+
+def collect_doc_knobs(docs_root: Optional[str] = None
+                      ) -> Dict[str, List[str]]:
+    """knob name -> [doc files mentioning it] over docs/*.md + README.md
+    (a ``PADDLE_TPU_CHAOS_*`` wildcard documents the prefix family)."""
+    base = _repo_root() if docs_root is None else docs_root
+    paths = sorted(glob.glob(os.path.join(base, "docs", "*.md")))
+    readme = os.path.join(base, "README.md")
+    if os.path.exists(readme):
+        paths.append(readme)
+    out: Dict[str, List[str]] = {}
+    for path in paths:
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, base)
+        for name in set(KNOB_RE.findall(text)):
+            out.setdefault(name, []).append(rel)
+    return out
+
+
+def drift(package_root: Optional[str] = None,
+          extra_files: Tuple[str, ...] = (),
+          docs_root: Optional[str] = None) -> dict:
+    """Both drift directions. ``undocumented``: knobs read in code with
+    no doc mention; ``ghosts``: documented knobs with no read site left.
+    A documented member of a prefix family (``PADDLE_TPU_CHAOS_FOO``)
+    is covered by the family's code-side prefix scan and vice versa."""
+    code = collect_code_knobs(package_root, extra_files)
+    docs = collect_doc_knobs(docs_root)
+
+    def covered(name, other):
+        if name in other:
+            return True
+        # a member is covered by the other side's prefix family...
+        if any(name.startswith(p) for p in other if p.endswith("_")):
+            return True
+        # ...and a prefix family by any member on the other side
+        return name.endswith("_") and any(o.startswith(name)
+                                          for o in other)
+
+    undocumented = sorted(k for k in code if not covered(k, docs))
+    ghosts = sorted(k for k in docs if not covered(k, code))
+    return {"code": {k: v for k, v in sorted(code.items())},
+            "docs": {k: v for k, v in sorted(docs.items())},
+            "undocumented": undocumented, "ghosts": ghosts}

@@ -286,8 +286,9 @@ class ServingEngine:
 
         def step(stt, tokens, k_pools, v_pools, bt, cu, ctx, sid, pos,
                  ssq, sbk, last_idx):
-            # executes at trace time only — counts compiles
-            self.step_traces += 1
+            # executes at trace time only — counting compiles is the
+            # point (the compile-once guard tests read it)
+            self.step_traces += 1  # analysis: allow(trace-attr-mutation)
             caches = [pa.RaggedLayerCache(
                 Tensor(k_pools[i]), Tensor(v_pools[i]), Tensor(bt),
                 Tensor(cu), Tensor(ctx), Tensor(sid), Tensor(pos),
@@ -309,6 +310,46 @@ class ServingEngine:
         # CPU backend can't honor donation (harmless warning), so gate it
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
         return jax.jit(step, donate_argnums=donate)
+
+    def compiled_hlo(self) -> str:
+        """Compiled-HLO text of the ONE unified step (the inspection seam
+        ``paddle_tpu.analysis`` audits — mirrors ``TrainStep.compiled_hlo``).
+
+        State-neutral where it matters (the PR 7 rng-stream lesson):
+        the step never executes, so pools, scheduler and rng are
+        untouched, and MoE gate side effects from the trace (``l_aux``
+        tracers) are cleared. The ``step_traces`` counter is NOT
+        masked: ``lower()`` shares the jit trace/executable cache with
+        real calls, so an inspection-first engine reads 1 after its
+        first real step exactly like an uninspected one (verified by
+        the state-neutrality test) — the compile-once accounting stays
+        truthful rather than under-reporting a compile that happened."""
+        return self._lowered_step().compile().as_text()
+
+    def _lowered_step(self):
+        """The unified step's ``jax.stages.Lowered`` on a zero-work
+        layout (the ``compiled_hlo`` internals; the program auditor
+        also reads ``.args_info`` from it for per-leaf donation
+        accounting). Same neutrality contract as ``compiled_hlo``."""
+        T, S = self.step_tokens, self.max_batch
+        tokens = np.zeros((1, T), np.int32)
+        bt = np.zeros((S + 1, self.cache.max_blocks_per_seq), np.int32)
+        cu = np.zeros((S + 2,), np.int32)
+        ctx = np.zeros((S + 1,), np.int32)
+        sid = np.full((T,), S, np.int32)
+        pos = np.zeros((T,), np.int32)
+        last_idx = np.zeros((S,), np.int32)
+        ssq, sbk = self._null_step_maps
+        with self._lock:
+            try:
+                return self._step.lower(
+                    self._st, jnp.asarray(tokens), self.cache.k_pools,
+                    self.cache.v_pools, jnp.asarray(bt), jnp.asarray(cu),
+                    jnp.asarray(ctx), jnp.asarray(sid), jnp.asarray(pos),
+                    jnp.asarray(ssq), jnp.asarray(sbk),
+                    jnp.asarray(last_idx))
+            finally:
+                self._clear_model_side_effects()
 
     # -- metrics -----------------------------------------------------------
     def _init_metrics(self):

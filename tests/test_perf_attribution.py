@@ -255,7 +255,12 @@ _FAMILY_PREFIXES = ("comm_", "train_", "serving_", "ckpt_",
 _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           "comm_totals", "data_time_s",
                           "serving_p99_ttft_seconds",
-                          "serving_decode_tokens_per_sec"}
+                          "serving_decode_tokens_per_sec",
+                          # bench.py --audit report-gate headlines
+                          # (docs/ANALYSIS.md), not registry families
+                          "train_step_allreduce_count",
+                          "train_step_undonated_bytes",
+                          "train_step_largest_intermediate_bytes"}
 
 
 def _documented_families():
