@@ -949,7 +949,11 @@ REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        # intermediate (the fused-CE before/after metric)
                        "train_step_allreduce_count",
                        "train_step_undonated_bytes",
-                       "train_step_largest_intermediate_bytes"}
+                       "train_step_largest_intermediate_bytes",
+                       # runtime-truth peak HBM of the compiled train
+                       # step (ISSUE 11, observability.memory): XLA
+                       # buffer-assignment total for the audited step
+                       "train_step_peak_hbm_bytes"}
 #: absolute ceilings: current must stay under max(baseline, bound) —
 #: step-time spread is a stability gate, not a race
 REPORT_BOUNDED = {"spread_pct_of_mean": 1.5}
@@ -1326,10 +1330,46 @@ def bench_audit():
     suffix = "" if on_tpu else "_cpu_smoke"
     for name in ("train_step_allreduce_count",
                  "train_step_undonated_bytes",
-                 "train_step_largest_intermediate_bytes"):
+                 "train_step_largest_intermediate_bytes",
+                 "train_step_peak_hbm_bytes"):
         print(json.dumps({"metric": f"{name}{suffix}",
                           "value": result.get(name)}))
     return result
+
+
+def bench_profile():
+    """On-demand device profiler smoke (--profile): compile the tiny
+    llama step, open a bounded ``observability.profile`` capture around
+    a few steps, and report how many trace files landed under
+    ``PADDLE_TPU_TRACE_DIR`` (docs/OBSERVABILITY.md#device-profiler).
+    Arming the profiler must not retrace — the step's executable cache
+    is asserted unchanged across the captured window."""
+    from paddle_tpu.analysis.driver import ensure_cpu_mesh, \
+        tiny_llama_step
+    ensure_cpu_mesh()
+    import jax
+
+    from paddle_tpu.observability import profile
+    on_tpu = jax.default_backend() == "tpu"
+
+    step, batch = tiny_llama_step()
+    jax.block_until_ready(step(*batch))  # compile outside the window
+    traces0 = len(step._cache)
+    out_dir = profile.start_capture(label="bench")
+    try:
+        for _ in range(3):
+            jax.block_until_ready(step(*batch))
+    finally:
+        profile.stop_capture()
+    assert len(step._cache) == traces0, \
+        "profiler capture must not retrace the train step"
+    n_files = sum(len(files) for _, _, files in os.walk(out_dir))
+    print(f"  profile capture -> {out_dir} ({n_files} files)",
+          file=sys.stderr)
+    suffix = "" if on_tpu else "_cpu_smoke"
+    print(json.dumps({"metric": f"profile_trace_files{suffix}",
+                      "value": n_files}))
+    return {"trace_dir": out_dir, "trace_files": n_files}
 
 
 def main():
@@ -1377,6 +1417,13 @@ def main():
         print(json.dumps({"audit": audit}))
         if metrics_out:
             emit_metrics({"audit": audit}, metrics_out)
+        return
+
+    if "--profile" in sys.argv:
+        prof = bench_profile()
+        print(json.dumps({"profile": prof}))
+        if metrics_out:
+            emit_metrics({"profile": prof}, metrics_out)
         return
 
     if "--serve" in sys.argv:

@@ -164,6 +164,12 @@ def run_default_audit(include_serving: bool = True,
     out["train_step_donation_coverage"] = round(rep.donation_coverage, 4)
     out["train_step_largest_intermediate_bytes"] = \
         rep.largest_intermediate_bytes
+    # runtime-truth counterpart from XLA's buffer assignment
+    # (observability.memory.MemoryReport; rides the same cached
+    # executable, so no extra trace)
+    mr = step.memory_report(*batch)
+    out["train_step_peak_hbm_bytes"] = \
+        None if mr is None else mr.total_bytes
 
     if include_serving:
         engine = tiny_serving_engine()

@@ -19,8 +19,8 @@ __all__ = ["set_device", "get_device", "get_all_custom_device_type",
            "is_compiled_with_xpu", "is_compiled_with_npu",
            "is_compiled_with_custom_device", "device_count", "synchronize",
            "cuda", "memory_stats", "memory_allocated",
-           "max_memory_allocated", "apply_xla_tuning",
-           "applied_xla_tuning"]
+           "max_memory_allocated", "reset_max_memory_allocated",
+           "apply_xla_tuning", "applied_xla_tuning"]
 
 _state = {"device": None}
 
@@ -192,30 +192,72 @@ def synchronize(device: Optional[str] = None):
     jax.block_until_ready(jax.numpy.zeros(()))
 
 
-def memory_stats(device: Optional[str] = None) -> dict:
-    """Per-device memory statistics from the PJRT runtime (the TPU analog
-    of the reference's allocator stats, ``fluid/memory/``; keys follow
-    jax's ``device.memory_stats()``: bytes_in_use, peak_bytes_in_use,
-    bytes_limit...). Empty dict when the backend doesn't report."""
-    devs = _devices()
+def _resolve_device(device):
+    """Map the accepted device spellings to a jax Device: None (default
+    placement), an integer index, a ``"tpu:1"``/``"cpu:0"``-style string
+    (or bare platform string meaning index 0), or an actual jax Device
+    object (used as-is — callers holding ``jax.devices()`` entries must
+    not be forced to re-spell them)."""
+    if device is not None and hasattr(device, "memory_stats"):
+        return device  # already a jax Device
     idx = 0
-    if device and ":" in str(device):
+    if isinstance(device, int):
+        idx = device
+    elif device and ":" in str(device):
         idx = int(str(device).rsplit(":", 1)[1])
+    devs = _devices()
     if idx >= len(devs):  # a typo'd device must error, not read as 0
         raise IndexError(
             f"device index {idx} out of range ({len(devs)} devices)")
+    return devs[idx]
+
+
+def memory_stats(device=None) -> dict:
+    """Per-device memory statistics from the PJRT runtime (the TPU analog
+    of the reference's allocator stats, ``fluid/memory/``; keys follow
+    jax's ``device.memory_stats()``: bytes_in_use, peak_bytes_in_use,
+    bytes_limit...). Accepts a ``"tpu:1"`` string, an index, or a jax
+    Device. Empty dict when the backend doesn't report."""
+    dev = _resolve_device(device)
     try:
-        return dict(devs[idx].memory_stats() or {})
+        return dict(dev.memory_stats() or {})
     except (AttributeError, NotImplementedError, RuntimeError):
         return {}  # backend doesn't report memory stats
 
 
-def memory_allocated(device: Optional[str] = None) -> int:
+def memory_allocated(device=None) -> int:
     return int(memory_stats(device).get("bytes_in_use", 0))
 
 
-def max_memory_allocated(device: Optional[str] = None) -> int:
+def max_memory_allocated(device=None) -> int:
     return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def reset_max_memory_allocated(device=None) -> bool:
+    """Reset the runtime's peak-HBM watermark so ``max_memory_allocated``
+    reflects only allocations after this call (reference:
+    ``cuda.reset_max_memory_allocated``). PJRT backends are uneven here —
+    whichever reset entry point this runtime exposes is used; when none
+    exists (CPU, older libtpu) this warns once and returns False, and the
+    memory ledger falls back to its host-side peak tracking
+    (``MemoryLedger.reset_peak``)."""
+    import warnings
+    dev = _resolve_device(device)
+    for name in ("reset_memory_stats", "reset_peak_memory_stats",
+                 "clear_memory_stats"):
+        fn = getattr(dev, name, None)
+        if fn is None:
+            continue
+        try:
+            fn()
+            return True
+        except (NotImplementedError, RuntimeError):
+            continue
+    warnings.warn(
+        "reset_max_memory_allocated: backend exposes no peak-reset entry "
+        "point; peak_bytes_in_use is cumulative for this process",
+        RuntimeWarning, stacklevel=2)
+    return False
 
 
 def is_compiled_with_cuda() -> bool:

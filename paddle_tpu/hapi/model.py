@@ -412,11 +412,40 @@ class Model:
         chaos.refresh()
         return chaos
 
+    @staticmethod
+    def _maybe_profile_window():
+        """Env-armed device-profiler window (docs/OBSERVABILITY.md#device-
+        profiler): ``PADDLE_TPU_PROFILE_AT_STEP=<start>:<stop>`` captures
+        a jax.profiler trace over that 1-based step range. Zero cost and
+        no imports when the var is unset — normal fits never touch the
+        profiler module."""
+        import os
+        if not os.environ.get("PADDLE_TPU_PROFILE_AT_STEP"):
+            return None
+        from paddle_tpu.observability import profile
+        return profile.step_window_from_env()
+
     def _fit_loop(self, loader, eval_data, batch_size, epochs, eval_freq,
                   save_dir, save_freq, num_workers, callbacks, num_iters,
                   history, _time):
         step = 0
         chaos = self._maybe_chaos()
+        pwin = self._maybe_profile_window()
+        try:
+            return self._fit_epochs(loader, eval_data, batch_size, epochs,
+                                    eval_freq, save_dir, save_freq,
+                                    num_workers, callbacks, num_iters,
+                                    history, _time, step, chaos, pwin)
+        finally:
+            if pwin is not None:
+                # a window still open when the loop dies (crash, stop
+                # inside the range) must not leak the process-wide
+                # capture slot
+                pwin.close()
+
+    def _fit_epochs(self, loader, eval_data, batch_size, epochs, eval_freq,
+                    save_dir, save_freq, num_workers, callbacks, num_iters,
+                    history, _time, step, chaos, pwin):
         for epoch in range(epochs):
             for cb in callbacks:
                 cb.on_epoch_begin(epoch)
@@ -440,6 +469,8 @@ class Model:
                          "batch_size": int(shape[0]) if shape else None}
                 for cb in callbacks:
                     cb.on_train_batch_begin(step + 1, blogs)
+                if pwin is not None:
+                    pwin.on_step(step + 1)
                 if chaos is not None:
                     x = chaos.poison_batch(step + 1, x)
                 loss = self.train_batch(x, y)[0]

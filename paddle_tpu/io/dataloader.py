@@ -158,6 +158,21 @@ class _Prefetcher:
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
+        # account the prefetch queue's resident batches in the HBM
+        # ledger (docs/OBSERVABILITY.md#memory): device_put'd batches
+        # waiting here are real HBM the train step can't see
+        from paddle_tpu.observability import memory as _obs_memory
+
+        def _queued_bytes():
+            try:
+                with q.mutex:
+                    held = list(q.queue)
+                return sum(_obs_memory.tree_bytes(b) for b in held
+                           if b is not self._SENTINEL and
+                           not isinstance(b, _WorkerError))
+            except Exception:
+                return 0
+        _obs_memory.register("data_prefetch", _queued_bytes)
         try:
             while True:
                 item = q.get()
@@ -168,6 +183,7 @@ class _Prefetcher:
                 yield item
         finally:
             stop.set()
+            _obs_memory.unregister("data_prefetch")
 
 
 def _process_worker(dataset, collate_fn, worker_init_fn, worker_id,

@@ -141,6 +141,39 @@ class TrainStep:
                     for k, v in st.items():
                         if hasattr(v, "copy"):
                             st[k] = uniquify(v)
+        self._register_memory_owners()
+
+    def _register_memory_owners(self):
+        """Hand the HBM ledger (docs/OBSERVABILITY.md#memory) the two
+        trees this step owns for its lifetime: the parameters and the
+        optimizer accumulators (per-param dicts plus the fused flats —
+        whichever currently holds the authoritative copies). Weakref
+        closures: a registration must not keep a discarded TrainStep —
+        and its buffers — alive, and returning None after death lets
+        the ledger drop the entry itself."""
+        import weakref
+
+        from paddle_tpu.observability import memory as _obs_memory
+
+        wself = weakref.ref(self)
+
+        def _param_buffers():
+            s = wself()
+            if s is None:
+                return None
+            return [p._data for p in s._params.values()]
+
+        def _opt_state_buffers():
+            s = wself()
+            if s is None:
+                return None
+            trees = list(s._opt._state.values())
+            if s._flat_cache is not None:
+                trees.append(s._flat_cache[2])
+            return trees
+
+        _obs_memory.register("model_params", _param_buffers)
+        _obs_memory.register("optimizer_state", _opt_state_buffers)
 
     # -- pure helpers ---------------------------------------------------------
     def _clip_pure(self, grads: Dict[str, object]) -> Dict[str, object]:
@@ -594,7 +627,20 @@ class TrainStep:
         # running concurrently (bucketed async all-reduce) is overlapped,
         # one serialized after it is exposed
         with RecordEvent("TrainStep"), compute_scope():
-            loss_val, new_train, new_states, new_bufs = compiled(*call_args)
+            try:
+                loss_val, new_train, new_states, new_bufs = \
+                    compiled(*call_args)
+            except Exception as e:
+                # RESOURCE_EXHAUSTED gets one postmortem (ledger owners +
+                # this executable's memory report) before re-raising;
+                # anything else passes straight through
+                from paddle_tpu.observability import memory as _obs_memory
+                _obs_memory.handle_oom(
+                    e, source="train_step",
+                    report_fn=lambda: _obs_memory.MemoryReport.from_compiled(
+                        compiled.lower(*call_args).compile(),
+                        source="train_step"))
+                raise
 
         # write back (storage replacement — same semantics as eager step())
         opt._step_count += 1
@@ -630,6 +676,24 @@ class TrainStep:
         try:
             _, compiled, call_args = self._prepare(args, kwargs)
             return compiled.lower(*call_args).compile().as_text()
+        finally:
+            _gen.set_rng_state(rng_state)
+
+    def memory_report(self, *args, **kwargs):
+        """XLA's memory accounting of the compiled step for this batch
+        (``observability.memory.MemoryReport``; None when the backend
+        doesn't report): argument/output/temp/alias/generated-code
+        bytes — the runtime-truth counterpart to the static audit's
+        ``largest_intermediate_bytes``, cross-checked by a tier-1 test.
+        Same contract as :meth:`compiled_hlo`: RNG-neutral (the key
+        ``_prepare`` drew is handed back) and retrace-free (``lower``
+        shares the jit trace cache with real calls)."""
+        from paddle_tpu.observability.memory import MemoryReport
+        rng_state = _gen.get_rng_state()
+        try:
+            _, compiled, call_args = self._prepare(args, kwargs)
+            return MemoryReport.from_compiled(
+                compiled.lower(*call_args).compile(), source="train_step")
         finally:
             _gen.set_rng_state(rng_state)
 

@@ -1,6 +1,6 @@
 """paddle_tpu.observability — production telemetry subsystem.
 
-Six pieces (see docs/OBSERVABILITY.md):
+Eight pieces (see docs/OBSERVABILITY.md):
 
 - **metrics** — Counter/Gauge/Histogram registry with Prometheus-text and
   JSON exposition; env-gated HTTP exporter (``PADDLE_TPU_METRICS_PORT``);
@@ -22,12 +22,21 @@ Six pieces (see docs/OBSERVABILITY.md):
 - **flight_recorder** — always-on bounded ring of recent
   op/comm/step/ckpt/data events dumped as postmortem JSON on
   crash/SIGTERM/SIGUSR1 (``PADDLE_TPU_FLIGHT_RECORDER``).
+- **memory** — HBM observability: per-executable ``memory_report()``
+  accounting, the subsystem memory ledger behind the ``hbm_*`` gauges,
+  and the RESOURCE_EXHAUSTED postmortem path
+  (``PADDLE_TPU_HBM_HEADROOM_WARN``).
+- **profile** — bounded on-demand ``jax.profiler`` capture windows
+  (``PADDLE_TPU_PROFILE_AT_STEP``, ``POST /debug/profile``,
+  ``bench.py --profile``).
 
 Importing this package applies the env gates (a no-op when the vars are
 unset), so ``import paddle_tpu`` alone arms the exporter/recorder/tracer
 in production jobs.
 """
-from . import comm, flight_recorder, metrics, step_timer, trace  # noqa: F401
+from . import (  # noqa: F401
+    comm, flight_recorder, memory, metrics, profile, step_timer, trace,
+)
 from .comm import (  # noqa: F401
     comm_scope, comm_totals, compute_scope, payload_bytes,
 )
@@ -38,6 +47,7 @@ from .metrics import (  # noqa: F401
 from .step_timer import StepTimer, peak_flops  # noqa: F401
 
 __all__ = ["metrics", "step_timer", "comm", "flight_recorder", "trace",
+           "memory", "profile",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "get_registry", "start_exporter", "maybe_start_exporter",
            "StepTimer", "peak_flops", "comm_scope", "comm_totals",

@@ -1,0 +1,453 @@
+"""HBM memory observability — program accounting, ledger, OOM postmortem.
+
+The third observability layer (after PR 1 metrics and PR 6 attribution):
+where ``analysis.audit`` *statically* estimates memory from HLO text,
+this module reports what the runtime actually holds, in three pieces:
+
+- :class:`MemoryReport` — per-executable byte accounting straight from
+  XLA's ``compiled.memory_analysis()`` (argument / output / temp / alias
+  / generated-code bytes). Surfaced through the existing inspection
+  seams as ``TrainStep.memory_report()`` and
+  ``ServingEngine.memory_report()`` — the runtime-truth counterpart to
+  the static ``largest_intermediate_bytes`` watermark (a tier-1 test
+  cross-checks the two on the committed geometry).
+- :class:`MemoryLedger` — long-lived buffer owners (model params, fused
+  optimizer flats, KV-cache pools, data prefetch buffers) register
+  named trees; ``snapshot()`` decomposes the device's ``bytes_in_use``
+  into named bytes + an unattributed residual, published as the
+  ``hbm_bytes{owner=...}`` / ``hbm_bytes_in_use`` / ``hbm_peak_bytes``
+  / ``hbm_headroom`` gauges (polled per step by ``StepTimer`` and per
+  engine iteration by ``ServingEngine``). The device-stats read goes
+  through a swappable seam (:func:`set_memory_stats_fn`) so all of it
+  is testable on a CPU backend that reports nothing.
+- **OOM postmortem** — compiled calls in ``TrainStep`` /
+  ``ServingEngine`` route ``RESOURCE_EXHAUSTED`` failures through
+  :func:`handle_oom`, which dumps one postmortem JSON (ledger snapshot
+  with the top owners, the failing executable's memory report, the
+  flight-recorder tail) into ``PADDLE_TPU_TRACE_DIR`` before the error
+  re-raises. A once-per-run warning fires when headroom drops below
+  ``PADDLE_TPU_HBM_HEADROOM_WARN`` (a fraction, e.g. ``0.1``).
+
+Docs: docs/OBSERVABILITY.md#memory.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+from typing import Callable, Dict, Optional
+
+__all__ = ["MemoryReport", "MemoryLedger", "get_ledger", "memory_metrics",
+           "tree_bytes", "register", "unregister", "snapshot", "publish",
+           "set_memory_stats_fn", "is_resource_exhausted", "handle_oom",
+           "reset_peak"]
+
+#: headroom fraction below which the once-per-run near-OOM warning fires
+ENV_HEADROOM_WARN = "PADDLE_TPU_HBM_HEADROOM_WARN"
+
+#: postmortems keep only the newest ring events — the full ring is the
+#: flight recorder's own dump's job
+POSTMORTEM_EVENT_TAIL = 64
+
+
+# ---------------------------------------------------------------------------
+# compiled-program memory accounting
+# ---------------------------------------------------------------------------
+
+class MemoryReport:
+    """Byte accounting of ONE compiled executable, as XLA sees it.
+
+    Fields mirror ``CompiledMemoryStats``: ``argument_bytes`` (live
+    inputs), ``output_bytes`` (results), ``temp_bytes`` (the scratch
+    high-water the program needs between them — the runtime-truth
+    counterpart of the static ``largest_intermediate_bytes``),
+    ``alias_bytes`` (donated input bytes reused as outputs — counted in
+    both argument and output, hence subtracted from the total), and
+    ``generated_code_bytes`` (the program text itself).
+    """
+
+    FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+              "alias_bytes", "generated_code_bytes")
+
+    def __init__(self, argument_bytes: int = 0, output_bytes: int = 0,
+                 temp_bytes: int = 0, alias_bytes: int = 0,
+                 generated_code_bytes: int = 0, source: str = ""):
+        self.argument_bytes = int(argument_bytes)
+        self.output_bytes = int(output_bytes)
+        self.temp_bytes = int(temp_bytes)
+        self.alias_bytes = int(alias_bytes)
+        self.generated_code_bytes = int(generated_code_bytes)
+        self.source = source
+
+    @classmethod
+    def from_compiled(cls, compiled, source: str = "") \
+            -> Optional["MemoryReport"]:
+        """Build from a ``jax.stages.Compiled`` (or anything exposing
+        ``memory_analysis()``). None when the backend doesn't report —
+        callers must treat the instrument as optional, never required."""
+        try:
+            ma = compiled.memory_analysis()
+        except Exception:
+            return None
+        if ma is None:
+            return None
+        return cls(
+            argument_bytes=getattr(ma, "argument_size_in_bytes", 0),
+            output_bytes=getattr(ma, "output_size_in_bytes", 0),
+            temp_bytes=getattr(ma, "temp_size_in_bytes", 0),
+            alias_bytes=getattr(ma, "alias_size_in_bytes", 0),
+            generated_code_bytes=getattr(
+                ma, "generated_code_size_in_bytes", 0),
+            source=source)
+
+    @property
+    def total_bytes(self) -> int:
+        """Peak HBM the executable needs: arguments + outputs + temp +
+        code, minus the aliased (donated-and-reused) bytes counted on
+        both sides."""
+        return (self.argument_bytes + self.output_bytes + self.temp_bytes
+                + self.generated_code_bytes - self.alias_bytes)
+
+    def to_json(self) -> dict:
+        d = {f: getattr(self, f) for f in self.FIELDS}
+        d["total_bytes"] = self.total_bytes
+        if self.source:
+            d["source"] = self.source
+        return d
+
+    def __repr__(self):
+        inner = ", ".join(f"{f}={getattr(self, f)}" for f in self.FIELDS)
+        return f"MemoryReport({inner}, total_bytes={self.total_bytes})"
+
+
+def tree_bytes(tree) -> int:
+    """Total buffer bytes across a pytree of arrays (jax / numpy /
+    paddle-style ``Tensor`` leaves — anything with ``nbytes`` directly
+    or behind ``.data``)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            inner = getattr(leaf, "data", None)
+            n = getattr(inner, "nbytes", None)
+        if n is not None:
+            total += int(n)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# device stats seam
+# ---------------------------------------------------------------------------
+
+def _default_memory_stats() -> dict:
+    """Device-0 PJRT allocator stats via ``paddle_tpu.device`` — empty
+    on backends that don't report (CPU), exactly like the public
+    ``device.memory_stats()`` surface."""
+    try:
+        from paddle_tpu import device as _device
+        return _device.memory_stats()
+    except Exception:
+        return {}
+
+
+class MemoryLedger:
+    """Named decomposition of HBM in use.
+
+    Owners register a zero-arg callable returning the pytree of buffers
+    they currently hold — or a pre-priced byte count (int), for owners
+    whose buffers aren't safely reachable as a tree (the data
+    prefetcher's queue). A constant tree works too; ``None`` from the
+    callable means the owner is gone and the entry drops itself.
+    ``snapshot()`` prices every owner via :func:`tree_bytes`, reads the
+    backend allocator through the ``stats_fn`` seam, and reports named
+    vs unattributed bytes plus headroom.
+    """
+
+    def __init__(self, stats_fn: Optional[Callable[[], dict]] = None):
+        self._owners: Dict[str, Callable] = {}
+        self._stats_fn = stats_fn or _default_memory_stats
+        self._peak_seen = 0
+        self._headroom_warned = False
+
+    # -- registration ------------------------------------------------------
+    def register(self, owner: str, tree_or_fn) -> None:
+        """Register (or replace) a named buffer owner. Callables are
+        re-evaluated at every snapshot, so live state (param buffers
+        replaced per step, KV pools swapped per engine iteration) stays
+        current; pass a weakref-backed closure returning ``None`` after
+        the owner dies and the entry unregisters itself."""
+        fn = tree_or_fn if callable(tree_or_fn) else (lambda: tree_or_fn)
+        self._owners[str(owner)] = fn
+
+    def unregister(self, owner: str) -> None:
+        self._owners.pop(str(owner), None)
+
+    def owners(self):
+        return sorted(self._owners)
+
+    def set_memory_stats_fn(self, fn: Optional[Callable[[], dict]]):
+        """Swap the backend allocator-stats source (the fake-backend
+        seam that keeps OOM/headroom paths testable on CPU). ``None``
+        restores the real ``device.memory_stats()`` read."""
+        self._stats_fn = fn or _default_memory_stats
+
+    # -- snapshot ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """One decomposition: per-owner bytes, device totals, residual.
+
+        ``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit`` are
+        ``None`` when the backend reports nothing (CPU) — the named
+        owner bytes are still real, only the residual is unknowable.
+        """
+        named = {}
+        for name, fn in list(self._owners.items()):
+            try:
+                tree = fn()
+            except Exception:
+                continue  # a broken owner must not kill telemetry
+            if tree is None:  # owner died (weakref closure) — drop it
+                self._owners.pop(name, None)
+                continue
+            if isinstance(tree, (int, float)):  # pre-priced byte count
+                named[name] = int(tree)
+            else:
+                named[name] = tree_bytes(tree)
+        try:
+            stats = self._stats_fn() or {}
+        except Exception:
+            stats = {}
+        in_use = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit")
+        peak = stats.get("peak_bytes_in_use")
+        if in_use is not None:
+            self._peak_seen = max(self._peak_seen, int(in_use))
+        if peak is not None:
+            self._peak_seen = max(self._peak_seen, int(peak))
+        named_total = sum(named.values())
+        snap = {
+            "owners": dict(sorted(named.items(),
+                                  key=lambda kv: -kv[1])),
+            "named_bytes": named_total,
+            "bytes_in_use": None if in_use is None else int(in_use),
+            "peak_bytes_in_use": self._peak_seen or (
+                None if peak is None else int(peak)),
+            "bytes_limit": None if limit is None else int(limit),
+            "unattributed_bytes": None if in_use is None
+            else max(int(in_use) - named_total, 0),
+            "headroom": None,
+        }
+        if in_use is not None and limit:
+            snap["headroom"] = round(1.0 - int(in_use) / int(limit), 6)
+            self._maybe_warn_headroom(snap)
+        return snap
+
+    def _maybe_warn_headroom(self, snap: dict):
+        """Once-per-run near-OOM warning under the env threshold."""
+        if self._headroom_warned:
+            return
+        raw = os.environ.get(ENV_HEADROOM_WARN, "").strip()
+        if not raw:
+            return
+        try:
+            threshold = float(raw)
+        except ValueError:
+            return  # a typo'd threshold must not take the job down
+        if snap["headroom"] is None or snap["headroom"] >= threshold:
+            return
+        self._headroom_warned = True
+        top = ", ".join(f"{k}={v}B"
+                        for k, v in list(snap["owners"].items())[:4]) \
+            or "no registered owners"
+        warnings.warn(
+            f"HBM headroom {snap['headroom']:.3f} below "
+            f"{ENV_HEADROOM_WARN}={threshold} "
+            f"(in_use={snap['bytes_in_use']}B of "
+            f"limit={snap['bytes_limit']}B; top owners: {top})",
+            RuntimeWarning, stacklevel=3)
+
+    def reset_peak(self):
+        """Start a fresh peak window (phase boundary): clears the
+        host-observed peak and asks the backend to reset its own
+        ``peak_bytes_in_use`` via ``device.reset_max_memory_allocated``
+        (a warning no-op on backends without support)."""
+        self._peak_seen = 0
+        try:
+            from paddle_tpu import device as _device
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                _device.reset_max_memory_allocated()
+        except Exception:
+            pass
+
+    # -- gauges ------------------------------------------------------------
+    def publish(self, registry=None) -> dict:
+        """Snapshot + set the ``hbm_*`` gauges; returns the snapshot.
+        Owner series are labeled ``{owner=...}`` with the residual as
+        ``{owner="unattributed"}``; device totals only publish when the
+        backend (or the fake seam) reports them."""
+        m = memory_metrics(registry)
+        snap = self.snapshot()
+        for name, nbytes in snap["owners"].items():
+            m["bytes"].set(nbytes, owner=name)
+        if snap["unattributed_bytes"] is not None:
+            m["bytes"].set(snap["unattributed_bytes"],
+                           owner="unattributed")
+        if snap["bytes_in_use"] is not None:
+            m["in_use"].set(snap["bytes_in_use"])
+        if snap["peak_bytes_in_use"] is not None:
+            m["peak"].set(snap["peak_bytes_in_use"])
+        if snap["headroom"] is not None:
+            m["headroom"].set(snap["headroom"])
+        return snap
+
+
+_memory_metrics_cache = None
+
+
+def memory_metrics(registry=None) -> dict:
+    """The ``hbm_*`` gauge families (created on first use) — the same
+    accessor-dict pattern as ``serving_metrics`` / ``ckpt_metrics``;
+    names and semantics in docs/OBSERVABILITY.md#memory."""
+    global _memory_metrics_cache
+    if registry is None and _memory_metrics_cache is not None:
+        return _memory_metrics_cache
+    from .metrics import get_registry
+    reg = registry if registry is not None else get_registry()
+    d = {
+        "bytes": reg.gauge(
+            "hbm_bytes",
+            "HBM bytes by registered owner (unattributed = residual)"),
+        "in_use": reg.gauge(
+            "hbm_bytes_in_use", "device allocator bytes currently held"),
+        "peak": reg.gauge(
+            "hbm_peak_bytes",
+            "peak bytes held since process start / last reset_peak"),
+        "headroom": reg.gauge(
+            "hbm_headroom", "1 - bytes_in_use/bytes_limit (0..1)"),
+    }
+    if registry is None:
+        _memory_metrics_cache = d
+    return d
+
+
+_default_ledger: Optional[MemoryLedger] = None
+
+
+def get_ledger() -> MemoryLedger:
+    """The process-wide default ledger (what the framework's own owners
+    register into)."""
+    global _default_ledger
+    if _default_ledger is None:
+        _default_ledger = MemoryLedger()
+    return _default_ledger
+
+
+def register(owner: str, tree_or_fn) -> None:
+    get_ledger().register(owner, tree_or_fn)
+
+
+def unregister(owner: str) -> None:
+    get_ledger().unregister(owner)
+
+
+def snapshot() -> dict:
+    return get_ledger().snapshot()
+
+
+def publish(registry=None) -> dict:
+    """Default-ledger gauge refresh — the per-step poll ``StepTimer``
+    and the serving engine run."""
+    return get_ledger().publish(registry)
+
+
+def reset_peak():
+    get_ledger().reset_peak()
+
+
+def set_memory_stats_fn(fn: Optional[Callable[[], dict]]):
+    get_ledger().set_memory_stats_fn(fn)
+
+
+# ---------------------------------------------------------------------------
+# OOM postmortem
+# ---------------------------------------------------------------------------
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """Does this look like the runtime running out of device memory?
+    PJRT surfaces OOM as ``XlaRuntimeError: RESOURCE_EXHAUSTED: ...``;
+    match on the status code (and its prose spellings) rather than the
+    exception type, which differs across jaxlib versions."""
+    text = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in text or "Resource exhausted" in text
+            or "Out of memory" in text or "out of memory" in text)
+
+
+def handle_oom(exc: BaseException, source: str,
+               report_fn: Optional[Callable] = None) -> Optional[str]:
+    """If ``exc`` is a RESOURCE_EXHAUSTED failure, dump ONE postmortem
+    JSON and return its path (None otherwise). The caller re-raises —
+    this only annotates the crash. Exactly-once: the path is pinned on
+    the exception object, so nested wraps (an engine step inside a
+    server loop) never dump twice for the same failure.
+
+    ``report_fn`` — zero-arg, returning the failing executable's
+    :class:`MemoryReport` (or None); best-effort, because after a real
+    OOM even lowering metadata reads can fail.
+    """
+    if not is_resource_exhausted(exc):
+        return None
+    existing = getattr(exc, "_pt_oom_postmortem", None)
+    if existing is not None:
+        return existing
+    try:
+        path = _dump_postmortem(exc, source, report_fn)
+    except Exception:
+        return None  # postmortem failure must never mask the OOM
+    try:
+        exc._pt_oom_postmortem = path
+    except Exception:
+        pass  # exceptions with __slots__ just lose the dedup marker
+    return path
+
+
+def _dump_postmortem(exc, source, report_fn) -> str:
+    from . import flight_recorder
+
+    info = flight_recorder._rank_topology()
+    d = os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(
+        d, f"oom_postmortem_rank{info['rank']}_{os.getpid()}_{source}.json")
+
+    report = None
+    if report_fn is not None:
+        try:
+            report = report_fn()
+        except Exception:
+            report = None
+    rec = flight_recorder.active()
+    events = []
+    if rec is not None:
+        try:
+            events = rec.events()[-POSTMORTEM_EVENT_TAIL:]
+        except Exception:
+            events = []
+    doc = {
+        "reason": "RESOURCE_EXHAUSTED",
+        "source": source,
+        "error": str(exc)[:4000],
+        "unix_time": time.time(),
+        **info,
+        "ledger": get_ledger().snapshot(),
+        "memory_report": None if report is None else report.to_json(),
+        "flight_recorder_tail": events,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    import sys
+    print(f"[paddle_tpu] OOM postmortem dumped to {path} ({source})",
+          file=sys.stderr)
+    return path

@@ -1,0 +1,218 @@
+"""On-demand device profiler capture — bounded ``jax.profiler`` windows.
+
+Production jobs rarely run under the full ``paddle_tpu.profiler``; what
+they need is a *small, bounded* device-trace window cut out of a live
+run, on demand. Three entry points, all writing under
+``PADDLE_TPU_TRACE_DIR`` (default ``/tmp/paddle_tpu_trace``):
+
+- ``PADDLE_TPU_PROFILE_AT_STEP=<start>:<stop>`` — the hapi fit loop
+  arms a :class:`StepWindow` that starts the capture entering step
+  ``start`` and stops it after step ``stop`` (1-based, inclusive).
+- ``POST /debug/profile?seconds=N`` on the serving HTTP server —
+  bounded (≤ :data:`MAX_CAPTURE_SECONDS`), one capture at a time
+  (``409`` while one is live), stopped by a background timer.
+- ``python bench.py --profile`` — a capture window around a few
+  committed-geometry train steps.
+
+One capture at a time, process-wide: ``jax.profiler`` supports a single
+active trace, so :func:`start_capture` raises :class:`CaptureBusy` when
+a window is already open (the server maps that to ``409``). The
+start/stop calls go through module-level seams (``_start_trace`` /
+``_stop_trace``) so tests exercise the arming logic without a real
+device trace. Arming never touches the jit layer — a profiler window
+cannot retrace anything (the compile-once guard tests pin this).
+
+Docs: docs/OBSERVABILITY.md#device-profiler.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import warnings
+from typing import Optional, Tuple
+
+__all__ = ["CaptureBusy", "MAX_CAPTURE_SECONDS", "bound_seconds",
+           "capture_active", "start_capture", "stop_capture",
+           "capture_for", "start_timed_capture", "StepWindow",
+           "step_window_from_env"]
+
+#: fit-loop capture window, ``<start>:<stop>`` (1-based step ids,
+#: inclusive)
+ENV_PROFILE_AT_STEP = "PADDLE_TPU_PROFILE_AT_STEP"
+
+#: hard ceiling on one on-demand capture — device traces are large and
+#: the serving endpoint must stay abuse-proof
+MAX_CAPTURE_SECONDS = 120.0
+
+
+class CaptureBusy(RuntimeError):
+    """A capture window is already open (one at a time, process-wide)."""
+
+
+def _start_trace(path: str):  # seam — tests swap this out
+    import jax
+    jax.profiler.start_trace(path)
+
+
+def _stop_trace():  # seam — tests swap this out
+    import jax
+    jax.profiler.stop_trace()
+
+
+_lock = threading.Lock()
+_active_dir: Optional[str] = None
+
+
+def trace_dir() -> str:
+    return os.environ.get("PADDLE_TPU_TRACE_DIR", "/tmp/paddle_tpu_trace")
+
+
+def bound_seconds(seconds) -> float:
+    """Validate + clamp a requested capture duration. Raises
+    ``ValueError`` on garbage; silently clamps overlong requests to
+    :data:`MAX_CAPTURE_SECONDS` (bounded is the contract, not an
+    error)."""
+    s = float(seconds)
+    if not (s > 0):  # rejects 0, negatives AND NaN in one comparison
+        raise ValueError(f"capture seconds must be > 0, got {seconds!r}")
+    return min(s, MAX_CAPTURE_SECONDS)
+
+
+def capture_active() -> Optional[str]:
+    """The live capture's output directory, or None."""
+    return _active_dir
+
+
+def start_capture(label: str = "ondemand") -> str:
+    """Open a device-trace window; returns the capture directory.
+    Raises :class:`CaptureBusy` when one is already open."""
+    global _active_dir
+    with _lock:
+        if _active_dir is not None:
+            raise CaptureBusy(
+                f"device profiler capture already running "
+                f"({_active_dir})")
+        out = os.path.join(trace_dir(),
+                           f"profile_{label}_{int(time.time() * 1e3)}")
+        os.makedirs(out, exist_ok=True)
+        _start_trace(out)
+        _active_dir = out
+        return out
+
+
+def stop_capture() -> Optional[str]:
+    """Close the live window; returns its directory (None if none was
+    open — stop is idempotent so timer threads and finally-blocks can
+    both call it)."""
+    global _active_dir
+    with _lock:
+        if _active_dir is None:
+            return None
+        out, _active_dir = _active_dir, None
+        try:
+            _stop_trace()
+        except Exception:
+            # a failed stop must not wedge the one-capture slot shut
+            pass
+        return out
+
+
+def capture_for(seconds, label: str = "ondemand") -> str:
+    """Blocking bounded capture (the bench.py path)."""
+    s = bound_seconds(seconds)
+    out = start_capture(label)
+    try:
+        time.sleep(s)
+    finally:
+        stop_capture()
+    return out
+
+
+def start_timed_capture(seconds, label: str = "serving") \
+        -> Tuple[str, float]:
+    """Non-blocking bounded capture (the HTTP endpoint's path): opens
+    the window now, stops it from a daemon timer thread after
+    ``seconds``. Returns ``(capture_dir, bounded_seconds)``."""
+    s = bound_seconds(seconds)
+    out = start_capture(label)
+
+    def _stop_later():
+        time.sleep(s)
+        # only close OUR window — a capture that was stopped and
+        # replaced before the timer fired must not be clipped
+        if _active_dir == out:
+            stop_capture()
+
+    threading.Thread(target=_stop_later, daemon=True,
+                     name="pt-profile-timer").start()
+    return out, s
+
+
+# ---------------------------------------------------------------------------
+# fit-loop step window
+# ---------------------------------------------------------------------------
+
+class StepWindow:
+    """Start/stop a capture across a step interval (1-based,
+    inclusive). Driven per training step by the fit loop; ``close()``
+    in the loop's finally so a window still open when training ends
+    (stop > total steps, crash) is flushed, not lost."""
+
+    def __init__(self, start: int, stop: int, label: str = "fit"):
+        if start < 1 or stop < start:
+            raise ValueError(
+                f"profile window needs 1 <= start <= stop, got "
+                f"{start}:{stop}")
+        self.start = int(start)
+        self.stop = int(stop)
+        self.label = label
+        self._dir: Optional[str] = None
+        self._done = False
+
+    @property
+    def capture_dir(self) -> Optional[str]:
+        return self._dir
+
+    def on_step(self, step: int):
+        """Called entering each step; opens/closes the window at the
+        configured edges. A busy capture slot (another window live)
+        skips this one with a warning instead of killing the fit."""
+        if self._done:
+            return
+        if self._dir is None and self.start <= step <= self.stop:
+            try:
+                self._dir = start_capture(self.label)
+            except CaptureBusy as e:
+                warnings.warn(f"{ENV_PROFILE_AT_STEP} window skipped: {e}",
+                              RuntimeWarning, stacklevel=2)
+                self._done = True
+                return
+        elif self._dir is not None and step > self.stop:
+            self.close()
+
+    def close(self):
+        if self._dir is not None:
+            stop_capture()
+            self._dir = self._dir  # path survives for callers/logs
+        self._done = True
+
+
+def step_window_from_env() -> Optional[StepWindow]:
+    """Parse ``PADDLE_TPU_PROFILE_AT_STEP=<start>:<stop>`` (a single
+    ``<step>`` means a one-step window). Malformed values warn and
+    disarm — a typo must not take the training job down."""
+    raw = os.environ.get(ENV_PROFILE_AT_STEP, "").strip()
+    if not raw:
+        return None
+    try:
+        if ":" in raw:
+            a, b = raw.split(":", 1)
+            return StepWindow(int(a), int(b))
+        start = int(raw)
+        return StepWindow(start, start)
+    except ValueError as e:
+        warnings.warn(
+            f"ignoring malformed {ENV_PROFILE_AT_STEP}={raw!r}: {e}",
+            RuntimeWarning, stacklevel=2)
+        return None

@@ -15,7 +15,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from . import flight_recorder, trace
+from . import flight_recorder, memory, trace
 from .comm import comm_totals
 from .metrics import MetricsRegistry, get_registry
 
@@ -151,6 +151,13 @@ class StepTimer:
             flight_recorder.KIND_STEP, "train_step",
             int((t1 - total) * 1e9), int(t1 * 1e9),
             aux=int(samples or 0), args=stats)
+        # per-step HBM poll: refresh the memory ledger's hbm_* gauges
+        # into THIS timer's registry (owners registered by TrainStep,
+        # the engine, the data prefetcher — docs/OBSERVABILITY.md#memory)
+        try:
+            memory.publish(self.registry)
+        except Exception:
+            pass  # the memory instrument must never fail a step
         self._step_index += 1
         # the trace layer's step phases: one "step" span carrying the
         # step id (the merge tool's skew/straggler key) plus child phase

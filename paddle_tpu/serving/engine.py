@@ -99,6 +99,17 @@ def _build_serving_metrics(reg) -> dict:
         "kv_blocks": reg.gauge(
             "serving_kv_blocks_in_use",
             "KV-cache blocks currently held by live sequences"),
+        # the two stats()-only fields promoted to real gauge families
+        # (ISSUE 11): Prometheus scrapers and the bench --report gate
+        # see pool pressure and compile churn without polling /healthz
+        "kv_headroom": reg.gauge(
+            "serving_kv_headroom",
+            "fraction of KV-cache blocks still free (pool pressure "
+            "before preemption-by-recompute starts churning)"),
+        "step_compiles": reg.gauge(
+            "serving_step_compiles",
+            "compiles of the ONE unified step executable (>1 means the "
+            "compile-once contract broke)"),
     }
 
 
@@ -311,6 +322,19 @@ class ServingEngine:
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
         return jax.jit(step, donate_argnums=donate)
 
+    def memory_report(self):
+        """XLA's memory accounting of the ONE unified step
+        (``observability.memory.MemoryReport``; None when the backend
+        doesn't report) — the serving-side twin of
+        ``TrainStep.memory_report``. Rides :meth:`_lowered_step`, so it
+        inherits the same neutrality contract as :meth:`compiled_hlo`:
+        pools/scheduler/rng untouched, MoE side effects cleared, and no
+        retrace (``lower`` shares the jit trace cache with real calls —
+        ``step_compiles`` stays truthful)."""
+        from paddle_tpu.observability.memory import MemoryReport
+        return MemoryReport.from_compiled(
+            self._lowered_step().compile(), source="serving_step")
+
     def compiled_hlo(self) -> str:
         """Compiled-HLO text of the ONE unified step (the inspection seam
         ``paddle_tpu.analysis`` audits — mirrors ``TrainStep.compiled_hlo``).
@@ -365,7 +389,38 @@ class ServingEngine:
         self._m_tokens = m["tokens"]
         self._m_preempt = m["preemptions"]
         self._m_steps = m["steps"]
+        self._m_kv_headroom = m["kv_headroom"]
+        self._m_step_compiles = m["step_compiles"]
         self.cache.gauge_in_use()
+        self._register_memory_owners()
+
+    def _register_memory_owners(self):
+        """Register this engine's long-lived HBM owners with the memory
+        ledger (docs/OBSERVABILITY.md#memory): the block-paged KV pools
+        and the functional model state the step threads. Weakref
+        closures so a discarded engine unregisters itself; a second
+        engine in the same process simply takes over the names (the
+        ledger keys by owner, latest registration wins)."""
+        import weakref
+
+        from paddle_tpu.observability import memory as _obs_memory
+
+        wself = weakref.ref(self)
+
+        def _kv_pools():
+            eng = wself()
+            if eng is None:
+                return None
+            return (eng.cache.k_pools, eng.cache.v_pools)
+
+        def _model_state():
+            eng = wself()
+            if eng is None:
+                return None
+            return eng._st
+
+        _obs_memory.register("kv_cache", _kv_pools)
+        _obs_memory.register("serving_params", _model_state)
 
     def _update_gauges(self):
         # queue depth = never-started arrivals; waiting also counts
@@ -383,6 +438,17 @@ class ServingEngine:
         if new > 0:
             self._m_preempt.inc(new)
             self._published_preemptions += new
+        self._m_kv_headroom.set(
+            self.cache.allocator.num_free()
+            / max(self.cache.allocator.capacity, 1))
+        self._m_step_compiles.set(self.step_traces)
+        # per-iteration HBM poll (the serving half of the StepTimer
+        # poll): refresh the ledger-backed hbm_* gauges
+        from paddle_tpu.observability import memory as _obs_memory
+        try:
+            _obs_memory.publish()
+        except Exception:
+            pass  # the memory instrument must never fail a step
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
@@ -510,11 +576,20 @@ class ServingEngine:
 
         t0 = time.perf_counter_ns()
         compiles0 = self.step_traces
-        logits, kps, vps = self._step(
-            self._st, jnp.asarray(tokens), self.cache.k_pools,
-            self.cache.v_pools, jnp.asarray(bt), jnp.asarray(cu),
-            jnp.asarray(ctx), jnp.asarray(sid), jnp.asarray(pos),
-            jnp.asarray(ssq), jnp.asarray(sbk), jnp.asarray(last_idx))
+        try:
+            logits, kps, vps = self._step(
+                self._st, jnp.asarray(tokens), self.cache.k_pools,
+                self.cache.v_pools, jnp.asarray(bt), jnp.asarray(cu),
+                jnp.asarray(ctx), jnp.asarray(sid), jnp.asarray(pos),
+                jnp.asarray(ssq), jnp.asarray(sbk), jnp.asarray(last_idx))
+        except Exception as e:
+            # RESOURCE_EXHAUSTED gets one postmortem (ledger owners +
+            # the unified step's memory report) before re-raising into
+            # the run loop's fail-all-handles path
+            from paddle_tpu.observability import memory as _obs_memory
+            _obs_memory.handle_oom(e, source="serving_step",
+                                   report_fn=self.memory_report)
+            raise
         self.cache.update_pools(kps, vps)
         self._clear_model_side_effects()
         t1 = time.perf_counter_ns()

@@ -23,6 +23,11 @@ Stdlib-only (``http.server`` on daemon threads, mirroring
 * ``GET /metrics`` / ``GET /metrics.json`` — the observability
   registry's Prometheus-text / JSON expositions (serving_* families
   included; see docs/SERVING.md).
+* ``POST /debug/profile?seconds=N`` — open a bounded on-demand device
+  profiler window (``observability.profile``) into
+  ``PADDLE_TPU_TRACE_DIR``; one capture at a time (``409`` while one
+  is live), duration clamped to the module's hard ceiling. Arming
+  never retraces the engine's compiled step.
 
 Graceful degradation (docs/RESILIENCE.md): with ``max_queue_depth`` set,
 ``POST /generate`` sheds load with ``503 + Retry-After`` instead of
@@ -129,6 +134,9 @@ class Server:
                     self.close_connection = True
 
             def do_POST(self):  # noqa: N802 (stdlib API)
+                if self.path.startswith("/debug/profile"):
+                    self._profile_capture()
+                    return
                 if not self.path.startswith("/generate"):
                     self._json(404, {"error": "not found"})
                     return
@@ -187,6 +195,34 @@ class Server:
                     self._stream_response(handle, tokens_q, timeout)
                 else:
                     self._sync_response(handle, timeout)
+
+            def _profile_capture(self):
+                """Bounded on-demand device-trace window. 400 on a
+                garbage duration, 409 while a capture is already live
+                (one at a time, process-wide)."""
+                from urllib.parse import parse_qs, urlparse
+
+                from paddle_tpu.observability import profile as obs_profile
+
+                qs = parse_qs(urlparse(self.path).query)
+                raw = qs.get("seconds", ["2"])[0]
+                try:
+                    seconds = obs_profile.bound_seconds(raw)
+                except (TypeError, ValueError) as e:
+                    self._json(400, {"error": f"bad seconds: {e}"})
+                    return
+                try:
+                    out_dir, seconds = obs_profile.start_timed_capture(
+                        seconds, label="serving")
+                except obs_profile.CaptureBusy as e:
+                    self._json(409, {"error": str(e)})
+                    return
+                except Exception as e:  # backend refused to trace
+                    self._json(500, {"error": f"capture failed: {e}"})
+                    return
+                self._json(200, {"status": "capturing",
+                                 "seconds": seconds,
+                                 "trace_dir": out_dir})
 
             def _abort(self, handle):
                 """Deadline blown: cancel the engine-side request so

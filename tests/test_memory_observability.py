@@ -1,0 +1,542 @@
+"""HBM memory observability (ISSUE 11, paddle_tpu.observability.memory
++ .profile).
+
+Coverage contract: MemoryReport field accounting off a fake
+``memory_analysis``; the ledger's named/unattributed decomposition over
+the fake-backend stats seam (CPU reports nothing, so every
+headroom/residual path runs against injected stats); the once-per-run
+near-OOM warning; the seeded-OOM drill — a fake RESOURCE_EXHAUSTED out
+of the compiled train step AND the serving engine's unified step each
+produce exactly one postmortem JSON naming the top ledger owners and
+the failing executable's memory report, then re-raise; compile-once
+guards proving ``memory_report()`` and profiler arming never retrace
+(rng stream restored, ``step_compiles`` unchanged); the bounded
+profiler windows (step-window arming in ``Model.fit``, the serving
+``POST /debug/profile`` 200/400/409 contract) against fake trace
+seams; and the static-vs-runtime cross-check on the committed
+geometries (audit ``largest_intermediate_bytes`` <= XLA's
+``temp_bytes``).
+"""
+import json
+import os
+import urllib.error
+import urllib.request
+import warnings
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.observability import memory, profile
+from paddle_tpu.observability.memory import (MemoryLedger, MemoryReport,
+                                             tree_bytes)
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+
+class _FakeCompiled:
+    """Stands in for jax.stages.Compiled in unit tests."""
+
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_analysis(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+# ---------------- MemoryReport -----------------------------------------------
+
+def test_memory_report_accounting():
+    ma = SimpleNamespace(argument_size_in_bytes=100,
+                         output_size_in_bytes=40,
+                         temp_size_in_bytes=60,
+                         alias_size_in_bytes=30,
+                         generated_code_size_in_bytes=7)
+    rep = MemoryReport.from_compiled(_FakeCompiled(ma), source="unit")
+    assert rep.argument_bytes == 100 and rep.temp_bytes == 60
+    # aliased (donated) bytes counted in both args and outputs: once
+    assert rep.total_bytes == 100 + 40 + 60 + 7 - 30
+    doc = rep.to_json()
+    assert doc["total_bytes"] == rep.total_bytes
+    assert doc["source"] == "unit"
+    assert set(MemoryReport.FIELDS) <= set(doc)
+
+
+def test_memory_report_none_when_backend_silent():
+    assert MemoryReport.from_compiled(_FakeCompiled(None)) is None
+    assert MemoryReport.from_compiled(
+        _FakeCompiled(NotImplementedError("no"))) is None
+    assert MemoryReport.from_compiled(object()) is None  # no method at all
+
+
+def test_tree_bytes_prices_arrays_and_tensors():
+    x = np.zeros((4, 8), np.float32)            # 128 B
+    t = pt.to_tensor(np.zeros(16, np.float32))  # 64 B behind .data
+    assert tree_bytes({"a": x, "b": [t, None]}) == 128 + t._data.nbytes
+    assert tree_bytes([]) == 0
+
+
+# ---------------- ledger decomposition ---------------------------------------
+
+def _fake_stats(in_use=1000, limit=2000, peak=1500):
+    return lambda: {"bytes_in_use": in_use, "bytes_limit": limit,
+                    "peak_bytes_in_use": peak}
+
+
+def test_ledger_named_vs_unattributed():
+    led = MemoryLedger(stats_fn=_fake_stats())
+    led.register("params", np.zeros(100, np.float32))   # 400 B
+    led.register("kv", lambda: 100)                     # pre-priced int
+    snap = led.snapshot()
+    assert snap["owners"] == {"params": 400, "kv": 100}
+    assert snap["named_bytes"] == 500
+    assert snap["bytes_in_use"] == 1000
+    assert snap["unattributed_bytes"] == 500
+    assert snap["headroom"] == 0.5
+    assert snap["peak_bytes_in_use"] == 1500
+
+
+def test_ledger_cpu_backend_reports_nothing():
+    """The real CPU shape: no allocator stats — named bytes still real,
+    residual/headroom unknowable (None), never a crash."""
+    led = MemoryLedger(stats_fn=lambda: {})
+    led.register("params", np.zeros(10, np.float32))
+    snap = led.snapshot()
+    assert snap["owners"] == {"params": 40}
+    assert snap["bytes_in_use"] is None
+    assert snap["unattributed_bytes"] is None
+    assert snap["headroom"] is None
+
+
+def test_ledger_dead_broken_and_replaced_owners():
+    led = MemoryLedger(stats_fn=lambda: {})
+    led.register("dead", lambda: None)       # weakref closure post-mortem
+    led.register("broken", lambda: 1 / 0)    # must not kill telemetry
+    led.register("x", np.zeros(4, np.float32))
+    led.register("x", np.zeros(8, np.float32))  # replace, latest wins
+    snap = led.snapshot()
+    assert snap["owners"] == {"x": 32}
+    assert "dead" not in led.owners()        # dropped itself
+    assert "broken" in led.owners()          # skipped, not evicted
+    led.unregister("x")
+    assert "x" not in led.owners()
+
+
+def test_ledger_peak_tracks_host_side_max():
+    stats = {"bytes_in_use": 100, "bytes_limit": 1000}
+    led = MemoryLedger(stats_fn=lambda: dict(stats))
+    led.snapshot()
+    stats["bytes_in_use"] = 700
+    led.snapshot()
+    stats["bytes_in_use"] = 300
+    assert led.snapshot()["peak_bytes_in_use"] == 700  # backend has none
+    led._peak_seen = 0  # reset_peak's host half, without touching device
+    assert led.snapshot()["peak_bytes_in_use"] == 300
+
+
+def test_headroom_warns_once(monkeypatch):
+    monkeypatch.setenv(memory.ENV_HEADROOM_WARN, "0.4")
+    led = MemoryLedger(stats_fn=_fake_stats(in_use=1800, limit=2000))
+    led.register("params", np.zeros(8, np.float32))
+    with pytest.warns(RuntimeWarning, match="HBM headroom"):
+        led.snapshot()
+    with warnings.catch_warnings():          # once per run, not per poll
+        warnings.simplefilter("error")
+        led.snapshot()
+    # typo'd threshold is ignored, healthy headroom never warns
+    led2 = MemoryLedger(stats_fn=_fake_stats(in_use=1999, limit=2000))
+    led3 = MemoryLedger(stats_fn=_fake_stats(in_use=100, limit=2000))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        monkeypatch.setenv(memory.ENV_HEADROOM_WARN, "lots")
+        led2.snapshot()
+        monkeypatch.setenv(memory.ENV_HEADROOM_WARN, "0.4")
+        led3.snapshot()
+
+
+def test_publish_sets_hbm_gauges():
+    led = MemoryLedger(stats_fn=_fake_stats())
+    led.register("params", np.zeros(100, np.float32))
+    reg = MetricsRegistry()
+    led.publish(reg)
+    assert reg.get("hbm_bytes").value(owner="params") == 400
+    assert reg.get("hbm_bytes").value(owner="unattributed") == 600
+    assert reg.get("hbm_bytes_in_use").value() == 1000
+    assert reg.get("hbm_peak_bytes").value() == 1500
+    assert reg.get("hbm_headroom").value() == 0.5
+
+
+# ---------------- OOM postmortem ---------------------------------------------
+
+def _oom_error():
+    return RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 1234 bytes")
+
+
+def test_handle_oom_dumps_once_and_only_for_oom(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    assert memory.handle_oom(ValueError("shape mismatch"),
+                             source="train_step") is None
+    assert list(tmp_path.iterdir()) == []
+
+    exc = _oom_error()
+    rep = MemoryReport(argument_bytes=10, temp_bytes=5, source="unit")
+    path = memory.handle_oom(exc, source="train_step",
+                             report_fn=lambda: rep)
+    assert path is not None and os.path.exists(path)
+    # exactly-once: the same exception (nested wraps) reuses the dump
+    assert memory.handle_oom(exc, source="server_loop") == path
+    files = [p for p in tmp_path.iterdir()
+             if p.name.startswith("oom_postmortem")]
+    assert len(files) == 1
+    doc = json.load(open(path))
+    assert doc["reason"] == "RESOURCE_EXHAUSTED"
+    assert doc["source"] == "train_step"
+    assert doc["memory_report"]["temp_bytes"] == 5
+    assert "ledger" in doc and "flight_recorder_tail" in doc
+
+
+def test_handle_oom_survives_broken_report_fn(tmp_path, monkeypatch):
+    """After a real OOM even metadata reads can fail — the postmortem
+    still lands, with a null report."""
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    path = memory.handle_oom(_oom_error(), source="serving_step",
+                             report_fn=lambda: 1 / 0)
+    doc = json.load(open(path))
+    assert doc["memory_report"] is None
+
+
+# ---------------- compiled-step integration ----------------------------------
+
+@pytest.fixture(scope="module")
+def llama_step():
+    from paddle_tpu.analysis.driver import tiny_llama_step
+    import jax
+    step, batch = tiny_llama_step()
+    jax.block_until_ready(step(*batch))  # one real compile, shared below
+    return step, batch
+
+
+class _Boom:
+    """Raises RESOURCE_EXHAUSTED on call but stays a real executable for
+    inspection — the postmortem's memory report must be the truth, not
+    a fabrication."""
+
+    def __init__(self, real):
+        self._real = real
+
+    def __call__(self, *a, **k):
+        raise _oom_error()
+
+    def lower(self, *a, **k):
+        return self._real.lower(*a, **k)
+
+
+def test_train_step_oom_drill(llama_step, tmp_path, monkeypatch):
+    """Seeded OOM out of the compiled train step: exactly one postmortem
+    naming the top ledger owners and the failing executable's real
+    memory report, then the error re-raises."""
+    step, batch = llama_step
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    key = next(iter(step._cache))
+    real = step._cache[key]
+    monkeypatch.setitem(step._cache, key, _Boom(real))
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        step(*batch)
+    files = [p for p in tmp_path.iterdir()
+             if p.name.startswith("oom_postmortem")]
+    assert len(files) == 1 and files[0].name.endswith("train_step.json")
+    doc = json.load(open(files[0]))
+    assert doc["source"] == "train_step"
+    assert "model_params" in doc["ledger"]["owners"]
+    assert "optimizer_state" in doc["ledger"]["owners"]
+    assert doc["memory_report"]["temp_bytes"] > 0
+    assert doc["memory_report"]["total_bytes"] > 0
+
+
+def test_train_step_memory_report_is_neutral(llama_step):
+    """The compile-once + rng-neutrality guard: memory_report rides the
+    cached executable (no retrace) and hands back the key _prepare
+    drew (inspection must not shift the training key stream)."""
+    from paddle_tpu.core import generator as _gen
+    step, batch = llama_step
+    n_compiled = len(step._cache)
+    rng0 = _gen.get_rng_state()
+    rep = step.memory_report(*batch)
+    assert rep is not None and rep.source == "train_step"
+    assert rep.temp_bytes > 0 and rep.total_bytes > 0
+    assert len(step._cache) == n_compiled       # no new executable
+    assert _gen.get_rng_state() == rng0          # key stream untouched
+    # registered owners price to real, non-zero byte totals
+    snap = memory.snapshot()
+    assert snap["owners"].get("model_params", 0) > 0
+    assert snap["owners"].get("optimizer_state", 0) > 0
+
+
+def test_static_watermark_below_runtime_temp(llama_step):
+    """The cross-check the accounting hangs on: the static audit's
+    largest single intermediate is a lower bound on XLA's whole-program
+    scratch high-water (one buffer cannot exceed the sum of live
+    buffers at the peak)."""
+    from paddle_tpu.analysis.audit import audit_train_step
+    step, batch = llama_step
+    rep = audit_train_step(step, *batch)
+    mr = step.memory_report(*batch)
+    assert 0 < rep.largest_intermediate_bytes <= mr.temp_bytes
+
+
+@pytest.mark.slow
+def test_static_watermark_below_runtime_temp_dp8():
+    """Same inequality on the committed dp8 bucketed geometry."""
+    from paddle_tpu.analysis.audit import audit_train_step
+    from paddle_tpu.analysis.driver import dp8_bucketed_step
+    step, batch = dp8_bucketed_step(8)
+    rep = audit_train_step(step, *batch)
+    mr = step.memory_report(*batch)
+    assert 0 < rep.largest_intermediate_bytes <= mr.temp_bytes
+
+
+# ---------------- serving engine ---------------------------------------------
+
+def _tiny_engine(seed=11):
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import ServingEngine
+    pt.seed(seed)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=True))
+    m.eval()
+    return ServingEngine(m, max_batch=2, max_blocks=16, block_size=4,
+                         prefill_chunk=4)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return _tiny_engine()
+
+
+def test_engine_oom_drill(engine, tmp_path, monkeypatch):
+    """Seeded OOM out of the unified serving step: one postmortem with
+    the KV/param owners and the step's real memory report, re-raised
+    into the caller."""
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    monkeypatch.setattr(engine, "_step", _Boom(engine._step))
+    engine.submit([1, 2, 3], max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        while engine.step():
+            pass
+    files = [p for p in tmp_path.iterdir()
+             if p.name.startswith("oom_postmortem")]
+    assert len(files) == 1 and files[0].name.endswith("serving_step.json")
+    doc = json.load(open(files[0]))
+    assert doc["source"] == "serving_step"
+    assert "kv_cache" in doc["ledger"]["owners"]
+    assert "serving_params" in doc["ledger"]["owners"]
+    assert doc["memory_report"]["argument_bytes"] > 0
+
+
+def test_engine_memory_report_and_gauges(engine):
+    """memory_report and the new serving gauges ride the jit trace
+    cache: step_traces (and its serving_step_compiles gauge) stays
+    truthful across inspection."""
+    engine.memory_report()   # warm: the FIRST inspection legitimately
+    traces0 = engine.step_traces  # traces (shared jit cache, counted)
+    rep = engine.memory_report()
+    assert rep is not None and rep.source == "serving_step"
+    assert rep.argument_bytes > 0
+    assert engine.step_traces == traces0       # no hidden retrace
+    engine._update_gauges()
+    assert engine._m_step_compiles.value() == engine.step_traces
+    assert 0.0 <= engine._m_kv_headroom.value() <= 1.0
+    snap = memory.snapshot()
+    assert snap["owners"].get("kv_cache", 0) > 0
+    assert snap["owners"].get("serving_params", 0) > 0
+
+
+# ---------------- profiler windows -------------------------------------------
+
+@pytest.fixture()
+def fake_trace(monkeypatch):
+    """Swap the jax.profiler seams for recorders; guarantee the
+    process-wide capture slot is free before and after."""
+    calls = {"start": [], "stop": 0}
+    profile.stop_capture()
+    monkeypatch.setattr(profile, "_start_trace",
+                        lambda path: calls["start"].append(path))
+
+    def _stop():
+        calls["stop"] += 1
+    monkeypatch.setattr(profile, "_stop_trace", _stop)
+    yield calls
+    profile.stop_capture()
+
+
+def test_bound_seconds_contract():
+    assert profile.bound_seconds("2.5") == 2.5
+    assert profile.bound_seconds(10 ** 6) == profile.MAX_CAPTURE_SECONDS
+    for bad in (0, -1, "nope", float("nan")):
+        with pytest.raises(ValueError):
+            profile.bound_seconds(bad)
+
+
+def test_capture_exclusive_and_idempotent_stop(fake_trace, tmp_path,
+                                               monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    out = profile.start_capture("unit")
+    assert os.path.isdir(out) and profile.capture_active() == out
+    with pytest.raises(profile.CaptureBusy):
+        profile.start_capture("another")
+    assert profile.stop_capture() == out
+    assert profile.stop_capture() is None      # idempotent
+    assert fake_trace["start"] == [out] and fake_trace["stop"] == 1
+
+
+def test_step_window_opens_and_closes_on_edges(fake_trace, tmp_path,
+                                               monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    win = profile.StepWindow(2, 3)
+    win.on_step(1)
+    assert fake_trace["start"] == []           # before the window
+    win.on_step(2)
+    assert len(fake_trace["start"]) == 1       # opened entering start
+    win.on_step(3)
+    assert fake_trace["stop"] == 0             # stop is INCLUSIVE
+    win.on_step(4)
+    assert fake_trace["stop"] == 1             # closed past stop
+    win.on_step(5)
+    win.close()
+    assert len(fake_trace["start"]) == 1 and fake_trace["stop"] == 1
+
+
+def test_step_window_busy_slot_warns_not_kills(fake_trace, tmp_path,
+                                               monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    profile.start_capture("occupant")
+    win = profile.StepWindow(1, 2)
+    with pytest.warns(RuntimeWarning, match="window skipped"):
+        win.on_step(1)
+    win.on_step(2)                             # disarmed, no retries
+    assert len(fake_trace["start"]) == 1       # only the occupant
+
+
+def test_step_window_from_env(monkeypatch):
+    monkeypatch.setenv(profile.ENV_PROFILE_AT_STEP, "2:5")
+    win = profile.step_window_from_env()
+    assert (win.start, win.stop) == (2, 5)
+    monkeypatch.setenv(profile.ENV_PROFILE_AT_STEP, "7")
+    win = profile.step_window_from_env()
+    assert (win.start, win.stop) == (7, 7)
+    monkeypatch.setenv(profile.ENV_PROFILE_AT_STEP, "three:4")
+    with pytest.warns(RuntimeWarning, match="malformed"):
+        assert profile.step_window_from_env() is None
+    monkeypatch.delenv(profile.ENV_PROFILE_AT_STEP)
+    assert profile.step_window_from_env() is None
+
+
+def test_fit_loop_profile_window(fake_trace, tmp_path, monkeypatch):
+    """PADDLE_TPU_PROFILE_AT_STEP drives exactly one capture window out
+    of a real Model.fit."""
+    from paddle_tpu import io, nn
+    from paddle_tpu import optimizer as opt
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv(profile.ENV_PROFILE_AT_STEP, "2:3")
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    m = pt.Model(net)
+    m.prepare(optimizer=opt.AdamW(learning_rate=0.01,
+                                  parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X.sum(-1) > 0).astype(np.int64)
+    m.fit(io.TensorDataset([X, y]), batch_size=8, epochs=1, verbose=0)
+    assert len(fake_trace["start"]) == 1
+    assert fake_trace["stop"] == 1
+    assert "profile_fit_" in fake_trace["start"][0]
+
+
+def test_server_debug_profile_endpoint(engine, fake_trace, tmp_path,
+                                       monkeypatch):
+    """POST /debug/profile: 200 opens a bounded capture, garbage seconds
+    is 400, a live capture is 409 — and none of it touches the engine's
+    executables (step_compiles unchanged)."""
+    from paddle_tpu.serving import Server
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    traces0 = engine.step_traces
+    srv = Server(engine).start()
+    try:
+        def post(q):
+            req = urllib.request.Request(
+                srv.url + f"/debug/profile?seconds={q}", data=b"")
+            return json.loads(urllib.request.urlopen(
+                req, timeout=10).read())
+
+        res = post("0.05")
+        assert res["status"] == "capturing" and res["seconds"] == 0.05
+        assert str(tmp_path) in res["trace_dir"]
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("banana")
+        assert ei.value.code == 400
+        profile.stop_capture()                 # free the timed window
+
+        profile.start_capture("occupant")      # now the slot is busy
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("1")
+        assert ei.value.code == 409
+        assert engine.step_traces == traces0
+    finally:
+        srv.close(stop_engine=False)
+
+
+# ---------------- data prefetch owner ----------------------------------------
+
+def test_prefetcher_registers_ledger_owner():
+    from paddle_tpu import io
+    X = np.zeros((64, 8), np.float32)
+    y = np.zeros((64,), np.int64)
+    loader = io.DataLoader(io.TensorDataset([X, y]), batch_size=8)
+    assert loader.prefetch_depth >= 2          # buffer reader is on
+    seen = []
+    for _ in loader:
+        seen.append("data_prefetch" in memory.get_ledger().owners())
+    assert any(seen)                           # live while iterating
+    assert "data_prefetch" not in memory.get_ledger().owners()
+
+
+# ---------------- device satellites ------------------------------------------
+
+def test_device_memory_stats_spellings():
+    import jax
+    from paddle_tpu import device
+    assert device.memory_stats() == {}         # CPU backend: no stats
+    assert device.memory_stats("cpu:0") == {}
+    assert device.memory_stats(0) == {}
+    assert device.memory_stats(jax.devices()[0]) == {}  # Device object
+    assert device.memory_allocated() == 0
+    assert device.max_memory_allocated("cpu:0") == 0
+    with pytest.raises(IndexError, match="out of range"):
+        device.memory_stats("cpu:99")
+    with pytest.raises(IndexError, match="out of range"):
+        device.memory_stats(99)
+
+
+def test_device_reset_peak_warning_noop():
+    from paddle_tpu import device
+    with pytest.warns(RuntimeWarning, match="no peak-reset"):
+        assert device.reset_max_memory_allocated() is False
+
+
+def test_audit_headline_includes_peak_hbm():
+    """bench.py --audit's new LOWER_BETTER headline is wired end to
+    end: the driver emits it and the report gate knows its
+    direction."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert "train_step_peak_hbm_bytes" in bench.REPORT_LOWER_BETTER

@@ -243,7 +243,8 @@ class TestBenchReportGate:
 
 #: family-name prefixes owned by this framework's telemetry
 _FAMILY_PREFIXES = ("comm_", "train_", "serving_", "ckpt_",
-                    "resilience_", "data_", "loader_", "attribution_")
+                    "resilience_", "data_", "loader_", "attribution_",
+                    "hbm_")
 
 #: backticked doc tokens that look like families but are not registry
 #: metrics: `comm_bytes` is the chrome-trace counter-track name,
@@ -260,7 +261,15 @@ _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           # (docs/ANALYSIS.md), not registry families
                           "train_step_allreduce_count",
                           "train_step_undonated_bytes",
-                          "train_step_largest_intermediate_bytes"}
+                          "train_step_largest_intermediate_bytes",
+                          # bench.py --audit runtime-memory headline
+                          # (ISSUE 11, docs/ANALYSIS.md) — a report-gate
+                          # stdout line, not a registry family
+                          "train_step_peak_hbm_bytes",
+                          # HBM-ledger owner names (the {owner} label
+                          # values of hbm_bytes, docs/OBSERVABILITY.md
+                          # #memory), not families themselves
+                          "serving_params", "data_prefetch"}
 
 
 def _documented_families():
@@ -307,6 +316,7 @@ def _registered_families():
     from paddle_tpu.io.dataloader import loader_metrics
     from paddle_tpu.observability import StepTimer, get_registry
     from paddle_tpu.observability.attribution import attribution_metrics
+    from paddle_tpu.observability.memory import memory_metrics
     from paddle_tpu.resilience.counters import (
         nonfinite_counter, preemption_counter, rollback_counter,
         watchdog_metrics)
@@ -317,6 +327,7 @@ def _registered_families():
     data_metrics()
     loader_metrics()
     attribution_metrics()
+    memory_metrics()
     serving_metrics()
     nonfinite_counter(), rollback_counter(), preemption_counter()
     watchdog_metrics()
