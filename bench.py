@@ -954,9 +954,19 @@ REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        # step (ISSUE 11, observability.memory): XLA
                        # buffer-assignment total for the audited step
                        "train_step_peak_hbm_bytes"}
+#: open-ended LOWER_BETTER families — the static comm budget is one
+#: metric per mesh axis (ISSUE 12, bench.py --audit /
+#: paddle_tpu.analysis commplan), so membership is by prefix; the
+#: ``_cpu_smoke`` suffix rides after the axis name
+REPORT_LOWER_BETTER_PREFIXES = ("train_step_comm_bytes_",)
 #: absolute ceilings: current must stay under max(baseline, bound) —
 #: step-time spread is a stability gate, not a race
 REPORT_BOUNDED = {"spread_pct_of_mean": 1.5}
+
+
+def _lower_better(name: str) -> bool:
+    return name in REPORT_LOWER_BETTER or \
+        name.startswith(REPORT_LOWER_BETTER_PREFIXES)
 
 
 def _report_metrics_of(doc: dict) -> dict:
@@ -1037,7 +1047,7 @@ def report_compare(baseline: dict, current: dict,
     for name in sorted(baseline):
         base = baseline[name]
         if name not in current:
-            if name in REPORT_HIGHER_BETTER or name in REPORT_LOWER_BETTER \
+            if name in REPORT_HIGHER_BETTER or _lower_better(name) \
                     or name in REPORT_BOUNDED:
                 skipped.append(name)
             continue
@@ -1046,7 +1056,7 @@ def report_compare(baseline: dict, current: dict,
         status = "info"
         if name in REPORT_HIGHER_BETTER:
             status = "fail" if cur < base * (1 - tol) else "ok"
-        elif name in REPORT_LOWER_BETTER:
+        elif _lower_better(name):
             status = "fail" if cur > base * (1 + tol) else "ok"
         elif name in REPORT_BOUNDED:
             limit = max(base, REPORT_BOUNDED[name])
@@ -1334,6 +1344,17 @@ def bench_audit():
                  "train_step_peak_hbm_bytes"):
         print(json.dumps({"metric": f"{name}{suffix}",
                           "value": result.get(name)}))
+
+    # per-axis static comm budget (ISSUE 12): the bucketed-dp step's
+    # comm-plan ledger as LOWER_BETTER headlines — the before/after
+    # instrument the overlap/fusion work pairs with the runtime
+    # train_step_exposed_collective_seconds counter
+    from paddle_tpu.analysis.driver import run_commplan
+    plan = run_commplan(only=("dp8",))
+    for axis, slot in sorted(plan["ledgers"].get("dp8", {}).items()):
+        result[f"train_step_comm_bytes_{axis}"] = slot["bytes"]
+        print(json.dumps({"metric": f"train_step_comm_bytes_{axis}{suffix}",
+                          "value": slot["bytes"]}))
     return result
 
 

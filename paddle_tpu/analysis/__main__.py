@@ -1,8 +1,9 @@
-"""CLI: ``python -m paddle_tpu.analysis {audit,lint,knobs}``.
+"""CLI: ``python -m paddle_tpu.analysis {audit,lint,knobs,commplan,all}``.
 
-Exit codes: 0 clean, 1 new findings / drift, 2 usage error. The gate
-semantics (new-vs-baseline) match the tier-1 tests, so a green local
-run means a green CI lint job.
+Exit codes: 0 clean, 1 new findings / drift, 2 usage error or unusable
+baseline (missing/corrupt ``baseline.json`` prints a one-line hint, not
+a traceback). The gate semantics (new-vs-baseline) match the tier-1
+tests, so a green local run means a green CI lint job.
 """
 from __future__ import annotations
 
@@ -11,7 +12,8 @@ import json
 import os
 import sys
 
-from .findings import load_baseline, repo_root as _repo_root
+from .findings import BaselineError, load_baseline, \
+    repo_root as _repo_root
 
 
 def _pkg_root() -> str:
@@ -57,6 +59,13 @@ def cmd_lint(args) -> int:
     from .lint import lint_tree
     findings = lint_tree(args.root, extra_files=_bench_path())
     findings.sort(key=lambda f: (f.severity, f.path, f.line))
+    if not getattr(args, "strict_suppressions", False):
+        # allow-rot is advisory by default: surface it, don't gate on it
+        stale_sup = [f for f in findings if f.rule == "stale-suppression"]
+        findings = [f for f in findings if f.rule != "stale-suppression"]
+        if stale_sup and not args.quiet and not args.json:
+            for f in stale_sup:
+                print("warn " + f.format(), file=sys.stderr)
     return _gate(findings, args, "lint")
 
 
@@ -75,6 +84,73 @@ def cmd_audit(args) -> int:
                   f"largest={rep['largest_intermediate_bytes']}B",
                   file=sys.stderr)
     return _gate(findings, args, "audit", extra=result)
+
+
+def cmd_commplan(args) -> int:
+    from .commplan import budget_findings
+    from .driver import ensure_cpu_mesh, run_commplan
+    ensure_cpu_mesh()
+    result = run_commplan(seed_typo=getattr(args, "seed_typo", False),
+                          only=getattr(args, "only", None))
+    findings = result.pop("findings")
+    if not args.json:
+        for label, rep in result["reports"].items():
+            for axis, slot in sorted(rep["ledger"].items()):
+                print(f"-- {label}/{axis}: ops={slot['ops']} "
+                      f"bytes={slot['bytes']} hops={slot['hops']} "
+                      f"kinds={slot['kinds']}", file=sys.stderr)
+            if not rep["ledger"]:
+                print(f"-- {label}: no collectives", file=sys.stderr)
+        for label, why in result["skipped"].items():
+            print(f"-- {label}: SKIPPED ({why})", file=sys.stderr)
+
+    base = load_baseline(args.baseline)
+    if args.write_baseline:
+        for label, ledger in result["ledgers"].items():
+            base.commplan[label] = {
+                axis: {"ops": slot["ops"], "bytes": slot["bytes"],
+                       "kinds": dict(slot["kinds"])}
+                for axis, slot in ledger.items()}
+        base.save()
+        print(f"pinned comm ledgers for "
+              f"{sorted(result['ledgers'])} into {base.path}",
+              file=sys.stderr)
+    elif not base.commplan:
+        raise BaselineError(base.path, "no pinned commplan section")
+    else:
+        for label, ledger in result["ledgers"].items():
+            findings.extend(budget_findings(
+                label, ledger, base.commplan.get(label)))
+    findings.sort(key=lambda f: (f.severity, f.path, f.anchor))
+    return _gate(findings, args, "commplan", extra=result)
+
+
+def cmd_all(args) -> int:
+    """What CI runs: every prong, worst exit code wins (run them all
+    even if an early one fails, so one CI log shows the whole picture)."""
+    shared = dict(baseline=args.baseline, update_baseline=False,
+                  quiet=args.quiet, json=False)
+    steps = (
+        ("lint", cmd_lint, dict(
+            root=None,
+            strict_suppressions=args.strict_suppressions, **shared)),
+        ("knobs", cmd_knobs, dict(json=False)),
+        ("audit", cmd_audit, dict(no_serving=False, **shared)),
+        ("commplan", cmd_commplan, dict(
+            seed_typo=False, only=None, write_baseline=False, **shared)),
+    )
+    worst = 0
+    for name, fn, ns in steps:
+        print(f"== {name}", file=sys.stderr)
+        try:
+            rc = fn(argparse.Namespace(**ns))
+        except BaselineError as e:
+            print(str(e), file=sys.stderr)
+            rc = 2
+        if rc:
+            print(f"== {name}: FAIL (exit {rc})", file=sys.stderr)
+        worst = max(worst, rc)
+    return worst
 
 
 def cmd_knobs(args) -> int:
@@ -110,25 +186,50 @@ def main(argv=None) -> int:
     lint = sub.add_parser("lint", help="AST trace-safety lint")
     lint.add_argument("--root", default=None,
                       help="tree to lint (default: the installed package)")
+    lint.add_argument("--strict-suppressions", action="store_true",
+                      help="gate on stale `# analysis: allow(...)` "
+                           "comments instead of warning")
     audit = sub.add_parser("audit",
                            help="compiled-program audit (committed "
                                 "geometry)")
     audit.add_argument("--no-serving", action="store_true",
                        help="skip the serving-engine program")
+    commplan = sub.add_parser(
+        "commplan", help="SPMD comm-plan audit over the committed "
+                         "parallelism matrix")
+    commplan.add_argument("--write-baseline", action="store_true",
+                          help="pin the current per-axis ledgers into "
+                               "the baseline (budget re-baseline)")
+    commplan.add_argument("--seed-typo", dest="seed_typo",
+                          action="store_true",
+                          help="self-test: lower the dp8 geometry with a "
+                               "seeded sharding-spec typo (must exit 1)")
+    commplan.add_argument("--only", action="append", default=None,
+                          metavar="LABEL",
+                          help="restrict to named geometries (repeatable)")
     knobs = sub.add_parser("knobs", help="env-knob registry + doc drift")
-    for sp in (lint, audit):
+    knobs.add_argument("--json", action="store_true")
+    allp = sub.add_parser("all", help="lint+knobs+audit+commplan, the "
+                                      "way CI runs them")
+    allp.add_argument("--strict-suppressions", action="store_true",
+                      help="gate on stale suppressions in the lint step")
+    for sp in (lint, audit, commplan, allp):
         sp.add_argument("--baseline", default=None,
                         help="baseline.json path (default: committed, or "
                              "$PADDLE_TPU_ANALYSIS_BASELINE)")
-        sp.add_argument("--update-baseline", action="store_true",
-                        help="accept the new findings into the baseline")
         sp.add_argument("--quiet", action="store_true")
         sp.add_argument("--json", action="store_true")
-    knobs.add_argument("--json", action="store_true")
+    for sp in (lint, audit, commplan):
+        sp.add_argument("--update-baseline", action="store_true",
+                        help="accept the new findings into the baseline")
 
     args = p.parse_args(argv)
-    return {"lint": cmd_lint, "audit": cmd_audit,
-            "knobs": cmd_knobs}[args.cmd](args)
+    try:
+        return {"lint": cmd_lint, "audit": cmd_audit, "knobs": cmd_knobs,
+                "commplan": cmd_commplan, "all": cmd_all}[args.cmd](args)
+    except BaselineError as e:
+        print(str(e), file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":

@@ -23,7 +23,8 @@ import os
 from typing import Optional, Tuple
 
 __all__ = ["ensure_cpu_mesh", "dp8_bucketed_step", "tiny_llama_step",
-           "tiny_serving_engine", "run_default_audit"]
+           "tiny_serving_engine", "run_default_audit", "run_commplan",
+           "COMMPLAN_GEOMETRIES"]
 
 
 def ensure_cpu_mesh(devices: int = 8) -> bool:
@@ -46,9 +47,15 @@ def ensure_cpu_mesh(devices: int = 8) -> bool:
     return True
 
 
-def dp8_bucketed_step(dp: Optional[int] = None):
+def dp8_bucketed_step(dp: Optional[int] = None, seed_typo: bool = False):
     """(step, (x, y)) — pure-dp ``DataParallel`` MLP with the bucketed
-    collective path active (the PR 7 HLO-contract geometry)."""
+    collective path active (the PR 7 HLO-contract geometry).
+
+    ``seed_typo`` plants the accidental-all-gather defect the commplan
+    auditor exists to catch: one bias declared sharded over ``dp`` (a
+    one-token sharding-spec mistake), which forces GSPMD to all-gather
+    that parameter every step. Used by ``commplan --seed-typo`` and the
+    regression tests — never by a real audit."""
     import numpy as np
 
     import paddle_tpu as pt
@@ -61,6 +68,9 @@ def dp8_bucketed_step(dp: Optional[int] = None):
     mesh = dist.init_mesh({"dp": dp})
     pt.seed(3)
     net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    if seed_typo:
+        from paddle_tpu.distributed import P
+        net[0].bias._sharding_spec = P("dp")
     m = dist.DataParallel(net, mesh=mesh)
     o = pt.optimizer.AdamW(learning_rate=0.01, parameters=m.parameters())
 
@@ -176,4 +186,285 @@ def run_default_audit(include_serving: bool = True,
         rep = audit_serving_engine(engine)
         out["reports"].append(rep.summary())
         out["findings"].extend(rep.findings)
+    return out
+
+
+# -- commplan geometries ----------------------------------------------------
+#
+# One tiny committed program per MULTICHIP parallelism segment, lowered
+# through the same RNG-neutral ``compiled_hlo`` seam the audits use.
+# The per-axis comm ledgers these produce are pinned in baseline.json —
+# the budget-drift gate compares every run against them.
+
+def _lower_train_step(step, *args):
+    """(hlo_text, leaf_names) via the RNG-neutral ``_prepare`` seam —
+    leaf names aligned to entry-parameter numbers so the
+    implicit-reshard pass can name the gathered leaf."""
+    from paddle_tpu.core import generator as _gen
+
+    from .audit import TRAIN_STEP_ARGS, _align_params, _leaf_names
+    from .hlo import parse_entry_params
+
+    rng_state = _gen.get_rng_state()
+    try:
+        _, compiled, call_args = step._prepare(args, {})
+        lowered = compiled.lower(*call_args)
+        hlo_text = lowered.compile().as_text()
+        args_info = lowered.args_info
+    finally:
+        _gen.set_rng_state(rng_state)
+    leaves = _leaf_names(args_info, TRAIN_STEP_ARGS)
+    aligned = _align_params(parse_entry_params(hlo_text), leaves)
+    return hlo_text, [name for name, *_ in aligned]
+
+
+def _geo_dp8(seed_typo: bool = False):
+    step, (x, y) = dp8_bucketed_step(seed_typo=seed_typo)
+    hlo, names = _lower_train_step(step, x, y)
+    import paddle_tpu.distributed as dist
+    return {"hlo": hlo, "mesh": dist.get_mesh(), "leaf_names": names,
+            "gather_ok": False}
+
+
+def _geo_dpxmp():
+    """Data x tensor parallel: the zoo Llama with Megatron-style mpu
+    layers over {dp: 4, mp: 2} (graft-entry segment (a))."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed import P
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    mesh = dist.init_mesh({"dp": 4, "mp": 2})
+    pt.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=True))
+    o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+
+    def loss_fn(m, toks):
+        _, loss = m(toks, labels=toks)
+        return loss
+
+    step = pt.jit.TrainStep(model, loss_fn, o, mesh=mesh,
+                            input_spec=P("dp"))
+    rng = np.random.RandomState(0)
+    toks = pt.to_tensor(rng.randint(0, 256, (8, 8)).astype(np.int32))
+    hlo, names = _lower_train_step(step, toks)
+    return {"hlo": hlo, "mesh": mesh, "leaf_names": names,
+            "gather_ok": False}
+
+
+def _pp_train_step(mesh):
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed.fleet as fleet
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import nn
+
+    pt.seed(4)
+    layer = fleet.SpmdPipelineLayer(
+        lambda: nn.Sequential(nn.Linear(8, 8), nn.Tanh()),
+        num_virtual_stages=2, mesh=mesh)
+    mse = nn.MSELoss()
+
+    def loss_fn(m, xs, ys):
+        out = m(xs)
+        return mse(pt.reshape(out, [-1, 8]), pt.reshape(ys, [-1, 8]))
+
+    o = opt.AdamW(learning_rate=1e-3, parameters=layer.parameters())
+    rng = np.random.RandomState(0)
+    return layer, loss_fn, o, rng
+
+
+def _geo_pp():
+    """SPMD pipeline over a pure {pp: 8} mesh — stage hops are compiled
+    ppermutes (collective-permute in the ledger)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import P
+
+    mesh = dist.init_mesh({"pp": 8})
+    layer, loss_fn, o, rng = _pp_train_step(mesh)
+    step = pt.jit.TrainStep(layer, loss_fn, o, mesh=mesh, input_spec=P())
+    X = pt.to_tensor(rng.randn(8, 2, 8).astype(np.float32))
+    Y = pt.to_tensor(rng.randn(8, 2, 8).astype(np.float32))
+    hlo, names = _lower_train_step(step, X, Y)
+    return {"hlo": hlo, "mesh": mesh, "leaf_names": names,
+            "gather_ok": False}
+
+
+def _geo_dpxpp():
+    """Data x pipeline over {dp: 2, pp: 4} — the partial-manual
+    shard_map geometry. On jax builds whose shard_map cannot mix a
+    manual pp axis with an auto dp axis this raises and the runner
+    records the geometry as skipped (capability-gated, not silently
+    dropped)."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import P
+
+    mesh = dist.init_mesh({"dp": 2, "pp": 4})
+    layer, loss_fn, o, rng = _pp_train_step(mesh)
+    step = pt.jit.TrainStep(layer, loss_fn, o, mesh=mesh,
+                            input_spec=P(None, "dp"))
+    X = pt.to_tensor(rng.randn(4, 4, 8).astype(np.float32))
+    Y = pt.to_tensor(rng.randn(4, 4, 8).astype(np.float32))
+    hlo, names = _lower_train_step(step, X, Y)
+    return {"hlo": hlo, "mesh": mesh, "leaf_names": names,
+            "gather_ok": False}
+
+
+def _geo_zero():
+    """ZeRO stage-3 (p_g_os) over {sharding: 8}. ``gather_ok``: the
+    whole POINT of ZeRO is re-gathering sharded params every step, so
+    the implicit-reshard pass must stay quiet here."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import P
+
+    mesh = dist.init_mesh({"sharding": 8})
+    pt.seed(5)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 16))
+    o = opt.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    m, o, _ = dist.group_sharded_parallel(net, o, level="p_g_os")
+
+    def loss_fn(model, x, y):
+        return ((model(x) - y) ** 2).mean()
+
+    step = pt.jit.TrainStep(m, loss_fn, o, mesh=mesh,
+                            input_spec=P("sharding"))
+    rng = np.random.RandomState(0)
+    X = pt.to_tensor(rng.randn(16, 16).astype(np.float32))
+    Y = pt.to_tensor(rng.randn(16, 16).astype(np.float32))
+    hlo, names = _lower_train_step(step, X, Y)
+    return {"hlo": hlo, "mesh": mesh, "leaf_names": names,
+            "gather_ok": True}
+
+
+def _geo_sp():
+    """Sequence-parallel ring attention over {sp: 8} — a pure
+    collective-permute ring (no TrainStep; the kernel is a function, so
+    the lowering goes through a plain jax.jit)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.distributed.fleet as fleet
+
+    mesh = dist.init_mesh({"sp": 8})
+
+    def fn(q, k, v):
+        out = fleet.ring_attention(pt.to_tensor(q), pt.to_tensor(k),
+                                   pt.to_tensor(v), mesh=mesh, axis="sp",
+                                   causal=True)
+        return out.data
+
+    rng = np.random.RandomState(0)
+    args = [rng.randn(2, 32, 2, 8).astype(np.float32) for _ in range(3)]
+    hlo = jax.jit(fn).lower(*args).compile().as_text()
+    return {"hlo": hlo, "mesh": mesh, "leaf_names": None,
+            "gather_ok": False}
+
+
+def _geo_ep():
+    """Expert-parallel MoE (GShard gate) over {ep: 8} — token dispatch
+    is the all-to-all pair. Activations legitimately reshard around the
+    expert boundary; parameters must not, so gather_ok stays False."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.distributed.fleet as fleet
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed import P
+
+    mesh = dist.init_mesh({"ep": 8})
+    pt.seed(6)
+    moe = fleet.MoELayer(16, 32, num_experts=8, gate="gshard",
+                         mesh=mesh, axis="ep")
+    o = opt.AdamW(learning_rate=1e-3, parameters=moe.parameters())
+
+    def loss_fn(model, x, y):
+        out = model(x)
+        return ((out - y) ** 2).mean() + 0.01 * model.l_aux
+
+    step = pt.jit.TrainStep(moe, loss_fn, o, mesh=mesh, input_spec=P("ep"))
+    rng = np.random.RandomState(0)
+    X = pt.to_tensor(rng.randn(8, 4, 16).astype(np.float32))
+    Y = pt.to_tensor(rng.randn(8, 4, 16).astype(np.float32))
+    hlo, names = _lower_train_step(step, X, Y)
+    return {"hlo": hlo, "mesh": mesh, "leaf_names": names,
+            "gather_ok": False}
+
+
+def _geo_serving():
+    """The unified serving step (single device off-TPU — an empty
+    ledger is itself the pinned fact: serving must not grow collectives
+    without review)."""
+    engine = tiny_serving_engine()
+    lowered = engine._lowered_step()
+    return {"hlo": lowered.compile().as_text(), "mesh": None,
+            "leaf_names": None, "gather_ok": False}
+
+
+#: label -> builder; labels are baseline keys — NEVER rename casually
+#: (a rename orphans the pinned ledger and reports everything as new)
+COMMPLAN_GEOMETRIES = (
+    ("dp8", _geo_dp8),
+    ("dpxmp", _geo_dpxmp),
+    ("pp", _geo_pp),
+    ("dpxpp", _geo_dpxpp),
+    ("zero", _geo_zero),
+    ("sp", _geo_sp),
+    ("ep", _geo_ep),
+    ("serving", _geo_serving),
+)
+
+
+def run_commplan(seed_typo: bool = False, only=None) -> dict:
+    """Lower every committed geometry and run the comm-plan audit.
+
+    Returns ``{"reports": {label: summary}, "ledgers": {label: ledger},
+    "findings": [...], "skipped": {label: reason}}``. A geometry whose
+    *construction* is unsupported on the running jax (the partial-manual
+    dp x pp shard_map) lands in ``skipped`` with the error string —
+    visible, not silently absent. ``seed_typo`` swaps in the defective
+    dp8 variant (the accidental-all-gather regression fixture)."""
+    import paddle_tpu.distributed as dist
+
+    from .commplan import audit_comm
+
+    prev_mesh = dist.get_mesh()
+    out = {"reports": {}, "ledgers": {}, "findings": [], "skipped": {}}
+    try:
+        for label, build in COMMPLAN_GEOMETRIES:
+            if only and label not in only:
+                continue
+            try:
+                geo = build(seed_typo=True) if (
+                    seed_typo and label == "dp8") else build()
+            except Exception as e:  # capability gate, not error-hiding:
+                # the skip reason is part of the report and the tests
+                # assert the supported set
+                out["skipped"][label] = f"{type(e).__name__}: {e}"
+                continue
+            rep = audit_comm(geo["hlo"], label, mesh=geo["mesh"],
+                             leaf_names=geo["leaf_names"],
+                             gather_ok=geo["gather_ok"])
+            out["reports"][label] = rep.summary()
+            out["ledgers"][label] = rep.ledger
+            out["findings"].extend(rep.findings)
+    finally:
+        dist.set_mesh(prev_mesh)
     return out

@@ -21,8 +21,22 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-__all__ = ["Finding", "Baseline", "baseline_path", "load_baseline",
-           "SEVERITIES", "P0", "P1", "P2", "repo_root", "iter_py_files"]
+__all__ = ["Finding", "Baseline", "BaselineError", "baseline_path",
+           "load_baseline", "SEVERITIES", "P0", "P1", "P2", "repo_root",
+           "iter_py_files"]
+
+
+class BaselineError(RuntimeError):
+    """The committed baseline is unusable (corrupt JSON, or a required
+    section is missing). The CLI turns this into exit 2 plus a one-line
+    hint instead of a traceback."""
+
+    def __init__(self, path: str, problem: str):
+        self.path, self.problem = path, problem
+        super().__init__(
+            f"baseline {path}: {problem} — run `python -m "
+            f"paddle_tpu.analysis commplan --write-baseline` (or restore "
+            f"the committed file) to regenerate it")
 
 
 def repo_root() -> str:
@@ -105,9 +119,12 @@ class Baseline:
                                         "note": "why this is accepted"}},
          "audit": {"<metric>": <pinned number>, ...}}
 
-    ``findings`` gates both prongs; ``audit`` additionally pins headline
+    ``findings`` gates all prongs; ``audit`` additionally pins headline
     numbers for the committed bench geometry (consumed by the regression
-    tests, informational for the CLI).
+    tests, informational for the CLI); ``commplan`` pins the per-axis
+    comm ledger per committed geometry (``{geometry: {axis: {"ops": n,
+    "bytes": b, "kinds": {...}}}}``) that the budget-drift pass gates
+    against.
     """
 
     def __init__(self, doc: Optional[dict] = None, path: Optional[str] = None):
@@ -115,6 +132,7 @@ class Baseline:
         self.path = path
         self.findings: Dict[str, dict] = dict(doc.get("findings", {}))
         self.audit: Dict[str, float] = dict(doc.get("audit", {}))
+        self.commplan: Dict[str, dict] = dict(doc.get("commplan", {}))
 
     # -- gating ------------------------------------------------------------
     def split(self, findings: List[Finding]):
@@ -139,7 +157,10 @@ class Baseline:
                 "where": f.where, "note": note or f.message}
 
     def to_json(self) -> dict:
-        return {"version": 1, "findings": self.findings, "audit": self.audit}
+        doc = {"version": 1, "findings": self.findings, "audit": self.audit}
+        if self.commplan:
+            doc["commplan"] = self.commplan
+        return doc
 
     def save(self, path: Optional[str] = None):
         path = path or self.path
@@ -152,10 +173,18 @@ class Baseline:
 
 def load_baseline(path: Optional[str] = None) -> Baseline:
     """Load the committed baseline (missing file = empty ledger, so a
-    fresh checkout without one simply reports everything as new)."""
+    fresh checkout without one simply reports everything as new; a file
+    that exists but does not parse raises :class:`BaselineError` — a
+    truncated merge must fail loudly, not masquerade as zero debt)."""
     p = baseline_path(path)
     try:
         with open(p) as f:
-            return Baseline(json.load(f), path=p)
+            doc = json.load(f)
     except FileNotFoundError:
         return Baseline({}, path=p)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise BaselineError(p, f"corrupt JSON ({e})") from e
+    if not isinstance(doc, dict):
+        raise BaselineError(p, f"expected a JSON object, got "
+                               f"{type(doc).__name__}")
+    return Baseline(doc, path=p)

@@ -22,6 +22,15 @@ codifies a bug this repo actually shipped and then fixed the hard way:
 - ``unjoined-thread`` (P1): a non-daemon thread started but never
   joined anywhere in its module — blocks interpreter exit and leaks
   work past the owner's lifetime.
+- ``blocking-call-under-lock`` (P0): ``time.sleep``, timeout-less
+  ``.join()``/``.result()``/``.get()``/``.wait()`` inside a
+  ``with <lock>`` body (depth-2 callees included) — the serving/
+  prefetch stall class PR 4/5 paid for at runtime: whoever else wants
+  that lock now waits on an unbounded sleep or join.
+- ``stale-suppression`` (P2, advisory unless ``--strict-suppressions``):
+  an ``# analysis: allow(<rule>)`` comment that no longer suppresses
+  anything — allow-rot; either the flagged code was fixed (delete the
+  comment) or the comment drifted away from the finding line.
 
 The linter is deliberately *lexical*: it resolves calls one–two levels
 deep within the same class/module and never imports the code it scans,
@@ -47,7 +56,8 @@ from .findings import Finding, P0, P1, iter_py_files
 __all__ = ["lint_file", "lint_tree", "RULES"]
 
 RULES = ("gc-eager-jax", "signal-unsafe-call", "trace-attr-mutation",
-         "traced-impurity", "unjoined-thread")
+         "traced-impurity", "unjoined-thread", "blocking-call-under-lock",
+         "stale-suppression")
 
 #: dotted-name suffixes whose first argument is traced by jax
 _TRACE_WRAPPERS = ("jax.jit", "jit", "jax.value_and_grad",
@@ -109,6 +119,8 @@ class _Module:
         #: attribute forms and bare names from `from signal import ...`
         self.signal_attr_roots: Set[str] = {"signal"}
         self.signal_bare_names: Set[str] = set()
+        #: (lineno, rule) allow-comments that suppressed something
+        self.used_allows: Set[Tuple[int, str]] = set()
         self._index()
 
     def _index(self):
@@ -154,11 +166,15 @@ class _Module:
         self.jnp_roots.update({"jnp", "jax.numpy"})
 
     def suppressed(self, rule: str, *linenos: int) -> bool:
+        hit = False
         for ln in linenos:
             if 0 < ln <= len(self.lines) \
                     and f"analysis: allow({rule})" in self.lines[ln - 1]:
-                return True
-        return False
+                # record every match so the stale-suppression pass knows
+                # which allow comments actually earn their keep
+                self.used_allows.add((ln, rule))
+                hit = True
+        return hit
 
     def resolve(self, name: str) -> List[ast.AST]:
         return self.by_name.get(name, [])
@@ -459,6 +475,150 @@ def _scan_signal_unsafe(mod: _Module, fn, qual, cls, findings, frontier):
                                  mod.qual.get(callee, node.func.id)))
 
 
+# -- blocking calls under a lock --------------------------------------------
+
+def _lock_item_names(node: ast.With) -> List[str]:
+    """Dotted names of with-items that look like lock/CV acquisitions
+    (same heuristic the signal pass uses — ONE definition of 'lock')."""
+    names = []
+    for item in node.items:
+        nm = (_call_name(item.context_expr)
+              if isinstance(item.context_expr, ast.Call)
+              else _dotted(item.context_expr)) or ""
+        leaf = nm.split(".")[-1].lower()
+        if "lock" in leaf or leaf in ("_cv", "cv", "cond", "condition"):
+            names.append(nm)
+    return names
+
+
+def _blocking_reason(node: ast.Call, lock_names) -> Optional[str]:
+    """Why this call must not run while holding a lock, or None."""
+    name = _call_name(node) or ""
+    if name in ("time.sleep", "sleep"):
+        return "sleeps while holding the lock"
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    leaf = name.split(".")[-1]
+    has_timeout = bool(node.args) or any(
+        kw.arg == "timeout" for kw in node.keywords)
+    if leaf == "join" and not has_timeout:
+        return "timeout-less .join() blocks until the thread exits"
+    if leaf == "result" and not has_timeout:
+        return "timeout-less Future.result() blocks on the executor"
+    if leaf == "get" and not node.args and not node.keywords:
+        return "timeout-less Queue.get() blocks until a producer runs"
+    if leaf == "wait" and not has_timeout:
+        # cv.wait() on the with-item itself RELEASES that lock while
+        # waiting — the canonical condition-variable pattern, not a hold
+        if (_dotted(node.func.value) or "") in lock_names:
+            return None
+        return "timeout-less .wait() holds the lock across the wait"
+    return None
+
+
+def _scan_blocking(mod: _Module, nodes, qual, cls, lock_names, lock_name,
+                   sup_lines, findings, frontier):
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        reason = _blocking_reason(node, lock_names)
+        if reason is not None:
+            name = _call_name(node) or "<call>"
+            if not mod.suppressed("blocking-call-under-lock",
+                                  node.lineno, *sup_lines):
+                findings.append(Finding(
+                    "blocking-call-under-lock", P0, mod.rel, qual,
+                    anchor=f"{lock_name}:{name}", line=node.lineno,
+                    message=(f"{name}() inside `with {lock_name}` — "
+                             f"{reason}; every other taker of the lock "
+                             f"stalls behind it (the serving/prefetch "
+                             f"deadlock class)")))
+        # queue self.m() / module-fn callees: they run under the lock too
+        if isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            callee = mod.resolve_method(cls, node.func.attr)
+            if callee is not None:
+                frontier.append((callee,
+                                 mod.qual.get(callee, node.func.attr)))
+        elif isinstance(node.func, ast.Name):
+            for callee in mod.resolve(node.func.id):
+                frontier.append((callee,
+                                 mod.qual.get(callee, node.func.id)))
+
+
+def _check_blocking_under_lock(mod: _Module, findings: List[Finding]):
+    for fn, qual, cls in mod.funcs:
+        def_line = getattr(fn, "lineno", 0)
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.With):
+                continue
+            locks = _lock_item_names(node)
+            if not locks:
+                continue
+            lock_name = locks[0]
+            body_nodes = []
+            stack = list(node.body)
+            while stack:
+                n = stack.pop()
+                body_nodes.append(n)
+                for child in ast.iter_child_nodes(n):
+                    # a def/lambda created under the lock runs later,
+                    # not here
+                    if not isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.Lambda)):
+                        stack.append(child)
+            seen: Set[int] = set()
+            frontier: List[Tuple[ast.AST, str]] = []
+            _scan_blocking(mod, body_nodes, qual, cls, locks, lock_name,
+                           (node.lineno, def_line), findings, frontier)
+            depth = 1
+            while frontier and depth <= 2:
+                nxt: List[Tuple[ast.AST, str]] = []
+                for callee, cq in frontier:
+                    if id(callee) in seen:
+                        continue
+                    seen.add(id(callee))
+                    # inside a callee the cv-receiver exception can't be
+                    # tracked — pass no lock_names, flag every wait()
+                    _scan_blocking(
+                        mod, _own_nodes(callee), cq, cls, (), lock_name,
+                        (getattr(callee, "lineno", 0), node.lineno,
+                         def_line), findings, nxt)
+                frontier, depth = nxt, depth + 1
+
+
+# -- stale suppressions ------------------------------------------------------
+
+_ALLOW_RE = None  # compiled lazily; ast is imported, re is not yet
+
+
+def _check_stale_suppressions(mod: _Module, findings: List[Finding]):
+    """Every ``# analysis: allow(<rule>)`` comment that no check
+    consulted is allow-rot: either the finding it silenced was fixed
+    (delete the comment) or it drifted off the line the checks look at.
+    Runs LAST — it reads ``mod.used_allows`` filled by the other rules."""
+    global _ALLOW_RE
+    if _ALLOW_RE is None:
+        import re
+        _ALLOW_RE = re.compile(r"#\s*analysis:\s*allow\(([\w\-]+)\)")
+    from .findings import P2
+    for ln, line in enumerate(mod.lines, start=1):
+        for m in _ALLOW_RE.finditer(line):
+            rule = m.group(1)
+            if (ln, rule) in mod.used_allows:
+                continue
+            code = line[:m.start()].split("#")[0].strip()
+            findings.append(Finding(
+                "stale-suppression", P2, mod.rel, "<module>",
+                anchor=f"{rule}@{code[:60]}", line=ln,
+                message=(f"allow({rule}) suppresses nothing "
+                         f"{'(unknown rule) ' if rule not in RULES else ''}"
+                         f"— the finding was fixed or the comment "
+                         f"drifted; delete it")))
+
+
 # -- threads ----------------------------------------------------------------
 
 def _check_threads(mod: _Module, findings: List[Finding]):
@@ -526,6 +686,9 @@ def lint_file(path: str, rel: Optional[str] = None) -> List[Finding]:
     _check_gc_paths(mod, findings)
     _check_signal_handlers(mod, findings)
     _check_threads(mod, findings)
+    _check_blocking_under_lock(mod, findings)
+    # must run after every suppressible check has queried mod.suppressed
+    _check_stale_suppressions(mod, findings)
     return findings
 
 

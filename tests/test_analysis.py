@@ -680,3 +680,122 @@ class TestCliAndGate:
             assert f"{name}_cpu_smoke" in metrics
         assert metrics["train_step_allreduce_count_cpu_smoke"] == \
             BASE.audit["train_step_allreduce_count"]
+
+
+# ---------------- ISSUE 12 lint satellites -----------------------------------
+
+LOCKS = textwrap.dedent("""\
+    import time
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition()
+
+        def bad(self):
+            with self._lock:
+                time.sleep(1.0)
+                self._t.join()
+                self._q.get()
+                self._fut.result()
+
+        def bounded_ok(self):
+            with self._lock:
+                self._t.join(timeout=2)
+                self._fut.result(timeout=1)
+                self._ev.wait(0.5)
+
+        def cv_ok(self):
+            with self._cv:
+                self._cv.wait()
+
+        def via_callee(self):
+            with self._lock:
+                self._drain()
+
+        def _drain(self):
+            self._t2.join()
+
+        def no_lock(self):
+            time.sleep(1.0)
+""")
+
+
+class TestBlockingUnderLock:
+    def test_blocking_calls_flagged(self, tmp_path):
+        fs = _lint_src(tmp_path, LOCKS)
+        hits = [f for f in fs if f.rule == "blocking-call-under-lock"]
+        assert all(f.severity == "P0" for f in hits)
+        anchors = {f.anchor for f in hits}
+        assert anchors == {"self._lock:time.sleep",
+                           "self._lock:self._t.join",
+                           "self._lock:self._q.get",
+                           "self._lock:self._fut.result",
+                           "self._lock:self._t2.join"}
+        # depth-1 callee hit is attributed to the callee's qualname
+        callee = [f for f in hits if f.anchor.endswith("_t2.join")]
+        assert callee[0].where == "Worker._drain"
+
+    def test_timeouts_and_cv_wait_exempt(self, tmp_path):
+        fs = _lint_src(tmp_path, LOCKS)
+        lines = {f.line for f in fs
+                 if f.rule == "blocking-call-under-lock"}
+        src_lines = LOCKS.splitlines()
+        for needle in ("join(timeout=2)", "result(timeout=1)",
+                       "wait(0.5)", "self._cv.wait()"):
+            ln = next(i for i, s in enumerate(src_lines, 1) if needle in s)
+            assert ln not in lines, f"{needle} wrongly flagged"
+
+    def test_suppression_honored(self, tmp_path):
+        allowed = LOCKS.replace(
+            "time.sleep(1.0)",
+            "time.sleep(1.0)  # analysis: allow(blocking-call-under-lock)")
+        fs = _lint_src(tmp_path, allowed)
+        anchors = {f.anchor for f in fs
+                   if f.rule == "blocking-call-under-lock"}
+        assert "self._lock:time.sleep" not in anchors
+        assert "self._lock:self._t.join" in anchors
+
+
+class TestStaleSuppressions:
+    def test_live_allow_not_reported(self, tmp_path):
+        fs = _lint_src(tmp_path, TRACE_MUT)
+        assert not [f for f in fs if f.rule == "stale-suppression"]
+
+    def test_dead_allow_reported_p2(self, tmp_path):
+        src = ("def f():\n"
+               "    return 1  # analysis: allow(gc-eager-jax)\n")
+        fs = _lint_src(tmp_path, src)
+        stale = [f for f in fs if f.rule == "stale-suppression"]
+        assert len(stale) == 1 and stale[0].severity == "P2"
+        assert "gc-eager-jax" in stale[0].anchor
+
+    def test_strict_suppressions_cli_flag(self, tmp_path, capsys):
+        from paddle_tpu.analysis.__main__ import main
+        (tmp_path / "mod.py").write_text(
+            "x = 1  # analysis: allow(unjoined-thread)\n")
+        bl = str(tmp_path / "bl.json")
+        assert main(["lint", "--root", str(tmp_path),
+                     "--baseline", bl]) == 0
+        assert "stale-suppression" in capsys.readouterr().err
+        assert main(["lint", "--root", str(tmp_path), "--baseline", bl,
+                     "--strict-suppressions"]) == 1
+        assert "stale-suppression" in capsys.readouterr().out
+
+
+class TestCommBytesReportFamily:
+    def test_prefix_membership_and_gate_direction(self):
+        bench = _bench()
+        assert bench._lower_better("train_step_comm_bytes_dp_cpu_smoke")
+        assert bench._lower_better("train_step_comm_bytes_mp")
+        assert not bench._lower_better("train_step_comm_count")
+        cmp = bench.report_compare(
+            {"train_step_comm_bytes_dp_cpu_smoke": 4739.0},
+            {"train_step_comm_bytes_dp_cpu_smoke": 6000.0},
+            tolerance_pct=5)
+        assert cmp["failures"] == ["train_step_comm_bytes_dp_cpu_smoke"]
+        cmp = bench.report_compare(
+            {"train_step_comm_bytes_dp_cpu_smoke": 4739.0}, {},
+            tolerance_pct=5)
+        assert cmp["skipped"] == ["train_step_comm_bytes_dp_cpu_smoke"]

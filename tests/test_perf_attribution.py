@@ -266,6 +266,11 @@ _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           # (ISSUE 11, docs/ANALYSIS.md) — a report-gate
                           # stdout line, not a registry family
                           "train_step_peak_hbm_bytes",
+                          # per-axis comm-plan headline family
+                          # (docs/ANALYSIS.md Prong 3) — bench.py
+                          # --audit report-gate stdout lines, not
+                          # registry families
+                          "train_step_comm_bytes_dp",
                           # HBM-ledger owner names (the {owner} label
                           # values of hbm_bytes, docs/OBSERVABILITY.md
                           # #memory), not families themselves
