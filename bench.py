@@ -777,9 +777,12 @@ def _chaos_worker():
         rng = np.random.RandomState(0)
         data = [(rng.randn(4, 8).astype(np.float32),
                  rng.randn(4, 1).astype(np.float32)) for _ in range(4)]
+        # StepTelemetry drives the StepTimer → the goodput ledger, whose
+        # per-step snapshots (PADDLE_TPU_GOODPUT_DIR) the parent folds
+        # into the job_goodput_fraction headline
         model.fit(data, epochs=(remaining + len(data) - 1) // len(data),
                   num_iters=remaining, verbose=0,
-                  callbacks=[fr, Progress()])
+                  callbacks=[fr, pt.callbacks.StepTelemetry(), Progress()])
     fr.exit_if_preempted()
 
 
@@ -805,6 +808,11 @@ def bench_chaos():
         "BENCH_CHAOS_STEPS": str(target),
         "PADDLE_TPU_CHAOS_KILL_AT_STEP": str(kill_step),
         "PADDLE_TPU_CHAOS_MARK_DIR": run_dir,  # kill fires once per job
+        # per-step goodput ledger snapshots (one file per incarnation;
+        # the launcher stamps PADDLE_TPU_GOODPUT_DOWN_AT on relaunch, so
+        # the second file's ledger carries the kill→resume gap as
+        # restart badput)
+        "PADDLE_TPU_GOODPUT_DIR": run_dir,
     })
     try:
         t0 = time.perf_counter()
@@ -830,8 +838,56 @@ def bench_chaos():
             out["mttr_s"] = round(first_after["t"] - last_before["t"], 2)
             # steps re-run because the kill outran the async commit
             out["steps_lost"] = last_before["gs"] + 1 - first_after["gs"]
+        out.update(_chaos_goodput(run_dir))
     finally:
         shutil.rmtree(run_dir, ignore_errors=True)
+    if "job_goodput_fraction" in out:
+        # report-gate headline (stdout JSON line; see _report_metrics_of)
+        import jax
+        sfx = "" if jax.default_backend() == "tpu" else "_cpu_smoke"
+        print(json.dumps({"metric": f"job_goodput_fraction{sfx}",
+                          "value": out["job_goodput_fraction"],
+                          "unit": "fraction"}))
+    return out
+
+
+def _chaos_goodput(run_dir: str) -> dict:
+    """Fold the chaos run's per-incarnation goodput ledger snapshots
+    (``goodput_rank0_<pid>.json``, written per step under
+    ``PADDLE_TPU_GOODPUT_DIR``) into the job-level accounting: summed
+    bins, the SIGKILL relaunch gap as restart badput, and the headline
+    ``job_goodput_fraction``. ``wall_coverage`` is the invariant the
+    docs promise — the bins sum to measured wall-clock (first ledger
+    birth → last classified step) within a few percent; only the
+    last-step→SIGKILL slice and the launcher's reap latency escape."""
+    import glob as _glob
+    snaps = []
+    for p in _glob.glob(os.path.join(run_dir, "goodput_rank*.json")):
+        try:
+            with open(p) as f:
+                snaps.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    if not snaps:
+        return {}
+    snaps.sort(key=lambda s: s.get("start_unix", 0.0))
+    bins = {}
+    for s in snaps:
+        for b, v in s.get("bins", {}).items():
+            bins[b] = bins.get(b, 0.0) + v
+    binned = sum(bins.values())
+    last = snaps[-1]
+    end_unix = last["start_unix"] + last["wall_s"] - \
+        last.get("bins", {}).get("restart", 0.0)
+    measured = end_unix - snaps[0]["start_unix"]
+    out = {"goodput_bins": {b: round(v, 3) for b, v in bins.items()},
+           "goodput_restart_s": round(bins.get("restart", 0.0), 3),
+           "goodput_incarnations": len(snaps)}
+    if binned > 0:
+        out["job_goodput_fraction"] = round(
+            bins.get("productive", 0.0) / binned, 4)
+    if measured > 0:
+        out["goodput_wall_coverage"] = round(binned / measured, 4)
     return out
 
 
@@ -932,6 +988,10 @@ REPORT_HIGHER_BETTER = {
     # serving throughput under the RPA kernel (ISSUE 8): bench.py
     # --serve Poisson-trace aggregate decode rate
     "serving_decode_tokens_per_sec",
+    # productive share of chaos-run wall-clock (ISSUE 13): bench.py
+    # --chaos goodput ledger headline — restart/rollback badput must
+    # not silently grow
+    "job_goodput_fraction",
 }
 REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        # step-glue fusion/overlap trajectory (ISSUE 7):

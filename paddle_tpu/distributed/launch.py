@@ -209,6 +209,7 @@ def launch(script: str, script_args: Optional[List[str]] = None,
     resume_budget = _max_resumes(max_resumes)
     cur_np = nproc_per_node  # this epoch's local trainer count (elastic)
     scale_seen = int(store.add("__scale_out", 0))
+    down_at = None  # when the previous attempt's trainers were all dead
     while True:
         cur_world = nnodes * cur_np
         procs = []
@@ -225,6 +226,11 @@ def launch(script: str, script_args: Optional[List[str]] = None,
                 "PADDLE_STORE_PORT": str(store.port),
                 "PADDLE_RESTART_EPOCH": str(epoch),
             })
+            if down_at is not None:
+                # relaunch: stamp the previous incarnation's death time
+                # so the child's GoodputLedger bins the crash→resume gap
+                # as restart badput (docs/OBSERVABILITY.md#goodput)
+                env["PADDLE_TPU_GOODPUT_DOWN_AT"] = repr(down_at)
             if log_dir:
                 os.makedirs(log_dir, exist_ok=True)
                 lf = open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
@@ -324,6 +330,7 @@ def launch(script: str, script_args: Optional[List[str]] = None,
                 p.terminate()
         for p in procs:
             p.wait()
+        down_at = time.time()  # goodput restart-gap stamp for relaunch
         for lf in logs:
             lf.close()
 
@@ -469,6 +476,7 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
             time.sleep(0.2)
         return int(store.add("__restart_epoch", 0))
 
+    down_at = None  # when the previous round's trainer died (goodput)
     while True:
         beat()
         store.set(f"__join/{epoch}/{node_rank}", b"1")
@@ -545,6 +553,10 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
             "PADDLE_STORE_PORT": str(store.port),
             "PADDLE_RESTART_EPOCH": str(epoch),
         })
+        if down_at is not None:
+            # relaunch round: stamp the previous trainer's death time for
+            # the child's goodput restart bin
+            env["PADDLE_TPU_GOODPUT_DOWN_AT"] = repr(down_at)
         lf = None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -606,6 +618,7 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
         if proc.poll() is None:
             proc.terminate()
         proc.wait()
+        down_at = time.time()  # goodput restart-gap stamp for relaunch
         if lf:
             lf.close()
 

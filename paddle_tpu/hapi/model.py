@@ -195,7 +195,8 @@ class StepTelemetry(Callback):
     fit loop's batch hooks, recording per-step time decomposition
     (data / compute / collective), samples-per-sec, optional tokens-per-sec
     and an MFU estimate into the metrics registry — and injects the same
-    stats into the batch ``logs`` so ProgBarLogger/VisualDL surface them.
+    stats (plus the goodput ledger's running ``goodput_fraction``) into
+    the batch ``logs`` so ProgBarLogger/VisualDL surface them.
 
     ``flops_per_sample``: training FLOPs per sample (fwd+bwd+update); when
     omitted, a ``flops_per_sample`` attribute on the network is used if
@@ -237,7 +238,7 @@ class StepTelemetry(Callback):
         self.last_stats = stats
         if logs is not None:
             for k in ("step_time_s", "samples_per_sec", "tokens_per_sec",
-                      "mfu"):
+                      "mfu", "goodput_fraction"):
                 if k in stats:
                     logs[k] = stats[k]
 

@@ -37,6 +37,7 @@ Step-glue fast paths (docs/PERFORMANCE.md):
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict
 
 import jax
@@ -599,6 +600,10 @@ class TrainStep:
             self._plans[key] = (layout, comm, reason)
             self._cache[key] = self._compile(treedef, layout, comm,
                                              flat_example)
+            # jax.jit compiles lazily on the first concrete call — mark
+            # this executable fresh so __call__ stamps that call's wall
+            # into the goodput ledger's compile bin
+            self._fresh_executable = True
         layout, comm, reason = self._plans[key]
         self._layout, self._comm_buckets, self._bucketed_reason = \
             layout, comm, reason
@@ -626,6 +631,13 @@ class TrainStep:
         # window for the comm tracer's exposure accounting — a collective
         # running concurrently (bucketed async all-reduce) is overlapped,
         # one serialized after it is exposed
+        # first call of a freshly built executable carries the real XLA
+        # compile (jit is lazy): time it for the goodput ledger. The
+        # wall includes one execution — negligible next to the compile,
+        # and exactly how fleet goodput accounting bins warmup steps.
+        fresh = getattr(self, "_fresh_executable", False)
+        self._fresh_executable = False
+        t_compile0 = time.perf_counter() if fresh else 0.0
         with RecordEvent("TrainStep"), compute_scope():
             try:
                 loss_val, new_train, new_states, new_bufs = \
@@ -641,6 +653,10 @@ class TrainStep:
                         compiled.lower(*call_args).compile(),
                         source="train_step"))
                 raise
+
+        if fresh:
+            from paddle_tpu.observability import goodput
+            goodput.record_compile(time.perf_counter() - t_compile0)
 
         # write back (storage replacement — same semantics as eager step())
         opt._step_count += 1

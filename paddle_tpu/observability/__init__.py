@@ -29,13 +29,22 @@ Eight pieces (see docs/OBSERVABILITY.md):
 - **profile** — bounded on-demand ``jax.profiler`` capture windows
   (``PADDLE_TPU_PROFILE_AT_STEP``, ``POST /debug/profile``,
   ``bench.py --profile``).
+- **fleet** — live cross-rank telemetry bus over the job TCPStore:
+  per-step heartbeats, a rank-0 ``FleetAggregator`` with online
+  straggler detection, and the ``/fleetz`` JSON rollup
+  (``PADDLE_TPU_FLEET``).
+- **goodput** — the per-rank :class:`GoodputLedger` classifying all
+  wall-clock into productive/compile/checkpoint/data-stall/exposed-
+  collective/restart/rollback bins (``goodput_seconds_total{bin}``,
+  ``job_goodput_fraction``).
 
 Importing this package applies the env gates (a no-op when the vars are
 unset), so ``import paddle_tpu`` alone arms the exporter/recorder/tracer
 in production jobs.
 """
 from . import (  # noqa: F401
-    comm, flight_recorder, memory, metrics, profile, step_timer, trace,
+    comm, fleet, flight_recorder, goodput, memory, metrics, profile,
+    step_timer, trace,
 )
 from .comm import (  # noqa: F401
     comm_scope, comm_totals, compute_scope, payload_bytes,
@@ -47,7 +56,7 @@ from .metrics import (  # noqa: F401
 from .step_timer import StepTimer, peak_flops  # noqa: F401
 
 __all__ = ["metrics", "step_timer", "comm", "flight_recorder", "trace",
-           "memory", "profile",
+           "memory", "profile", "fleet", "goodput",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "get_registry", "start_exporter", "maybe_start_exporter",
            "StepTimer", "peak_flops", "comm_scope", "comm_totals",
@@ -57,3 +66,4 @@ __all__ = ["metrics", "step_timer", "comm", "flight_recorder", "trace",
 metrics.maybe_start_exporter()
 flight_recorder.maybe_enable_from_env()
 trace.maybe_enable_from_env()
+fleet.maybe_enable_from_env()

@@ -1,0 +1,448 @@
+"""Fleet telemetry bus — live cross-rank heartbeats over the job TCPStore.
+
+Every cross-rank question used to be answered offline: ``trace merge``
+reconstructs straggler tables from span files after the job is dead.
+This module answers them *while the job runs*:
+
+- each rank publishes a compact **heartbeat** per step (step id,
+  step/data/collective/exposed seconds, HBM in use, last
+  flight-recorder event kind, goodput bins) to the job TCPStore under
+  the epoch-namespaced key ``__fleet/{epoch}/hb/{rank}`` — the same
+  control plane the preemption/rendezvous layers already ride;
+- rank 0 runs a :class:`FleetAggregator` daemon thread folding the
+  heartbeats into job-wide rollups: rank liveness (a heartbeat older
+  than ``PADDLE_TPU_FLEET_STALE_S`` flips the rank to ``missing``), a
+  rolling-median step time, **online straggler detection** (a rank
+  > k×median for M consecutive *new* heartbeats raises
+  ``fleet_straggler{rank}`` and a once-per-incident flight-recorder
+  event), and the fleet-wide ``job_goodput_fraction``;
+- the whole picture is served as JSON on ``/fleetz`` (metrics exporter
+  and serving ``Server``) via :func:`fleetz_snapshot`, which degrades
+  to a local-ledger-only view on ranks without an aggregator.
+
+Heartbeat lanes are keyed **by rank**, so a crashed-and-relaunched rank
+(new pid, same rank id) replaces its lane instead of duplicating it.
+
+The publish path is a module-global seam (``_publisher``), read once per
+step by :meth:`StepTimer.end_step` — zero cost when the bus is off.
+Arming is env-gated (:func:`maybe_enable_from_env`): on by default when
+``PADDLE_MASTER`` names a job store, killed by ``PADDLE_TPU_FLEET=0``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from . import flight_recorder, goodput
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["HeartbeatPublisher", "FleetAggregator", "fleet_metrics",
+           "publish_step", "note_step", "last_step_age_seconds",
+           "healthz_fields", "fleetz_snapshot", "recent_heartbeats",
+           "enable", "disable", "maybe_enable_from_env"]
+
+#: last N heartbeats kept locally for postmortem appendices
+_RECENT = 32
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _world() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    except ValueError:
+        return 1
+
+
+def _epoch() -> str:
+    return os.environ.get("PADDLE_RESTART_EPOCH", "0")
+
+
+def _hb_key(rank: int) -> str:
+    return f"__fleet/{_epoch()}/hb/{rank}"
+
+
+def job_id() -> str:
+    """The operator-visible job identity: ``PADDLE_TPU_JOB_ID``, falling
+    back to the store address (every rank of a job shares it)."""
+    return os.environ.get("PADDLE_TPU_JOB_ID") or \
+        os.environ.get("PADDLE_MASTER", "local")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def fleet_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The ``fleet_*`` metric families (created on first use) — the
+    docs-drift gate instantiates this accessor."""
+    r = registry or get_registry()
+    return {
+        "heartbeats": r.counter(
+            "fleet_heartbeats_total", "heartbeat records published"),
+        "straggler": r.gauge(
+            "fleet_straggler",
+            "1 while the rank is flagged as a straggler, by rank"),
+        "live": r.gauge("fleet_ranks_live",
+                        "ranks with a fresh heartbeat"),
+        "missing": r.gauge(
+            "fleet_ranks_missing",
+            "ranks whose last heartbeat is past the staleness window"),
+        "median": r.gauge("fleet_step_seconds_median",
+                          "fleet-wide rolling-median step time"),
+    }
+
+
+def _default_store():
+    from paddle_tpu.distributed.tcp_store import job_store
+    return job_store()
+
+
+class HeartbeatPublisher:
+    """Per-rank heartbeat emitter. ``store`` is anything with
+    ``set(key, value)`` (the job TCPStore in production, a dict-backed
+    fake in tests); it is resolved lazily so constructing the publisher
+    never blocks on a socket."""
+
+    def __init__(self, store=None, rank: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self._store = store
+        self.rank = _rank() if rank is None else int(rank)
+        self._m = fleet_metrics(registry)
+        self.recent: deque = deque(maxlen=_RECENT)
+        self._broken = False
+
+    def _resolve_store(self):
+        if self._store is None:
+            self._store = _default_store()
+        return self._store
+
+    def publish(self, step: int, stats: dict):
+        """Fold the StepTimer's per-step stats (plus HBM, last FR event
+        kind, and the goodput snapshot) into one compact record and set
+        it on the bus. Never raises — a dead store must not fail a
+        training step (the aggregator sees the rank go ``missing``)."""
+        rec = {
+            "rank": self.rank, "pid": os.getpid(), "step": int(step),
+            "t": time.time(),
+            "step_time_s": round(float(stats.get("step_time_s", 0.0)), 6),
+            "data_time_s": round(float(stats.get("data_time_s", 0.0)), 6),
+            "collective_time_s": round(
+                float(stats.get("collective_time_s", 0.0)), 6),
+            "exposed_collective_time_s": round(
+                float(stats.get("exposed_collective_time_s", 0.0)), 6),
+            "hbm_in_use": _hbm_in_use(),
+            "last_event": _last_event_kind(),
+        }
+        snap = goodput.snapshot()
+        if snap is not None:
+            rec["goodput"] = {"bins": snap["bins"],
+                              "wall_s": snap["wall_s"],
+                              "fraction": snap["job_goodput_fraction"]}
+        self.recent.append(rec)
+        if self._broken:
+            return
+        try:
+            self._resolve_store().set(_hb_key(self.rank), json.dumps(rec))
+            self._m["heartbeats"].inc()
+        except Exception:
+            # one warning, then stay quiet: the bus is telemetry, the
+            # step loop is the product
+            self._broken = True
+            import warnings
+            warnings.warn("[fleet] heartbeat publish failed; bus disabled "
+                          "for this process", RuntimeWarning, stacklevel=2)
+
+
+def _hbm_in_use() -> int:
+    from . import memory
+    try:
+        snap = memory.snapshot()
+        # CPU backends report no bytes_in_use; the named-owner ledger
+        # total is the best available proxy there
+        return int(snap.get("bytes_in_use") or snap.get("named_bytes") or 0)
+    except Exception:
+        return 0
+
+
+def _last_event_kind() -> Optional[str]:
+    return flight_recorder.last_kind()
+
+
+class FleetAggregator:
+    """Rank 0's folding thread (usable un-started, via :meth:`poll_once`,
+    for deterministic tests).
+
+    Lanes are keyed by rank — a relaunched rank's new-pid heartbeat
+    *replaces* its lane. A lane whose heartbeat is older than
+    ``stale_s`` reports ``status="missing"`` (the record is kept: the
+    postmortem wants the rank's last known state). Straggler detection
+    only advances on *new* heartbeats (step id moved), so a slow poller
+    never double-counts one record."""
+
+    def __init__(self, store=None, world: Optional[int] = None,
+                 interval: Optional[float] = None,
+                 stale_s: Optional[float] = None,
+                 k: Optional[float] = None, m: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None):
+        self._store = store
+        self.world = _world() if world is None else int(world)
+        self.interval = _env_float("PADDLE_TPU_FLEET_INTERVAL", 1.0) \
+            if interval is None else float(interval)
+        self.stale_s = _env_float("PADDLE_TPU_FLEET_STALE_S", 15.0) \
+            if stale_s is None else float(stale_s)
+        self.k = _env_float("PADDLE_TPU_FLEET_STRAGGLER_K", 1.5) \
+            if k is None else float(k)
+        self.m = int(_env_float("PADDLE_TPU_FLEET_STRAGGLER_STEPS", 3)) \
+            if m is None else int(m)
+        self._m = fleet_metrics(registry)
+        self._lock = threading.Lock()
+        self.lanes: dict = {}           # rank -> last parsed record
+        self._seen_step: dict = {}      # rank -> last step id counted
+        self._slow_streak: dict = {}    # rank -> consecutive slow steps
+        self.stragglers: set = set()
+        self.fleet_goodput: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _resolve_store(self):
+        if self._store is None:
+            self._store = _default_store()
+        return self._store
+
+    # -- one fold ----------------------------------------------------------
+    def poll_once(self, now: Optional[float] = None) -> dict:
+        """Read every rank's lane, update liveness/straggler/goodput
+        state, refresh the ``fleet_*`` gauges; returns the rollup dict
+        (what ``/fleetz`` serves). Store/parse failures degrade to the
+        previous state — the aggregator must survive a dying job."""
+        now = time.time() if now is None else now
+        try:
+            store = self._resolve_store()
+            for rank in range(self.world):
+                raw = store.get(_hb_key(rank))
+                if not raw:
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except (ValueError, TypeError):
+                    continue  # torn/garbage write: keep the old lane
+                with self._lock:
+                    self.lanes[rank] = rec
+        except Exception:
+            pass  # store unreachable this tick: age-out still runs
+        with self._lock:
+            lanes = dict(self.lanes)
+        live, missing = [], []
+        for rank, rec in lanes.items():
+            (missing if now - rec.get("t", 0) > self.stale_s
+             else live).append(rank)
+        self._detect_stragglers(lanes, live)
+        self._fold_goodput(lanes)
+        self._m["live"].set(len(live))
+        self._m["missing"].set(len(missing) +
+                               max(self.world - len(lanes), 0))
+        return self.rollup(now=now)
+
+    def _detect_stragglers(self, lanes: dict, live: list):
+        times = [lanes[r].get("step_time_s", 0.0) for r in live]
+        times = [t for t in times if t > 0]
+        if len(times) < 2:
+            return
+        median = statistics.median(times)
+        self._m["median"].set(median)
+        for rank in live:
+            rec = lanes[rank]
+            step = rec.get("step")
+            if step is None or self._seen_step.get(rank) == step:
+                continue  # no new heartbeat since the last fold
+            self._seen_step[rank] = step
+            slow = rec.get("step_time_s", 0.0) > self.k * median
+            streak = self._slow_streak.get(rank, 0) + 1 if slow else 0
+            self._slow_streak[rank] = streak
+            if slow and streak >= self.m and rank not in self.stragglers:
+                self.stragglers.add(rank)
+                self._m["straggler"].set(1, rank=rank)
+                t = time.time_ns()
+                flight_recorder.record(
+                    flight_recorder.KIND_USER, f"fleet_straggler_rank{rank}",
+                    t, t, aux=rank,
+                    args={"step_time_s": rec.get("step_time_s"),
+                          "median_s": round(median, 6), "step": step})
+            elif not slow and rank in self.stragglers:
+                self.stragglers.discard(rank)
+                self._m["straggler"].set(0, rank=rank)
+
+    def _fold_goodput(self, lanes: dict):
+        prod = wall = 0.0
+        bins: dict = {}
+        for rec in lanes.values():
+            g = rec.get("goodput")
+            if not g:
+                continue
+            wall += g.get("wall_s", 0.0)
+            for b, v in g.get("bins", {}).items():
+                bins[b] = bins.get(b, 0.0) + v
+            prod += g.get("bins", {}).get("productive", 0.0)
+        if wall > 0:
+            frac = prod / wall
+            self.fleet_goodput = {
+                "bins": {b: round(v, 6) for b, v in bins.items()},
+                "wall_s": round(wall, 6),
+                "job_goodput_fraction": round(frac, 6)}
+            goodput.goodput_metrics()["fraction"].set(frac)
+
+    def rollup(self, now: Optional[float] = None) -> dict:
+        now = time.time() if now is None else now
+        with self._lock:
+            lanes = dict(self.lanes)
+        ranks = {}
+        for rank, rec in sorted(lanes.items()):
+            age = now - rec.get("t", now)
+            ranks[str(rank)] = {
+                **rec, "age_s": round(age, 3),
+                "status": "missing" if age > self.stale_s else "live",
+                "straggler": rank in self.stragglers}
+        return {"world": self.world, "ranks": ranks,
+                "stragglers": sorted(self.stragglers),
+                "goodput": self.fleet_goodput}
+
+    # -- thread lifecycle --------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="pt-fleet-aggregator", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:
+                pass  # next tick retries; the bus must outlive bad data
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+
+# -- module seams (read by StepTimer / serving engine every step) ----------
+_publisher: Optional[HeartbeatPublisher] = None
+_aggregator: Optional[FleetAggregator] = None
+_last_step_mono: Optional[float] = None
+
+
+def note_step():
+    """Stamp 'a step just finished' — feeds ``last_step_age_seconds`` on
+    ``/healthz`` (train steps via StepTimer, serving via the engine)."""
+    global _last_step_mono
+    _last_step_mono = time.monotonic()
+
+
+def last_step_age_seconds() -> Optional[float]:
+    return None if _last_step_mono is None \
+        else time.monotonic() - _last_step_mono
+
+
+def publish_step(step: int, stats: dict):
+    """StepTimer's per-step hook: one attribute read when the bus is
+    off."""
+    pub = _publisher
+    if pub is not None:
+        pub.publish(step, stats)
+
+
+def recent_heartbeats() -> list:
+    """The last N locally-published heartbeats (postmortem appendix)."""
+    pub = _publisher
+    return list(pub.recent) if pub is not None else []
+
+
+def healthz_fields() -> dict:
+    """The wedged-but-listening probe fields shared by the serving
+    ``Server`` and the metrics exporter's ``/healthz``."""
+    age = last_step_age_seconds()
+    return {"rank": _rank(), "job_id": job_id(),
+            "last_step_age_seconds":
+                None if age is None else round(age, 3)}
+
+
+def fleetz_snapshot() -> dict:
+    """The ``/fleetz`` document. With an aggregator (rank 0): the full
+    fleet rollup. Without: a local-only view (this rank's last
+    heartbeat + goodput ledger), so the endpoint is useful on every
+    rank and in single-process runs."""
+    doc = {"job_id": job_id(), "epoch": _epoch(), "rank": _rank(),
+           "unix_time": time.time(), **healthz_fields()}
+    agg = _aggregator
+    if agg is not None:
+        agg.poll_once()
+        doc.update(aggregator=True, **agg.rollup())
+    else:
+        pub = _publisher
+        doc.update(aggregator=False, world=_world(),
+                   ranks={}, stragglers=[], goodput=None)
+        if pub is not None and pub.recent:
+            doc["ranks"] = {str(pub.rank): pub.recent[-1]}
+    local = goodput.snapshot()
+    doc["local_goodput"] = local
+    return doc
+
+
+# -- arming ----------------------------------------------------------------
+def enable(store=None, rank: Optional[int] = None,
+           world: Optional[int] = None,
+           start_aggregator: Optional[bool] = None):
+    """Arm the bus: every rank gets a publisher; rank 0 (or
+    ``start_aggregator=True``) also gets a polling aggregator."""
+    global _publisher, _aggregator
+    if _publisher is None:
+        _publisher = HeartbeatPublisher(store=store, rank=rank)
+    if start_aggregator is None:
+        start_aggregator = _publisher.rank == 0
+    if start_aggregator and _aggregator is None:
+        _aggregator = FleetAggregator(store=store, world=world).start()
+    return _publisher
+
+
+def disable():
+    global _publisher, _aggregator
+    agg, _aggregator = _aggregator, None
+    if agg is not None:
+        agg.stop()
+    _publisher = None
+
+
+def maybe_enable_from_env():
+    """Import-time gate: the bus arms itself in any job that has a
+    control-plane store (``PADDLE_MASTER``), unless ``PADDLE_TPU_FLEET=0``;
+    ``PADDLE_TPU_FLEET=1`` forces it on without a store (local fallback
+    views only). Never raises — this runs at ``import paddle_tpu``."""
+    flag = os.environ.get("PADDLE_TPU_FLEET", "").strip()
+    if flag == "0":
+        return None
+    if flag not in ("1", "true", "on") and \
+            not os.environ.get("PADDLE_MASTER"):
+        return None
+    try:
+        return enable()
+    except Exception:
+        return None

@@ -183,6 +183,8 @@ class FlightRecorder:
         self._dumped = None
 
     def record(self, kind, name, start_ns, end_ns, tid=0, aux=0, args=None):
+        global _last_kind
+        _last_kind = kind
         self._ring.record(kind, name, start_ns, end_ns, tid, aux, args)
 
     def events(self):
@@ -199,7 +201,7 @@ class FlightRecorder:
                 d, f"flight_recorder_rank{info['rank']}_{os.getpid()}.json")
         doc = {"reason": reason, "unix_time": time.time(), **info,
                "capacity": self.capacity, "native_ring": self.native,
-               "events": self.events()}
+               "events": self.events(), **_ledger_appendix()}
         with open(path, "w") as f:
             json.dump(doc, f, indent=1)
         self._dumped = path
@@ -302,6 +304,39 @@ def disable():
     _uninstall_handlers()
     rec, _active = _active, None
     rec.close()
+
+
+#: kind of the most recent event — a plain module global (GIL-atomic
+#: write on the record hot path); the fleet heartbeat reads it per step
+_last_kind = None
+
+
+def last_kind() -> Optional[str]:
+    """Name of the most recently recorded event kind (or None)."""
+    return _KIND_NAMES.get(_last_kind)
+
+
+def _ledger_appendix() -> dict:
+    """Postmortem appendix: the current goodput ledger snapshot and the
+    last N fleet heartbeats, so a hung-job dump names the rank that
+    stalled first. Lazy imports (fleet imports this module) and broad
+    guards — an appendix must never lose the ring dump itself."""
+    out = {}
+    try:
+        from . import goodput
+        snap = goodput.snapshot()
+        if snap is not None:
+            out["goodput"] = snap
+    except Exception:
+        pass
+    try:
+        from . import fleet
+        hbs = fleet.recent_heartbeats()
+        if hbs:
+            out["heartbeats"] = hbs
+    except Exception:
+        pass
+    return out
 
 
 def active() -> Optional[FlightRecorder]:

@@ -1,0 +1,274 @@
+"""Goodput ledger — classify *all* wall-clock into named bins.
+
+MFU says how fast a step runs; it says nothing about the minutes a job
+spends compiling, blocked on a checkpoint, stalled on data, re-running
+discarded steps after a NaN rollback, or dead between a SIGKILL and the
+relaunch. Fleet-scale TPU operations treat the fraction of wall-clock
+that is *productive training* — "goodput" — as the headline efficiency
+number (PAPERS.md 2605.25645). :class:`GoodputLedger` is the per-rank
+accountant:
+
+- **bins** (``goodput_seconds_total{bin=...}``): ``productive``,
+  ``compile``, ``checkpoint``, ``data_stall``, ``exposed_collective``,
+  ``restart``, ``rollback_discarded``, and the computed remainder
+  ``other_overhead`` — so the bins always sum to measured wall-clock by
+  construction;
+- **feeds**: :class:`~.step_timer.StepTimer` calls :func:`on_step` with
+  its per-step decomposition; ``TrainStep._prepare`` stamps compile
+  walls via :func:`record_compile`; the ``ckpt_blocking_seconds``
+  histogram is diffed per step; the elastic launcher stamps the
+  relaunch gap into ``PADDLE_TPU_GOODPUT_DOWN_AT`` (consumed once at
+  ledger creation → the ``restart`` bin); ``NaNGuard`` reclassifies
+  rolled-back steps via :func:`discard_recent_steps`;
+- **exposition**: ``goodput_seconds_total{bin}`` counter +
+  ``job_goodput_fraction`` gauge, the ``/fleetz`` endpoint (via
+  :mod:`.fleet`), the ``StepTelemetry`` console line, and — when
+  ``PADDLE_TPU_GOODPUT_DIR`` is set — an atomically-replaced per-rank
+  snapshot file ``goodput_rank<r>_<pid>.json`` after every step (the
+  cross-process read path for ``bench.py --chaos`` and postmortems).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["GoodputLedger", "BINS", "get_ledger", "on_step", "snapshot",
+           "record_compile", "discard_recent_steps", "goodput_metrics"]
+
+#: the taxonomy — every second of wall-clock lands in exactly one bin
+BINS = ("productive", "compile", "checkpoint", "data_stall",
+        "exposed_collective", "restart", "rollback_discarded",
+        "other_overhead")
+
+#: how many per-step productive contributions the ledger remembers for
+#: NaN-rollback reclassification (a rollback never spans more than the
+#: checkpoint cadence, which is far below this)
+_DISCARD_WINDOW = 256
+
+# compile walls land here before the step that contains them finishes —
+# TrainStep._prepare runs *inside* the step bracket, so on_step drains
+# this and subtracts it from the step's productive share
+_pending_compile_lock = threading.Lock()
+_pending_compile_s = 0.0
+
+
+def record_compile(seconds: float):
+    """Stamp a jit-compile wall (called from ``TrainStep._prepare`` and
+    the serving engine's executable build); drained by the next
+    :func:`on_step`, or folded straight into the ledger's ``compile``
+    bin if no step ever completes (a compile-then-crash run)."""
+    global _pending_compile_s
+    if seconds <= 0:
+        return
+    with _pending_compile_lock:
+        _pending_compile_s += float(seconds)
+
+
+def _drain_pending_compile() -> float:
+    global _pending_compile_s
+    with _pending_compile_lock:
+        s, _pending_compile_s = _pending_compile_s, 0.0
+    return s
+
+
+def goodput_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The ``goodput_*`` / ``job_*`` metric families (created on first
+    use) — the docs-drift gate instantiates this accessor."""
+    r = registry or get_registry()
+    return {
+        "seconds": r.counter(
+            "goodput_seconds_total",
+            "wall-clock accounted into goodput bins, by bin"),
+        "fraction": r.gauge(
+            "job_goodput_fraction",
+            "productive share of wall-clock since ledger start (0..1)"),
+    }
+
+
+class GoodputLedger:
+    """Per-rank wall-clock accountant (see module docstring).
+
+    The ledger starts its wall at construction; ``other_overhead`` is
+    *derived* (wall minus the explicit bins) so the snapshot always sums
+    to measured wall-clock — the invariant ``bench.py --chaos`` gates
+    within 5%.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 down_at: Optional[float] = None):
+        self.registry = registry or get_registry()
+        m = goodput_metrics(self.registry)
+        self._c_seconds = m["seconds"]
+        self._g_fraction = m["fraction"]
+        self._lock = threading.Lock()
+        self._bins = {b: 0.0 for b in BINS if b != "other_overhead"}
+        self._recent: deque = deque(maxlen=_DISCARD_WINDOW)
+        self._ckpt_sum0 = self._ckpt_blocking_sum()
+        self.start_unix = time.time()
+        self._start_mono = time.perf_counter()
+        self.steps = 0
+        # the launcher stamps the previous incarnation's death time into
+        # the relaunch env — the gap from death to *this* ledger's birth
+        # is restart badput, charged once, up front
+        if down_at is None:
+            raw = os.environ.get("PADDLE_TPU_GOODPUT_DOWN_AT")
+            try:
+                down_at = float(raw) if raw else None
+            except ValueError:
+                down_at = None
+        if down_at is not None:
+            gap = self.start_unix - down_at
+            if gap > 0:
+                self._add("restart", gap)
+
+    # -- feeds -------------------------------------------------------------
+    def _add(self, bin: str, seconds: float):
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._bins[bin] += seconds
+        self._c_seconds.inc(seconds, bin=bin)
+
+    def _ckpt_blocking_sum(self) -> float:
+        """Current sum of the default registry's ``ckpt_blocking_seconds``
+        histogram across label sets — checkpoint writers always record
+        there (same reason the comm counters do)."""
+        h = get_registry().get("ckpt_blocking_seconds")
+        if h is None:
+            return 0.0
+        with h._lock:
+            return float(sum(st["sum"] for st in h._samples.values()))
+
+    def on_step(self, stats: dict) -> dict:
+        """Classify one finished step from the StepTimer's decomposition.
+        Returns ``{"compile_s", "ckpt_s", "goodput_fraction"}`` so the
+        caller can embed them in the step's trace span (the offline
+        ``trace merge --goodput`` path replays this exact split)."""
+        total = float(stats.get("step_time_s", 0.0))
+        data = float(stats.get("data_time_s", 0.0))
+        exposed = float(stats.get("exposed_collective_time_s", 0.0))
+        compile_s = _drain_pending_compile()
+        ckpt_sum = self._ckpt_blocking_sum()
+        ckpt_s = max(ckpt_sum - self._ckpt_sum0, 0.0)
+        self._ckpt_sum0 = ckpt_sum
+        # overhead shares are capped by the step wall they occurred in
+        # (an async checkpoint blocking longer than the step cannot
+        # charge more than the step paid for it)
+        overhead = min(data + exposed + compile_s + ckpt_s, total)
+        productive = total - overhead
+        self._add("data_stall", data)
+        self._add("exposed_collective", exposed)
+        self._add("compile", compile_s)
+        self._add("checkpoint", ckpt_s)
+        self._add("productive", productive)
+        with self._lock:
+            self.steps += 1
+            self._recent.append(productive)
+        snap = self.snapshot()
+        self._maybe_write(snap)
+        return {"compile_s": compile_s, "ckpt_s": ckpt_s,
+                "goodput_fraction": snap["job_goodput_fraction"]}
+
+    def discard_recent_steps(self, n: int) -> float:
+        """NaN-rollback reclassification: the last ``n`` steps' work was
+        just thrown away by a checkpoint restore — move their productive
+        seconds into ``rollback_discarded``. Returns the moved wall."""
+        moved = 0.0
+        with self._lock:
+            for _ in range(min(int(n), len(self._recent))):
+                moved += self._recent.pop()
+            if moved > 0:
+                self._bins["productive"] -= moved
+                self._bins["rollback_discarded"] += moved
+        if moved > 0:
+            self._c_seconds.inc(moved, bin="rollback_discarded")
+            # counters only go up: productive's counter keeps its total,
+            # but the snapshot (the number every consumer reads) moves
+        return moved
+
+    def record(self, bin: str, seconds: float):
+        """Direct feed for bins without a dedicated seam (tests, the
+        launcher's in-process restart accounting)."""
+        if bin not in self._bins:
+            raise ValueError(f"unknown goodput bin {bin!r}; one of {BINS}")
+        self._add(bin, seconds)
+
+    # -- exposition --------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Bins + derived ``other_overhead`` + ``job_goodput_fraction``;
+        sums to ``wall_s`` by construction."""
+        now = time.perf_counter()
+        wall = max(now - self._start_mono, 0.0)
+        with self._lock:
+            bins = dict(self._bins)
+        # restart badput predates the ledger's own wall: the accounted
+        # span is (down_at .. now), not (start .. now)
+        span = wall + bins.get("restart", 0.0)
+        explicit = sum(bins.values())
+        bins["other_overhead"] = max(span - explicit, 0.0)
+        # clamp: perf_counter vs caller-supplied data_time drift can put
+        # the explicit bins a hair over the measured span
+        frac = min(bins["productive"] / span, 1.0) if span > 0 else 0.0
+        self._g_fraction.set(frac)
+        return {"bins": {b: round(bins[b], 6) for b in BINS},
+                "wall_s": round(span, 6), "steps": self.steps,
+                "start_unix": self.start_unix, "pid": os.getpid(),
+                "job_goodput_fraction": round(frac, 6)}
+
+    def _maybe_write(self, snap: dict):
+        d = os.environ.get("PADDLE_TPU_GOODPUT_DIR")
+        if not d:
+            return
+        try:
+            os.makedirs(d, exist_ok=True)
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+            path = os.path.join(d, f"goodput_rank{rank}_{os.getpid()}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # snapshot files are best-effort; never fail a step
+
+
+#: the process ledger — StepTimer reads this attribute every step, so it
+#: stays a plain module global (same seam pattern as trace._active)
+_ledger: Optional[GoodputLedger] = None
+
+
+def get_ledger() -> GoodputLedger:
+    """The process-wide ledger, created on first use (its wall starts
+    then — at the top of the first fit/serve loop, not at import)."""
+    global _ledger
+    if _ledger is None:
+        _ledger = GoodputLedger()
+    return _ledger
+
+
+def reset_ledger():
+    """Drop the process ledger (tests)."""
+    global _ledger
+    _ledger = None
+
+
+def on_step(stats: dict) -> dict:
+    return get_ledger().on_step(stats)
+
+
+def discard_recent_steps(n: int) -> float:
+    led = _ledger
+    return led.discard_recent_steps(n) if led is not None else 0.0
+
+
+def snapshot() -> Optional[dict]:
+    """The process ledger's snapshot, or None before the first step —
+    postmortem appendices must not *create* a ledger at dump time (its
+    wall would be zero and the fraction meaningless)."""
+    led = _ledger
+    return led.snapshot() if led is not None else None

@@ -312,9 +312,11 @@ def get_registry() -> MetricsRegistry:
 class MetricsExporter:
     """Background HTTP exposition server (daemon thread).
 
-    Serves ``/metrics`` (Prometheus text) and ``/metrics.json`` on
-    ``port`` (0 picks an ephemeral port — ``self.port`` holds the bound
-    one)."""
+    Serves ``/metrics`` (Prometheus text), ``/metrics.json``,
+    ``/fleetz`` (the fleet/goodput rollup) and ``/healthz``
+    (rank/job_id/last_step_age_seconds — the wedged-but-listening probe)
+    on ``port`` (0 picks an ephemeral port — ``self.port`` holds the
+    bound one)."""
 
     def __init__(self, port: int, registry: Optional[MetricsRegistry] = None,
                  host: str = "127.0.0.1"):
@@ -330,6 +332,17 @@ class MetricsExporter:
                 elif self.path.startswith("/metrics"):
                     body = registry.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/fleetz"):
+                    # lazy import: metrics is the substrate everything
+                    # else imports, so it cannot import fleet at top
+                    from . import fleet
+                    body = json.dumps(fleet.fleetz_snapshot()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/healthz"):
+                    from . import fleet
+                    body = json.dumps(
+                        {"status": "ok", **fleet.healthz_fields()}).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return

@@ -15,7 +15,7 @@ from __future__ import annotations
 import time
 from typing import Optional
 
-from . import flight_recorder, memory, trace
+from . import fleet, flight_recorder, goodput, memory, trace
 from .comm import comm_totals
 from .metrics import MetricsRegistry, get_registry
 
@@ -97,6 +97,14 @@ class StepTimer:
         self._comm0 = None
         self._step_index = 0
         self.last = None
+        # birth the process goodput ledger HERE (top of the fit loop),
+        # not lazily at the first end_step — the ledger's wall must
+        # already be running when step 1's seconds are classified, or
+        # the fraction exceeds 1
+        try:
+            goodput.get_ledger()
+        except Exception:
+            pass
 
     def begin_step(self, data_time: float = 0.0):
         self._data_time = float(data_time)
@@ -147,6 +155,17 @@ class StepTimer:
             tps = tokens / total
             stats["tokens_per_sec"] = tps
             self._g_tps.set(tps)
+        # goodput classification: every second of this step lands in a
+        # ledger bin; the compile/ckpt shares it discovered ride along in
+        # the stats (and the trace step span) so the offline
+        # `trace merge --goodput` path replays the exact same split
+        try:
+            g = goodput.on_step(stats)
+            stats["compile_s"] = g["compile_s"]
+            stats["ckpt_s"] = g["ckpt_s"]
+            stats["goodput_fraction"] = g["goodput_fraction"]
+        except Exception:
+            pass  # the accountant must never fail a step
         flight_recorder.record(
             flight_recorder.KIND_STEP, "train_step",
             int((t1 - total) * 1e9), int(t1 * 1e9),
@@ -159,6 +178,13 @@ class StepTimer:
         except Exception:
             pass  # the memory instrument must never fail a step
         self._step_index += 1
+        # fleet bus: stamp liveness and publish this step's heartbeat
+        # (both are single-attribute-read no-ops when the bus is off)
+        fleet.note_step()
+        try:
+            fleet.publish_step(self._step_index, stats)
+        except Exception:
+            pass  # telemetry bus must never fail a step
         # the trace layer's step phases: one "step" span carrying the
         # step id (the merge tool's skew/straggler key) plus child phase
         # spans for the data / compute decomposition

@@ -202,9 +202,73 @@ def _percentile(vals: List[float], q: float) -> float:
     return s[idx]
 
 
+def _goodput_rollup(ranks: List[dict], aligned: List[tuple]) -> dict:
+    """Offline goodput reclassification (``merge --goodput``): replay the
+    live :class:`~.goodput.GoodputLedger` split from the step spans'
+    embedded shares (``data_time_s`` / ``exposed_collective_time_s`` /
+    ``compile_s`` / ``ckpt_s`` — StepTimer writes them exactly so this
+    path can), so old trace dirs get goodput numbers retroactively.
+
+    Per lane: each step span's wall splits into its bins; the gaps
+    *between* consecutive step spans are ``other_overhead``. Per rank: a
+    relaunch (second lane, new pid) makes the gap between the first
+    lane's last event and the second's first event ``restart`` badput.
+    """
+    from .goodput import BINS
+    bins = {b: 0.0 for b in BINS}
+    lanes: Dict[str, dict] = {}
+    for ts, r, ev in aligned:
+        lane = lanes.setdefault(
+            r["label"], {"rank": r["rank"], "steps": [],
+                         "first_ns": ts, "last_ns": ts})
+        end = ts + int(ev.get("dur", 0)) if ev.get("type") == "span" else ts
+        lane["first_ns"] = min(lane["first_ns"], ts)
+        lane["last_ns"] = max(lane["last_ns"], end)
+        if ev.get("cat") == "step" and ev.get("type") == "span":
+            lane["steps"].append((ts, end, ev.get("args") or {}))
+    steps = 0
+    for lane in lanes.values():
+        lane["steps"].sort()
+        prev_end = None
+        for ts, end, a in lane["steps"]:
+            dur = float(a.get("step_time_s", (end - ts) / 1e9))
+            shares = {
+                "data_stall": float(a.get("data_time_s", 0.0)),
+                "exposed_collective": float(
+                    a.get("exposed_collective_time_s", 0.0)),
+                "compile": float(a.get("compile_s", 0.0)),
+                "checkpoint": float(a.get("ckpt_s", 0.0)),
+            }
+            scale = min(dur / max(sum(shares.values()), 1e-12), 1.0)
+            for b, v in shares.items():
+                bins[b] += v * scale
+            bins["productive"] += dur - min(sum(shares.values()), dur)
+            if prev_end is not None and ts > prev_end:
+                bins["other_overhead"] += (ts - prev_end) / 1e9
+            prev_end = end
+            steps += 1
+    # relaunch gaps: lanes of the same rank, ordered by first event
+    by_rank: Dict[int, List[dict]] = {}
+    for lane in lanes.values():
+        by_rank.setdefault(lane["rank"], []).append(lane)
+    for group in by_rank.values():
+        group.sort(key=lambda ln: ln["first_ns"])
+        for prev, nxt in zip(group, group[1:]):
+            gap = (nxt["first_ns"] - prev["last_ns"]) / 1e9
+            if gap > 0:
+                bins["restart"] += gap
+    wall = sum(bins.values())
+    return {"bins": {b: round(v, 6) for b, v in bins.items()},
+            "wall_s": round(wall, 6), "steps": steps,
+            "lanes": sorted(lanes),
+            "job_goodput_fraction": round(
+                bins["productive"] / wall, 6) if wall > 0 else 0.0}
+
+
 def merge(trace_dir: str, out_trace: Optional[str] = None,
           out_summary: Optional[str] = None,
-          pattern: str = "trace_rank*.jsonl") -> dict:
+          pattern: str = "trace_rank*.jsonl",
+          goodput: bool = False) -> dict:
     """Merge every per-rank trace file under ``trace_dir`` onto one
     clock. Writes a chrome trace (default ``merged_trace.json``) and a
     summary (default ``merge_summary.json``) into ``trace_dir`` and
@@ -359,6 +423,8 @@ def merge(trace_dir: str, out_trace: Optional[str] = None,
         "per_step": per_step,
         "comm_by_axes": comm,
     }
+    if goodput:
+        summary["goodput"] = _goodput_rollup(ranks, aligned)
 
     out_trace = out_trace or os.path.join(trace_dir, "merged_trace.json")
     out_summary = out_summary or os.path.join(trace_dir,
@@ -383,14 +449,18 @@ def _main(argv: Optional[List[str]] = None) -> int:
     mp.add_argument("trace_dir")
     mp.add_argument("--out", default=None, help="chrome trace output path")
     mp.add_argument("--summary", default=None, help="summary JSON path")
+    mp.add_argument("--goodput", action="store_true",
+                    help="reclassify merged step spans into the goodput "
+                         "ledger bins (offline job_goodput_fraction)")
     args = ap.parse_args(argv)
     if args.cmd == "merge":
         s = merge(args.trace_dir, out_trace=args.out,
-                  out_summary=args.summary)
-        print(json.dumps({k: s[k] for k in
-                          ("ranks", "events", "steps_compared", "skew",
-                           "straggler_counts", "out_trace", "out_summary")},
-                         indent=1))
+                  out_summary=args.summary, goodput=args.goodput)
+        keys = ["ranks", "events", "steps_compared", "skew",
+                "straggler_counts", "out_trace", "out_summary"]
+        if args.goodput:
+            keys.append("goodput")
+        print(json.dumps({k: s[k] for k in keys}, indent=1))
     return 0
 
 

@@ -112,6 +112,15 @@ class NaNGuard(Callback):
                 "exhausted; the run is numerically unstable")
         restored = self._restore_last_commit()
         rollback_counter(self.registry).inc()
+        if restored is not None and step > restored:
+            # the steps between the restored commit and the trip were
+            # just thrown away — reclassify their ledger seconds from
+            # productive to rollback_discarded badput
+            from paddle_tpu.observability import goodput
+            try:
+                goodput.discard_recent_steps(step - restored)
+            except Exception:
+                pass  # accounting must never block the rollback itself
         self._window.clear()
         self._cool = self.cooldown
         warnings.warn(

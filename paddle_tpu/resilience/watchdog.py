@@ -207,7 +207,11 @@ class Watchdog:
                               ("name", "timeout_s", "elapsed_s",
                                "armed_unix", "context")},
                "action": self.action,
-               "events": rec.events() if rec is not None else []}
+               "events": rec.events() if rec is not None else [],
+               # goodput ledger + last heartbeats: a hung-job postmortem
+               # should name the rank that stalled first (compare each
+               # lane's last step id / timestamp across rank dumps)
+               **flight_recorder._ledger_appendix()}
         with open(path, "w") as f:
             json.dump(doc, f, indent=1)
         return path

@@ -509,6 +509,10 @@ class ServingEngine:
                         and s.state is RequestState.PREFILL]
             if decode or prefills:
                 self._run_unified(decode, prefills)
+                # healthz liveness stamp: a wedged-but-listening server
+                # shows a growing last_step_age_seconds
+                from paddle_tpu.observability import fleet
+                fleet.note_step()
             self._update_gauges()
             return bool(decode or prefills)
 

@@ -97,7 +97,7 @@ class Server:
 
             # -- routes ----------------------------------------------------
             def do_GET(self):  # noqa: N802 (stdlib API)
-                from paddle_tpu.observability import get_registry
+                from paddle_tpu.observability import fleet, get_registry
                 if self.path.startswith("/healthz"):
                     stats = server_ref.engine.stats()
                     depth = server_ref.max_queue_depth
@@ -106,8 +106,13 @@ class Server:
                     self._json(200, {
                         "status": "degraded" if degraded else "ok",
                         **stats,
+                        # wedged-but-listening probe fields: rank/job
+                        # identity + age of the last engine step
+                        **fleet.healthz_fields(),
                         **({"max_queue_depth": depth}
                            if depth is not None else {})})
+                elif self.path.startswith("/fleetz"):
+                    self._json(200, fleet.fleetz_snapshot())
                 elif self.path.startswith("/metrics.json"):
                     self._json(200, get_registry().to_json())
                 elif self.path.startswith("/metrics"):

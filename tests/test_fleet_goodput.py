@@ -1,0 +1,380 @@
+"""Fleet telemetry bus + goodput ledger (ISSUE 13).
+
+Covers: the goodput bin invariant (bins sum to wall), restart/rollback
+accounting, the heartbeat bus with live straggler detection, aggregator
+resilience (relaunch lane replacement, stale ranks, garbage records,
+dead stores), the /fleetz + /healthz endpoints, the postmortem
+appendix, and live-vs-offline (``trace merge --goodput``) parity.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import fleet, flight_recorder, goodput, trace
+from paddle_tpu.observability.fleet import (FleetAggregator,
+                                            HeartbeatPublisher, _hb_key)
+from paddle_tpu.observability.goodput import BINS, GoodputLedger
+from paddle_tpu.observability.metrics import MetricsExporter, MetricsRegistry
+from paddle_tpu.observability.step_timer import StepTimer
+
+
+class FakeStore:
+    """Dict-backed stand-in for the job TCPStore (set/get only)."""
+
+    def __init__(self):
+        self.d = {}
+
+    def set(self, key, value):
+        self.d[key] = value
+
+    def get(self, key):
+        return self.d.get(key)
+
+
+class DeadStore:
+    def set(self, key, value):
+        raise ConnectionError("store down")
+
+    def get(self, key):
+        raise ConnectionError("store down")
+
+
+@pytest.fixture(autouse=True)
+def _clean_module_state():
+    goodput._drain_pending_compile()
+    goodput.reset_ledger()
+    yield
+    fleet.disable()
+    goodput.reset_ledger()
+    goodput._drain_pending_compile()
+
+
+def _stats(step_time=0.2, data=0.0, exposed=0.0):
+    return {"step_time_s": step_time, "data_time_s": data,
+            "exposed_collective_time_s": exposed}
+
+
+# ---------------- goodput ledger ---------------------------------------------
+class TestGoodputLedger:
+    def test_bins_sum_to_wall_and_fraction(self):
+        led = GoodputLedger(registry=MetricsRegistry())
+        led._start_mono -= 1.0  # pretend 1s of real wall has passed
+        goodput.record_compile(0.03)
+        out = led.on_step(_stats(step_time=0.2, data=0.05, exposed=0.02))
+        assert out["compile_s"] == pytest.approx(0.03)
+        snap = led.snapshot()
+        assert set(snap["bins"]) == set(BINS)
+        assert sum(snap["bins"].values()) == pytest.approx(
+            snap["wall_s"], rel=1e-4)
+        assert snap["bins"]["data_stall"] == pytest.approx(0.05)
+        assert snap["bins"]["exposed_collective"] == pytest.approx(0.02)
+        assert snap["bins"]["compile"] == pytest.approx(0.03)
+        assert snap["bins"]["productive"] == pytest.approx(0.10)
+        assert 0.0 < snap["job_goodput_fraction"] <= 1.0
+
+    def test_overhead_capped_by_step_wall(self):
+        # an async checkpoint blocking longer than the step cannot push
+        # productive below zero
+        led = GoodputLedger(registry=MetricsRegistry())
+        led._start_mono -= 1.0
+        led.on_step(_stats(step_time=0.1, data=0.4))
+        snap = led.snapshot()
+        assert snap["bins"]["productive"] == pytest.approx(0.0)
+        assert sum(snap["bins"].values()) == pytest.approx(
+            snap["wall_s"], rel=1e-4)
+
+    def test_restart_gap_binned_up_front(self):
+        led = GoodputLedger(registry=MetricsRegistry(),
+                            down_at=time.time() - 2.0)
+        snap = led.snapshot()
+        assert snap["bins"]["restart"] == pytest.approx(2.0, abs=0.25)
+        # the accounted span covers the down-time, not just ledger life
+        assert snap["wall_s"] >= snap["bins"]["restart"]
+        assert sum(snap["bins"].values()) == pytest.approx(
+            snap["wall_s"], rel=1e-4)
+
+    def test_down_at_env_stamp(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_GOODPUT_DOWN_AT",
+                           repr(time.time() - 1.5))
+        led = GoodputLedger(registry=MetricsRegistry())
+        assert led.snapshot()["bins"]["restart"] == pytest.approx(
+            1.5, abs=0.25)
+
+    def test_rollback_reclassifies_productive(self):
+        led = GoodputLedger(registry=MetricsRegistry())
+        led._start_mono -= 1.0
+        for _ in range(3):
+            led.on_step(_stats(step_time=0.2))
+        before = led.snapshot()["bins"]
+        moved = led.discard_recent_steps(2)
+        assert moved == pytest.approx(0.4)
+        snap = led.snapshot()
+        after = snap["bins"]
+        assert after["rollback_discarded"] == pytest.approx(0.4)
+        assert after["productive"] == pytest.approx(
+            before["productive"] - 0.4)
+        assert sum(after.values()) == pytest.approx(
+            snap["wall_s"], rel=1e-3)
+
+    def test_snapshot_file_written_atomically(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_GOODPUT_DIR", str(tmp_path))
+        led = GoodputLedger(registry=MetricsRegistry())
+        led.on_step(_stats())
+        path = tmp_path / f"goodput_rank0_{os.getpid()}.json"
+        doc = json.loads(path.read_text())
+        assert doc["steps"] == 1 and set(doc["bins"]) == set(BINS)
+        assert not list(tmp_path.glob("*.tmp"))
+
+
+# ---------------- heartbeat bus + aggregator ---------------------------------
+class TestFleetBus:
+    def test_straggler_flagged_live_and_recovers(self):
+        reg = MetricsRegistry()
+        store = FakeStore()
+        pub0 = HeartbeatPublisher(store=store, rank=0, registry=reg)
+        pub1 = HeartbeatPublisher(store=store, rank=1, registry=reg)
+        agg = FleetAggregator(store=store, world=2, stale_s=60,
+                              k=1.5, m=2, registry=reg)
+        for step in range(1, 4):
+            pub0.publish(step, _stats(step_time=0.1))
+            pub1.publish(step, _stats(step_time=0.5))
+            roll = agg.poll_once()
+        assert agg.stragglers == {1}
+        assert roll["stragglers"] == [1]
+        assert roll["ranks"]["1"]["straggler"] is True
+        assert roll["ranks"]["0"]["straggler"] is False
+        assert roll["ranks"]["0"]["status"] == "live"
+        assert reg.get("fleet_straggler").value(rank=1) == 1
+        # recovery: back under k*median clears the flag
+        for step in range(4, 6):
+            pub0.publish(step, _stats(step_time=0.1))
+            pub1.publish(step, _stats(step_time=0.11))
+            agg.poll_once()
+        assert agg.stragglers == set()
+        assert reg.get("fleet_straggler").value(rank=1) == 0
+
+    def test_stale_heartbeat_does_not_advance_streak(self):
+        # the same slow record polled repeatedly must not count as M
+        # consecutive slow steps
+        reg = MetricsRegistry()
+        store = FakeStore()
+        pub0 = HeartbeatPublisher(store=store, rank=0, registry=reg)
+        pub1 = HeartbeatPublisher(store=store, rank=1, registry=reg)
+        agg = FleetAggregator(store=store, world=2, stale_s=60,
+                              k=1.5, m=3, registry=reg)
+        pub0.publish(1, _stats(step_time=0.1))
+        pub1.publish(1, _stats(step_time=0.5))
+        for _ in range(5):
+            agg.poll_once()
+        assert agg.stragglers == set()
+
+    def test_relaunched_rank_replaces_lane(self):
+        reg = MetricsRegistry()
+        store = FakeStore()
+        agg = FleetAggregator(store=store, world=2, stale_s=60,
+                              registry=reg)
+        now = time.time()
+        store.set(_hb_key(1), json.dumps(
+            {"rank": 1, "pid": 111, "step": 5, "t": now,
+             "step_time_s": 0.1}))
+        agg.poll_once()
+        # relaunch: same rank, new pid → the lane is REPLACED
+        store.set(_hb_key(1), json.dumps(
+            {"rank": 1, "pid": 222, "step": 1, "t": now + 1,
+             "step_time_s": 0.1}))
+        roll = agg.poll_once()
+        assert list(roll["ranks"]) == ["1"]
+        assert roll["ranks"]["1"]["pid"] == 222
+
+    def test_stale_rank_goes_missing_without_crash(self):
+        reg = MetricsRegistry()
+        store = FakeStore()
+        agg = FleetAggregator(store=store, world=2, stale_s=15,
+                              registry=reg)
+        now = time.time()
+        store.set(_hb_key(0), json.dumps(
+            {"rank": 0, "pid": 1, "step": 9, "t": now,
+             "step_time_s": 0.1}))
+        store.set(_hb_key(1), json.dumps(
+            {"rank": 1, "pid": 2, "step": 3, "t": now - 100,
+             "step_time_s": 0.1}))
+        roll = agg.poll_once(now=now)
+        assert roll["ranks"]["0"]["status"] == "live"
+        assert roll["ranks"]["1"]["status"] == "missing"
+        # the last known record is kept for the postmortem
+        assert roll["ranks"]["1"]["step"] == 3
+        assert reg.get("fleet_ranks_live").value() == 1
+        assert reg.get("fleet_ranks_missing").value() == 1
+
+    def test_garbage_record_keeps_old_lane(self):
+        store = FakeStore()
+        agg = FleetAggregator(store=store, world=1, stale_s=60,
+                              registry=MetricsRegistry())
+        store.set(_hb_key(0), json.dumps(
+            {"rank": 0, "pid": 1, "step": 2, "t": time.time(),
+             "step_time_s": 0.1}))
+        agg.poll_once()
+        store.set(_hb_key(0), "{torn")
+        roll = agg.poll_once()
+        assert roll["ranks"]["0"]["step"] == 2
+
+    def test_dead_store_degrades_quietly(self):
+        agg = FleetAggregator(store=DeadStore(), world=2,
+                              registry=MetricsRegistry())
+        roll = agg.poll_once()  # must not raise
+        assert roll["ranks"] == {}
+        pub = HeartbeatPublisher(store=DeadStore(), rank=0,
+                                 registry=MetricsRegistry())
+        with pytest.warns(RuntimeWarning, match="heartbeat publish"):
+            pub.publish(1, _stats())
+        pub.publish(2, _stats())  # silent after the first warning
+        assert len(pub.recent) == 2  # local postmortem copies survive
+
+    def test_heartbeat_carries_goodput_and_identity(self):
+        goodput.get_ledger().on_step(_stats(step_time=0.2, data=0.05))
+        store = FakeStore()
+        pub = HeartbeatPublisher(store=store, rank=0,
+                                 registry=MetricsRegistry())
+        pub.publish(7, _stats(step_time=0.2, data=0.05))
+        rec = json.loads(store.get(_hb_key(0)))
+        assert rec["rank"] == 0 and rec["pid"] == os.getpid()
+        assert rec["step"] == 7
+        assert rec["step_time_s"] == pytest.approx(0.2)
+        assert rec["goodput"]["bins"]["data_stall"] == pytest.approx(0.05)
+        assert 0.0 <= rec["goodput"]["fraction"] <= 1.0
+
+
+# ---------------- endpoints --------------------------------------------------
+class TestEndpoints:
+    def test_exporter_healthz_and_fleetz(self):
+        store = FakeStore()
+        fleet.enable(store=store, rank=0, world=2, start_aggregator=True)
+        fleet.note_step()
+        fleet.publish_step(3, _stats(step_time=0.1))
+        reg = MetricsRegistry()
+        exp = MetricsExporter(0, reg)
+        try:
+            base = f"http://127.0.0.1:{exp.port}"
+            hz = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=10).read())
+            assert hz["status"] == "ok"
+            assert hz["rank"] == 0 and hz["job_id"] == "local"
+            assert hz["last_step_age_seconds"] >= 0.0
+            fz = json.loads(urllib.request.urlopen(
+                base + "/fleetz", timeout=10).read())
+            assert fz["aggregator"] is True and fz["world"] == 2
+            assert fz["ranks"]["0"]["step"] == 3
+            assert "local_goodput" in fz
+        finally:
+            exp.stop()
+
+    def test_fleetz_local_fallback_without_aggregator(self):
+        fleet.enable(store=FakeStore(), rank=1, start_aggregator=False)
+        fleet.publish_step(5, _stats())
+        fz = fleet.fleetz_snapshot()
+        assert fz["aggregator"] is False
+        assert fz["ranks"]["1"]["step"] == 5
+        assert fz["stragglers"] == []
+
+    def test_live_straggler_acceptance(self):
+        """ISSUE 13 acceptance: two simulated ranks, one slowed — the
+        live /fleetz document names the straggler while the 'job' runs,
+        with no trace merge involved."""
+        store = FakeStore()
+        fleet.enable(store=store, rank=0, world=2, start_aggregator=False)
+        agg = FleetAggregator(store=store, world=2, stale_s=60,
+                              k=1.5, m=2, registry=MetricsRegistry())
+        fleet._aggregator = agg  # un-started: polled by fleetz_snapshot
+        slow = HeartbeatPublisher(store=store, rank=1,
+                                  registry=MetricsRegistry())
+        for step in range(1, 4):
+            fleet.publish_step(step, _stats(step_time=0.1))
+            slow.publish(step, _stats(step_time=0.4))
+            fleet.fleetz_snapshot()
+        fz = fleet.fleetz_snapshot()
+        assert fz["stragglers"] == [1]
+        assert fz["ranks"]["1"]["straggler"] is True
+
+    def test_maybe_enable_from_env_gating(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLEET", "0")
+        monkeypatch.setenv("PADDLE_MASTER", "127.0.0.1:1")
+        assert fleet.maybe_enable_from_env() is None
+        monkeypatch.delenv("PADDLE_MASTER")
+        monkeypatch.setenv("PADDLE_TPU_FLEET", "")
+        assert fleet.maybe_enable_from_env() is None
+        assert fleet._publisher is None
+
+
+# ---------------- postmortem appendix ----------------------------------------
+class TestPostmortemAppendix:
+    def test_dump_carries_ledger_and_heartbeats(self, tmp_path):
+        goodput.get_ledger().on_step(_stats(step_time=0.2))
+        fleet.enable(store=FakeStore(), rank=0, start_aggregator=False)
+        fleet.publish_step(1, _stats(step_time=0.2))
+        appendix = flight_recorder._ledger_appendix()
+        assert set(appendix["goodput"]["bins"]) == set(BINS)
+        assert appendix["heartbeats"][-1]["step"] == 1
+        fr = flight_recorder.FlightRecorder(capacity=16)
+        try:
+            t = time.time_ns()
+            fr.record(flight_recorder.KIND_STEP, "train_step", t, t)
+            path = fr.dump(str(tmp_path / "pm.json"), reason="test")
+        finally:
+            fr.close()  # release the process-wide native ring
+        doc = json.loads(open(path).read())
+        assert doc["goodput"]["steps"] == 1
+        assert doc["heartbeats"][0]["rank"] == 0
+
+    def test_appendix_empty_without_ledger(self):
+        assert flight_recorder._ledger_appendix() == {}
+
+
+# ---------------- live vs offline parity -------------------------------------
+class TestOfflineParity:
+    def test_trace_merge_goodput_matches_live_split(self, tmp_path):
+        """Satellite 1: ``trace merge --goodput`` replays the live
+        ledger's per-step split from the step-span args."""
+        trace.enable(str(tmp_path), rank=0)
+        try:
+            timer = StepTimer(registry=MetricsRegistry(), peak=0)
+            goodput.record_compile(0.02)
+            for _ in range(4):
+                timer.begin_step(data_time=0.01)
+                time.sleep(0.015)
+                timer.end_step(samples=4)
+        finally:
+            trace.disable()
+        live = goodput.snapshot()
+        summary = trace.merge(str(tmp_path), goodput=True)
+        off = summary["goodput"]
+        assert off["steps"] == 4
+        for b in ("productive", "data_stall", "compile"):
+            assert off["bins"][b] == pytest.approx(
+                live["bins"][b], rel=0.05, abs=5e-3), b
+        assert sum(off["bins"].values()) == pytest.approx(
+            off["wall_s"], rel=1e-4)
+        assert 0.0 < off["job_goodput_fraction"] <= 1.0
+
+    def test_relaunch_gap_is_restart_offline(self, tmp_path):
+        """Two lanes of the same rank (a relaunch) → the gap between
+        them is restart badput in the offline rollup."""
+        import paddle_tpu.observability.trace as tr
+        anchor = (time.perf_counter_ns(), time.time_ns())
+        for label, t0, t1 in (("a", 0, int(0.5e9)),
+                              ("b", int(2.5e9), int(3.0e9))):
+            lines = [
+                {"type": "header", "version": 1, "rank": 0,
+                 "pid": 1 if label == "a" else 2,
+                 "clock": {"perf_ns": anchor[0], "unix_ns": anchor[1]}},
+                {"type": "span", "cat": "step", "name": "train_step",
+                 "ts": anchor[0] + t0, "dur": t1 - t0, "tid": 0,
+                 "args": {"step": 1, "step_time_s": (t1 - t0) / 1e9}},
+            ]
+            with open(tmp_path / f"trace_rank0_{label}.jsonl", "w") as f:
+                f.write("\n".join(json.dumps(ln) for ln in lines) + "\n")
+        off = tr.merge(str(tmp_path), goodput=True)["goodput"]
+        assert off["bins"]["restart"] == pytest.approx(2.0, rel=0.01)
+        assert off["bins"]["productive"] == pytest.approx(1.0, rel=0.01)

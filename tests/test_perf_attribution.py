@@ -244,7 +244,7 @@ class TestBenchReportGate:
 #: family-name prefixes owned by this framework's telemetry
 _FAMILY_PREFIXES = ("comm_", "train_", "serving_", "ckpt_",
                     "resilience_", "data_", "loader_", "attribution_",
-                    "hbm_")
+                    "hbm_", "fleet_", "goodput_", "job_")
 
 #: backticked doc tokens that look like families but are not registry
 #: metrics: `comm_bytes` is the chrome-trace counter-track name,
@@ -255,6 +255,16 @@ _FAMILY_PREFIXES = ("comm_", "train_", "serving_", "ckpt_",
 #: themselves
 _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           "comm_totals", "data_time_s",
+                          # fleet/goodput non-families (ISSUE 13):
+                          # /healthz + heartbeat record fields and
+                          # bench.py --chaos output keys, not registry
+                          # metric families
+                          "job_id", "goodput_fraction", "goodput_bins",
+                          "goodput_wall_coverage", "goodput_restart_s",
+                          "goodput_incarnations",
+                          # goodput bin names / heartbeat record fields
+                          # (docs backtick them; they are not families)
+                          "data_stall", "ckpt_s", "hbm_in_use",
                           "serving_p99_ttft_seconds",
                           "serving_decode_tokens_per_sec",
                           # bench.py --audit report-gate headlines
@@ -321,6 +331,8 @@ def _registered_families():
     from paddle_tpu.io.dataloader import loader_metrics
     from paddle_tpu.observability import StepTimer, get_registry
     from paddle_tpu.observability.attribution import attribution_metrics
+    from paddle_tpu.observability.fleet import fleet_metrics
+    from paddle_tpu.observability.goodput import goodput_metrics
     from paddle_tpu.observability.memory import memory_metrics
     from paddle_tpu.resilience.counters import (
         nonfinite_counter, preemption_counter, rollback_counter,
@@ -332,6 +344,8 @@ def _registered_families():
     data_metrics()
     loader_metrics()
     attribution_metrics()
+    fleet_metrics()
+    goodput_metrics()
     memory_metrics()
     serving_metrics()
     nonfinite_counter(), rollback_counter(), preemption_counter()

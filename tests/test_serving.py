@@ -407,6 +407,13 @@ def test_http_generate_roundtrip(served):
         # starts churning (ISSUE 8 satellite)
         assert 0.0 <= hz["kv_headroom"] <= 1.0
         assert hz["attn_impl"] in ("rpa", "gather")
+        # fleet identity fields (ISSUE 13): which rank of which job
+        # answered, and is it actually making progress
+        assert hz["rank"] == 0 and hz["job_id"]
+        assert hz["last_step_age_seconds"] >= 0.0
+        fz = json.loads(urllib.request.urlopen(
+            srv.url + "/fleetz", timeout=10).read())
+        assert fz["job_id"] == hz["job_id"] and "local_goodput" in fz
 
         # streaming: one NDJSON line per token, then the summary
         req = urllib.request.Request(
