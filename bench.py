@@ -1013,7 +1013,11 @@ REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        # runtime-truth peak HBM of the compiled train
                        # step (ISSUE 11, observability.memory): XLA
                        # buffer-assignment total for the audited step
-                       "train_step_peak_hbm_bytes"}
+                       "train_step_peak_hbm_bytes",
+                       # instrumented-vs-plain step cost of the numerics
+                       # observatory's sampled twin (ISSUE 14, bench.py
+                       # --numerics) — the tap seam must stay cheap
+                       "numerics_step_overhead_frac"}
 #: open-ended LOWER_BETTER families — the static comm budget is one
 #: metric per mesh axis (ISSUE 12, bench.py --audit /
 #: paddle_tpu.analysis commplan), so membership is by prefix; the
@@ -1453,6 +1457,64 @@ def bench_profile():
     return {"trace_dir": out_dir, "trace_files": n_files}
 
 
+def bench_numerics():
+    """Numerics observatory overhead smoke (--numerics): compile the
+    tiny llama step twice — plain and with the instrumented numerics
+    twin forced on every step — and report the relative step-time cost
+    of the in-graph tap/grad-stat telemetry as the
+    ``numerics_step_overhead_frac`` LOWER_BETTER report-gate headline
+    (``_cpu_smoke`` suffix off-TPU; docs/OBSERVABILITY.md#numerics).
+    The sampled production cost is this number divided by
+    ``PADDLE_TPU_NUMERICS_EVERY``."""
+    from paddle_tpu.analysis.driver import ensure_cpu_mesh, \
+        tiny_llama_step
+    ensure_cpu_mesh()
+    import jax
+
+    from paddle_tpu.observability import numerics
+    on_tpu = jax.default_backend() == "tpu"
+    steps, warmup = (20, 3) if on_tpu else (8, 2)
+
+    prev = {k: os.environ.get(k)
+            for k in ("PADDLE_TPU_NUMERICS", "PADDLE_TPU_NUMERICS_EVERY")}
+    try:
+        os.environ["PADDLE_TPU_NUMERICS"] = "0"
+        step, batch = tiny_llama_step()
+
+        def time_steps():
+            for _ in range(warmup):
+                jax.block_until_ready(step(*batch))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                jax.block_until_ready(step(*batch))
+            return (time.perf_counter() - t0) / steps
+
+        t_plain = time_steps()
+        compiles0 = len(step._cache)
+        os.environ["PADDLE_TPU_NUMERICS"] = "1"
+        os.environ["PADDLE_TPU_NUMERICS_EVERY"] = "1"
+        t_inst = time_steps()
+        assert len(step._cache) == compiles0 + 1, \
+            "arming numerics must compile exactly ONE instrumented twin"
+        sample = step.last_numerics
+        assert sample and sample["taps"], "instrumented steps must sample"
+    finally:
+        for k, v in prev.items():
+            os.environ.pop(k, None) if v is None \
+                else os.environ.__setitem__(k, v)
+
+    overhead = (t_inst - t_plain) / t_plain if t_plain > 0 else 0.0
+    print(f"  plain={t_plain * 1e3:.2f}ms instrumented={t_inst * 1e3:.2f}ms "
+          f"overhead={overhead * 100:.1f}% taps={len(sample['taps'])} "
+          f"grad_buckets={len(sample['grads'])}", file=sys.stderr)
+    suffix = "" if on_tpu else "_cpu_smoke"
+    print(json.dumps({"metric": f"numerics_step_overhead_frac{suffix}",
+                      "value": round(overhead, 4)}))
+    return {"plain_step_s": t_plain, "instrumented_step_s": t_inst,
+            "overhead_frac": overhead, "taps": len(sample["taps"]),
+            "grad_buckets": len(sample["grads"])}
+
+
 def main():
     if "--chaos-worker" in sys.argv:
         _chaos_worker()
@@ -1505,6 +1567,13 @@ def main():
         print(json.dumps({"profile": prof}))
         if metrics_out:
             emit_metrics({"profile": prof}, metrics_out)
+        return
+
+    if "--numerics" in sys.argv:
+        nums = bench_numerics()
+        print(json.dumps({"numerics": nums}))
+        if metrics_out:
+            emit_metrics({"numerics": nums}, metrics_out)
         return
 
     if "--serve" in sys.argv:

@@ -315,15 +315,24 @@ def _sig_leaf_names(treedef) -> List[str]:
 
 def diff_compile_keys(key_a, key_b) -> List[str]:
     """Human-readable difference between two ``TrainStep`` compile keys
-    ``(treedef, sig, training, train_names)`` — names the exact leaf
-    whose structure/shape/dtype changed, the mode flip, or the
-    trainable-set change that forced the recompilation."""
-    treedef_a, sig_a, training_a, train_a = key_a
-    treedef_b, sig_b, training_b, train_b = key_b
+    ``(treedef, sig, training, train_names, instrument)`` — names the
+    exact leaf whose structure/shape/dtype changed, the mode flip, the
+    trainable-set change, or the numerics-instrumentation flip that
+    forced the recompilation."""
+    treedef_a, sig_a, training_a, train_a = key_a[:4]
+    treedef_b, sig_b, training_b, train_b = key_b[:4]
+    # 4-tuple keys predate the instrumentation flag; treat as disarmed
+    inst_a = key_a[4] if len(key_a) > 4 else False
+    inst_b = key_b[4] if len(key_b) > 4 else False
     out = []
     if training_a != training_b:
         out.append(f"model mode changed: training={training_a} -> "
                    f"{training_b}")
+    if inst_a != inst_b:
+        # the expected sampled-twin retrace, not a perf smell
+        # (docs/OBSERVABILITY.md#numerics)
+        out.append(f"numerics instrumentation changed: {inst_a} -> "
+                   f"{inst_b}")
     if train_a != train_b:
         frozen = sorted(set(train_a) - set(train_b))
         unfrozen = sorted(set(train_b) - set(train_a))

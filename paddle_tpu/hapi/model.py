@@ -234,7 +234,9 @@ class StepTelemetry(Callback):
         self.timer.begin_step(data_time=logs.get("data_time", 0.0))
 
     def on_train_batch_end(self, step, logs=None):
-        stats = self.timer.end_step(samples=self._batch_size)
+        stats = self.timer.end_step(
+            samples=self._batch_size,
+            grad_norm=(logs or {}).get("grad_norm"))
         self.last_stats = stats
         if logs is not None:
             for k in ("step_time_s", "samples_per_sec", "tokens_per_sec",
@@ -480,6 +482,14 @@ class Model:
                 epoch_losses.append(loss)
                 step += 1
                 logs = {"loss": loss}
+                gn = getattr(self._train_step, "last_grad_norm", None)
+                if gn is not None:
+                    # satellite of the numerics observatory: the global
+                    # grad norm the clip path already computed — console
+                    # line (ProgBarLogger), train_grad_norm gauge
+                    # (StepTelemetry) and NaNGuard's grad_nan check all
+                    # read it from here
+                    logs["grad_norm"] = float(np.asarray(gn))
                 for cb in callbacks:
                     cb.on_train_batch_end(step, logs)
                 if chaos is not None:

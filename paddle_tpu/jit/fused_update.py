@@ -251,11 +251,14 @@ def fused_clip_and_update(opt, layout: FlatLayout, train, grads, flats,
                           group_lrs, clip_pure):
     """Traced body: clip + update for the fused buckets.
 
-    Returns ``(new_train_fused, new_flats, res_grads)`` — per-param new
-    parameter arrays for the fused names, the updated flat state buffers
-    (same structure as ``flats``, donated/aliased by the caller), and the
-    residue gradients for the per-param fallback loop (already clipped,
-    whichever strategy applied).
+    Returns ``(new_train_fused, new_flats, res_grads, global_norm)`` —
+    per-param new parameter arrays for the fused names, the updated flat
+    state buffers (same structure as ``flats``, donated/aliased by the
+    caller), the residue gradients for the per-param fallback loop
+    (already clipped, whichever strategy applied), and the pre-clip
+    global gradient norm when the strategy is ``ClipGradByGlobalNorm``
+    (None otherwise) — already reduced for the scale, surfaced so
+    TrainStep can publish it instead of throwing it away.
 
     ``clip_pure`` is TrainStep's per-param clip fallback, used verbatim
     for strategies that are inherently per-tensor (``ClipGradByNorm``).
@@ -284,6 +287,7 @@ def fused_clip_and_update(opt, layout: FlatLayout, train, grads, flats,
                for b in layout.buckets]
     res_grads = {n: grads[n] for n in layout.residue}
 
+    global_norm = None
     if not pre_clipped and isinstance(clip, ClipGradByGlobalNorm):
         # one dot per bucket instead of one small reduction per param
         # (changes the norm's float summation order vs eager — the one
@@ -338,4 +342,4 @@ def fused_clip_and_update(opt, layout: FlatLayout, train, grads, flats,
                 seg = jnp.reshape(delta[off:off + size], shape)
                 new_train[name] = p - seg.astype(p.dtype)
         new_flats.append(new_fs)
-    return new_train, new_flats, res_grads
+    return new_train, new_flats, res_grads, global_norm

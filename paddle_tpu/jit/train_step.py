@@ -178,13 +178,25 @@ class TrainStep:
 
     # -- pure helpers ---------------------------------------------------------
     def _clip_pure(self, grads: Dict[str, object]) -> Dict[str, object]:
+        clipped, _ = self._clip_pure_with_norm(grads)
+        return clipped
+
+    def _clip_pure_with_norm(self, grads):
+        """``(clipped, global_norm)`` — the norm is a free byproduct of
+        ``ClipGradByGlobalNorm`` (None for other strategies / no clip);
+        surfaced so the step can publish ``train_grad_norm`` instead of
+        recomputing the reduction it already paid for."""
+        from paddle_tpu.nn.clip import ClipGradByGlobalNorm
         clip = self._opt._grad_clip
         if clip is None:
-            return grads
+            return grads, None
         names = list(grads.keys())
         pairs = [(self._params[n], Tensor(grads[n])) for n in names]
-        clipped = clip(pairs)
-        return {n: c.data for n, (_, c) in zip(names, clipped)}
+        if isinstance(clip, ClipGradByGlobalNorm):
+            clipped, gnorm = clip._clip_with_norm(pairs)
+        else:
+            clipped, gnorm = clip(pairs), None
+        return {n: c.data for n, (_, c) in zip(names, clipped)}, gnorm
 
     def _update_loop(self, names, train, grads, states, group_lrs):
         """The classic per-parameter update (same rule the eager step()
@@ -225,12 +237,16 @@ class TrainStep:
     def _apply_updates(self, train, grads, states, group_lrs, layout):
         """Clip + optimizer update for every train param: fused buckets
         through ``fused_update`` (flat state rides ``states[FUSED_KEY]``),
-        everything else (or ``fused=False``) through the per-param loop."""
+        everything else (or ``fused=False``) through the per-param loop.
+        Returns ``(new_train, new_states, global_norm)`` — the clip
+        path's global gradient norm (None unless ``ClipGradByGlobalNorm``
+        is active)."""
         if layout is None or not layout.buckets:
-            grads = self._clip_pure(grads)
-            return self._update_loop(list(train), train, grads, states,
-                                     group_lrs)
-        new_train, new_flats, res_grads = fused_clip_and_update(
+            grads, gnorm = self._clip_pure_with_norm(grads)
+            new_train, new_states = self._update_loop(
+                list(train), train, grads, states, group_lrs)
+            return new_train, new_states, gnorm
+        new_train, new_flats, res_grads, gnorm = fused_clip_and_update(
             self._opt, layout, train, grads, states[FUSED_KEY], group_lrs,
             self._clip_pure)
         new_states = {FUSED_KEY: new_flats}
@@ -239,7 +255,7 @@ class TrainStep:
                                        states, group_lrs)
             new_train.update(rt)
             new_states.update(rs)
-        return new_train, new_states
+        return new_train, new_states, gnorm
 
     # -- fused flat-state lifecycle -------------------------------------------
     @staticmethod
@@ -345,10 +361,20 @@ class TrainStep:
             pass
 
     # -- compile --------------------------------------------------------------
-    def _grads_gspmd(self, treedef):
+    def _grads_gspmd(self, treedef, instrument=False, tap_order=None):
         """Gradient closure for the default path: one value_and_grad over
         the global batch; GSPMD inserts whatever collectives the shardings
-        imply (per-param grad all-reduces under dp)."""
+        imply (per-param grad all-reduces under dp). ``instrument`` arms
+        the numerics tap seam for this trace: activation-health scalars
+        collected during the forward ride out through the aux channel
+        (values only — ``value_and_grad`` never differentiates aux).
+        Disarmed, the collect() is a no-op yielding an empty dict — zero
+        extra pytree leaves, bit-identical HLO. ``tap_order`` (a list
+        cell) receives the taps' EXECUTION order at trace time — jax
+        pytrees iterate dicts key-sorted, so the topological order NaN
+        provenance scans by must leave the trace out-of-band."""
+        from paddle_tpu.observability import numerics
+
         model, loss_fn = self._model, self._loss_fn
 
         def run(train, frozen, buffers, rng, flat_batch):
@@ -362,17 +388,21 @@ class TrainStep:
             def loss_of(train_arrs):
                 state = {**train_arrs, **frozen, **buffers}
                 with no_grad(), _gen.rng_guard(rng_key), \
-                        swap_state(model, state) as out_bufs:
+                        swap_state(model, state) as out_bufs, \
+                        numerics.collect(instrument) as col:
                     loss = loss_fn(model, *args[0], **args[1])
                     val = loss.data if isinstance(loss, Tensor) else loss
-                return val, out_bufs
+                if tap_order is not None:
+                    tap_order[:] = list(col.taps)
+                return val, (out_bufs, col.taps)
 
-            (loss_val, new_bufs), grads = jax.value_and_grad(
+            (loss_val, (new_bufs, taps)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train)
-            return loss_val, grads, new_bufs
+            return loss_val, grads, new_bufs, taps
         return run
 
-    def _grads_bucketed(self, treedef, comm, flat_example):
+    def _grads_bucketed(self, treedef, comm, flat_example,
+                        instrument=False, tap_order=None):
         """Gradient closure for the bucketed-collective path: shard_map
         over ``dp`` computes per-shard gradients with no implicit
         collectives, then reduces them as ONE ``pmean`` per planned bucket
@@ -384,6 +414,7 @@ class TrainStep:
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
         from paddle_tpu.distributed.fleet.utils import shard_map_compat
+        from paddle_tpu.observability import numerics
 
         model, loss_fn = self._model, self._loss_fn
         mesh = self._mesh
@@ -406,19 +437,27 @@ class TrainStep:
             def loss_of(train_arrs):
                 state = {**train_arrs, **frozen}
                 with no_grad(), _gen.rng_guard(key), \
-                        swap_state(model, state) as out_bufs:
+                        swap_state(model, state) as out_bufs, \
+                        numerics.collect(instrument) as col:
                     loss = loss_fn(model, *args[0], **args[1])
                     val = loss.data if isinstance(loss, Tensor) else loss
-                return val, out_bufs
+                if tap_order is not None:
+                    tap_order[:] = list(col.taps)
+                return val, (out_bufs, col.taps)
 
-            (loss_val, new_bufs), grads = jax.value_and_grad(
+            (loss_val, (new_bufs, taps)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train)
+            if instrument:
+                # out_specs is P() (replicated): per-shard tap stats must
+                # leave shard_map as globals — max/mean/sum across dp
+                taps = {n: numerics.reduce_stats(st, "dp")
+                        for n, st in taps.items()}
             flats = []
             for names in comm:
                 flat = _flat(jnp, [grads[n] for n in names])
                 flats.append(jax.lax.pmean(flat, "dp"))
             loss_val = jax.lax.pmean(loss_val, "dp")
-            return loss_val, flats, new_bufs
+            return loss_val, flats, new_bufs, taps
 
         def batch_spec(leaf):
             return P("dp") if getattr(leaf, "ndim", 0) > 0 else P()
@@ -429,8 +468,8 @@ class TrainStep:
             out_specs=P())
 
         def run(train, frozen, buffers, rng, flat_batch):
-            loss_val, flats, new_bufs = sm(train, frozen, rng,
-                                           flat_batch)
+            loss_val, flats, new_bufs, taps = sm(train, frozen, rng,
+                                                 flat_batch)
             grads = {}
             for names, flat in zip(comm, flats):
                 off = 0
@@ -441,20 +480,100 @@ class TrainStep:
             # restore registration order so clip/update see the same
             # iteration order as the GSPMD path
             grads = {n: grads[n] for n in train}
-            return loss_val, grads, new_bufs
+            return loss_val, grads, new_bufs, taps
         return run
 
-    def _compile(self, treedef, layout, comm, flat_example):
-        grads_of = self._grads_bucketed(treedef, comm, flat_example) \
-            if comm is not None else self._grads_gspmd(treedef)
+    def _numerics_grad_stats(self, grads, layout):
+        """Per-parameter-bucket gradient (L2 norm, non-finite count),
+        riding the FlatLayout buckets so the per-param kernel storm the
+        fused optimizer killed does not return through telemetry; params
+        outside a fused bucket fall back to per-param-group aggregates.
+        Also returns the total sum-of-squares so the observatory gets a
+        global grad norm even when no global-norm clip computes one."""
+        import jax.numpy as jnp
+
+        def agg(names):
+            sq = sum(jnp.sum(jnp.square(grads[n].astype(jnp.float32)))
+                     for n in names)
+            nonf = sum(jnp.sum(jnp.logical_not(
+                jnp.isfinite(grads[n])).astype(jnp.int32)) for n in names)
+            return sq, nonf
+
+        out, total = {}, jnp.float32(0.0)
+        rest = list(grads)
+        if layout is not None and layout.buckets:
+            for i, b in enumerate(layout.buckets):
+                sq, nonf = agg(b.names)
+                out[f"bucket{i}:{b.names[0]}"] = (jnp.sqrt(sq), nonf)
+                total = total + sq
+            rest = list(layout.residue)
+        groups = {}
+        for n in rest:
+            gi = self._group_index[id(self._params[n])]
+            groups.setdefault(gi, []).append(n)
+        for gi in sorted(groups):
+            sq, nonf = agg(groups[gi])
+            out[f"group{gi}"] = (jnp.sqrt(sq), nonf)
+            total = total + sq
+        return out, total
+
+    def _numerics_update_stats(self, train, new_train, layout):
+        """Per-bucket (update_norm, param_norm) from the optimizer deltas
+        actually applied this step — the observatory publishes their
+        ratio (the classic 1e-3-ish LR-health signal)."""
+        import jax.numpy as jnp
+
+        def agg(names):
+            us = sum(jnp.sum(jnp.square(new_train[n].astype(jnp.float32)
+                                        - train[n].astype(jnp.float32)))
+                     for n in names)
+            ps = sum(jnp.sum(jnp.square(train[n].astype(jnp.float32)))
+                     for n in names)
+            return jnp.sqrt(us), jnp.sqrt(ps)
+
+        out = {}
+        rest = list(new_train)
+        if layout is not None and layout.buckets:
+            for i, b in enumerate(layout.buckets):
+                out[f"bucket{i}:{b.names[0]}"] = agg(b.names)
+            rest = list(layout.residue)
+        groups = {}
+        for n in rest:
+            gi = self._group_index[id(self._params[n])]
+            groups.setdefault(gi, []).append(n)
+        for gi in sorted(groups):
+            out[f"group{gi}"] = agg(groups[gi])
+        return out
+
+    def _compile(self, treedef, layout, comm, flat_example,
+                 instrument=False, tap_order=None):
+        grads_of = self._grads_bucketed(treedef, comm, flat_example,
+                                        instrument=instrument,
+                                        tap_order=tap_order) \
+            if comm is not None else self._grads_gspmd(
+                treedef, instrument=instrument, tap_order=tap_order)
 
         def pure(train, frozen, buffers, states, group_lrs, rng_key,
                  flat_batch):
-            loss_val, grads, new_bufs = grads_of(train, frozen, buffers,
-                                                 rng_key, flat_batch)
-            new_train, new_states = self._apply_updates(
+            loss_val, grads, new_bufs, taps = grads_of(
+                train, frozen, buffers, rng_key, flat_batch)
+            gstats = total_sq = None
+            if instrument:
+                gstats, total_sq = self._numerics_grad_stats(grads, layout)
+            new_train, new_states, gnorm = self._apply_updates(
                 train, grads, states, group_lrs, layout)
-            return loss_val, new_train, new_states, new_bufs
+            nums = None
+            if instrument:
+                import jax.numpy as jnp
+                nums = {
+                    "taps": taps,
+                    "grads": gstats,
+                    "updates": self._numerics_update_stats(
+                        train, new_train, layout),
+                    "grad_norm": gnorm if gnorm is not None
+                    else jnp.sqrt(total_sq),
+                }
+            return loss_val, new_train, new_states, new_bufs, gnorm, nums
 
         donate = (0, 3) if self._donate else ()
         if self._mesh is None:
@@ -529,7 +648,10 @@ class TrainStep:
         lr_sh = [rep] * len(self._opt._param_groups)
         in_shardings = (train_sh, frozen_sh, buf_sh, states_sh, lr_sh, rep,
                         batch_sh)
-        out_shardings = (rep, train_sh, states_sh, buf_sh)
+        # trailing rep prefixes cover the grad-norm scalar and the
+        # numerics sample tree (both replicated; empty subtrees — None —
+        # when the executable is not instrumented)
+        out_shardings = (rep, train_sh, states_sh, buf_sh, rep, rep)
         return jax.jit(pure, donate_argnums=donate,
                        in_shardings=in_shardings,
                        out_shardings=out_shardings)
@@ -560,9 +682,13 @@ class TrainStep:
         return out
 
     # -- call -----------------------------------------------------------------
-    def _prepare(self, args, kwargs):
+    def _prepare(self, args, kwargs, instrument=False):
         """Resolve (compile if needed) the executable for this batch
-        signature and assemble its call arguments."""
+        signature and assemble its call arguments. ``instrument=True``
+        resolves the numerics-instrumented twin — its own compile-cache
+        entry (compile-once per signature, exactly like train/eval), so
+        arming numerics mid-run costs one compile and disarming is a
+        cache hit on the original program."""
         model, opt = self._model, self._opt
         # other holders of flat state (another TrainStep on this
         # optimizer) must flush before we read accumulators; our own
@@ -572,7 +698,8 @@ class TrainStep:
         train, frozen, buffers = self._split_state()
         # the trainable-name set keys the cache too: unfreezing a param
         # changes the train pytree (and, under a mesh, the shardings)
-        key = (treedef, sig, model.training, tuple(sorted(train)))
+        key = (treedef, sig, model.training, tuple(sorted(train)),
+               bool(instrument))
         if key not in self._cache:
             # only shapes/dtypes are needed for sharding decisions — never
             # pin the concrete batch for the object's lifetime
@@ -598,8 +725,16 @@ class TrainStep:
                 if reason is None:
                     comm = plan_comm_buckets(train)
             self._plans[key] = (layout, comm, reason)
+            # filled at trace time (first execution): the taps' real
+            # execution order, which the sorted-key output dict loses
+            tap_order = [] if instrument else None
+            if not hasattr(self, "_tap_orders"):
+                self._tap_orders = {}
+            self._tap_orders[key] = tap_order
             self._cache[key] = self._compile(treedef, layout, comm,
-                                             flat_example)
+                                             flat_example,
+                                             instrument=instrument,
+                                             tap_order=tap_order)
             # jax.jit compiles lazily on the first concrete call — mark
             # this executable fresh so __call__ stamps that call's wall
             # into the goodput ledger's compile bin
@@ -607,6 +742,8 @@ class TrainStep:
         layout, comm, reason = self._plans[key]
         self._layout, self._comm_buckets, self._bucketed_reason = \
             layout, comm, reason
+        self._active_tap_order = self._tap_orders.get(key) \
+            if hasattr(self, "_tap_orders") else None
 
         if layout is not None and layout.buckets:
             states = {name: opt._ensure_state(self._params[name])
@@ -623,7 +760,16 @@ class TrainStep:
 
     def __call__(self, *args, **kwargs):
         model, opt = self._model, self._opt
-        train, compiled, call_args = self._prepare(args, kwargs)
+        from paddle_tpu.observability import numerics
+        instrument = numerics.sample_this_step(opt._step_count + 1)
+        train, compiled, call_args = self._prepare(args, kwargs,
+                                                   instrument=instrument)
+        if numerics.provenance_enabled():
+            # the batch is never donated, so its buffers survive the
+            # step — stash it (plus this step's rng parts) for the
+            # NaN-provenance replay; overwritten every step, dropped
+            # leaves the previous batch to the GC
+            self._last_batch = (args, kwargs, call_args[5])
 
         from paddle_tpu.observability.comm import compute_scope
         from paddle_tpu.profiler import RecordEvent
@@ -640,7 +786,7 @@ class TrainStep:
         t_compile0 = time.perf_counter() if fresh else 0.0
         with RecordEvent("TrainStep"), compute_scope():
             try:
-                loss_val, new_train, new_states, new_bufs = \
+                loss_val, new_train, new_states, new_bufs, gnorm, nums = \
                     compiled(*call_args)
             except Exception as e:
                 # RESOURCE_EXHAUSTED gets one postmortem (ledger owners +
@@ -679,6 +825,21 @@ class TrainStep:
             b = named_bufs.get(name)
             if b is not None:
                 b._data = arr
+        # device scalar (or None without a global-norm clip) — hapi's fit
+        # loop floats it into the per-step logs, which feeds the console
+        # line, the train_grad_norm gauge and NaNGuard's grad_nan check
+        self.last_grad_norm = gnorm
+        if nums is not None:
+            try:
+                self.last_numerics = numerics.host_sample(
+                    nums, loss_val, tap_order=self._active_tap_order)
+                numerics.get_observatory().record_sample(
+                    opt._step_count, self.last_numerics)
+            except Exception:
+                # telemetry must never fail the step it observes
+                import warnings
+                warnings.warn("[numerics] sample publication failed",
+                              RuntimeWarning, stacklevel=2)
         return Tensor(loss_val)
 
     def compiled_hlo(self, *args, **kwargs) -> str:
@@ -713,8 +874,69 @@ class TrainStep:
         finally:
             _gen.set_rng_state(rng_state)
 
+    def numerics_probe_last(self):
+        """NaN-provenance replay (docs/OBSERVABILITY.md#numerics): re-run
+        forward + backward over the last stashed batch with that step's
+        exact rng parts, fully instrumented, against the CURRENT
+        model/optimizer state — the caller (NaNGuard) restores the last
+        committed checkpoint first, so the replay answers "does the state
+        training resumes from still blow up on this batch, and where
+        first". No clip, no update, NOTHING donated — a probe must never
+        perturb the state it inspects. Returns the host sample dict (tap
+        stats + grad bucket stats + loss/grad-norm) or None when no
+        batch was stashed. Compiled once per batch signature into a side
+        cache (never counted by the compile-once guards on ``_cache``);
+        RNG-neutral like :meth:`compiled_hlo`. The bucketed-dp path is
+        replayed through the GSPMD closure (same math, global batch) —
+        per-shard dropout decorrelation is the one approximation."""
+        stash = getattr(self, "_last_batch", None)
+        if stash is None:
+            return None
+        args, kwargs, rng_parts = stash
+        from paddle_tpu.observability import numerics
+        rng_state = _gen.get_rng_state()
+        try:
+            self._opt._sync_state(exclude=self)
+            treedef, sig = _sig_of((args, kwargs))
+            train, frozen, buffers = self._split_state()
+            key = (treedef, sig, self._model.training,
+                   tuple(sorted(train)))
+            if not hasattr(self, "_probe_cache"):
+                self._probe_cache = {}
+            if key not in self._probe_cache:
+                # the layout only names the grad buckets here; reuse the
+                # step's plan when one exists for this signature
+                plan = self._plans.get(key + (True,)) \
+                    or self._plans.get(key + (False,))
+                layout = plan[0] if plan is not None else None
+                order = []
+                grads_of = self._grads_gspmd(treedef, instrument=True,
+                                             tap_order=order)
+
+                def probe(train_, frozen_, buffers_, rng, flat_batch):
+                    import jax.numpy as jnp
+                    loss_val, grads, _bufs, taps = grads_of(
+                        train_, frozen_, buffers_, rng, flat_batch)
+                    gstats, total_sq = self._numerics_grad_stats(
+                        grads, layout)
+                    return {"taps": taps, "grads": gstats,
+                            "grad_norm": jnp.sqrt(total_sq),
+                            "loss": loss_val}
+
+                self._probe_cache[key] = (jax.jit(probe), order)
+            flat_batch, _ = jax.tree_util.tree_flatten(
+                _unwrap((args, kwargs)))
+            fn, order = self._probe_cache[key]
+            out = fn(train, frozen, buffers, rng_parts, flat_batch)
+            loss_val = out.pop("loss")
+            return numerics.host_sample(out, loss_val, tap_order=order)
+        finally:
+            _gen.set_rng_state(rng_state)
+
     def clear_cache(self):
         self._flush_flat()
         self._flat_cache = None
         self._cache.clear()
         self._plans.clear()
+        if hasattr(self, "_probe_cache"):
+            self._probe_cache.clear()

@@ -28,6 +28,7 @@ from paddle_tpu.core.autograd import apply_op
 from paddle_tpu import ops
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
+from paddle_tpu.observability import numerics
 from paddle_tpu.ops.paged_attention import (PagedLayerCache,
                                             RaggedLayerCache)
 
@@ -443,8 +444,13 @@ class LlamaMLP(nn.Layer):
                                       cfg.hidden_size, "row")
 
     def forward(self, x):
-        return self.down_proj(
+        # numerics tap seam (docs/OBSERVABILITY.md#numerics): identity
+        # unless an instrumented executable is being traced. The gated
+        # activation is where Llama-family bf16 ranges blow up first.
+        act = numerics.tap(
+            "mlp_act",
             ops.multiply(F.silu(self.gate_proj(x)), self.up_proj(x)))
+        return self.down_proj(act)
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -460,18 +466,21 @@ class LlamaDecoderLayer(nn.Layer):
     def forward(self, x, cache=None, attention_mask=None, pos_offsets=None,
                 position_ids=None):
         if cache is None:
-            x = ops.add(x, self.self_attn(self.input_layernorm(x),
-                                          attention_mask=attention_mask,
-                                          position_ids=position_ids))
-            x = ops.add(x, self.mlp(self.post_attention_layernorm(x)))
-            return x
+            x = ops.add(x, numerics.tap(
+                "attn", self.self_attn(self.input_layernorm(x),
+                                       attention_mask=attention_mask,
+                                       position_ids=position_ids)))
+            x = ops.add(x, numerics.tap(
+                "mlp", self.mlp(self.post_attention_layernorm(x))))
+            return numerics.tap("resid", x)
         attn_out, new_cache = self.self_attn(self.input_layernorm(x),
                                              cache=cache,
                                              attention_mask=attention_mask,
                                              pos_offsets=pos_offsets)
-        x = ops.add(x, attn_out)
-        x = ops.add(x, self.mlp(self.post_attention_layernorm(x)))
-        return x, new_cache
+        x = ops.add(x, numerics.tap("attn", attn_out))
+        x = ops.add(x, numerics.tap(
+            "mlp", self.mlp(self.post_attention_layernorm(x))))
+        return numerics.tap("resid", x), new_cache
 
 
 class LlamaModel(nn.Layer):
@@ -497,31 +506,38 @@ class LlamaModel(nn.Layer):
         ragged batches (static path only); ``position_ids``: [B, S]
         per-token RoPE positions (cacheless packed path only). Reference
         mask threading: ``nn/layer/transformer.py:84``."""
-        x = self.embed_tokens(input_ids)
+        x = numerics.tap("embed", self.embed_tokens(input_ids))
         if caches is None:
             kw = {}
             if attention_mask is not None:
                 kw["attention_mask"] = attention_mask
             if position_ids is not None:
                 kw["position_ids"] = position_ids
-            for layer in self.layers:
-                if self.cfg.recompute and self.training:
-                    from paddle_tpu.distributed.fleet import recompute
-                    x = recompute(layer, x, **kw) if kw \
-                        else recompute(layer, x)
-                else:
-                    x = layer(x, **kw)
-            return self.norm(x)
+            for i, layer in enumerate(self.layers):
+                with numerics.scope(f"layers.{i}"):
+                    if self.cfg.recompute and self.training:
+                        from paddle_tpu.distributed.fleet import recompute
+                        # taps inside a remat region would leak its
+                        # tracers through the collector — suppress them
+                        # and tap the region's output instead
+                        with numerics.suppress():
+                            x = recompute(layer, x, **kw) if kw \
+                                else recompute(layer, x)
+                        x = numerics.tap("resid", x)
+                    else:
+                        x = layer(x, **kw)
+            return numerics.tap("final_norm", self.norm(x))
         if len(caches) != len(self.layers):
             raise ValueError(
                 f"caches has {len(caches)} entries for "
                 f"{len(self.layers)} layers")
         new_caches = []
-        for layer, c in zip(self.layers, caches):
-            x, nc = layer(x, cache=c, attention_mask=attention_mask,
-                          pos_offsets=pos_offsets)
+        for i, (layer, c) in enumerate(zip(self.layers, caches)):
+            with numerics.scope(f"layers.{i}"):
+                x, nc = layer(x, cache=c, attention_mask=attention_mask,
+                              pos_offsets=pos_offsets)
             new_caches.append(nc)
-        return self.norm(x), new_caches
+        return numerics.tap("final_norm", self.norm(x)), new_caches
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -579,7 +595,7 @@ class LlamaForCausalLM(nn.Layer):
             loss = apply_op(causal_lm_loss, h, w, labels,
                             op_name="fused_causal_ce")
             return None, loss
-        logits = self._logits(h)
+        logits = numerics.tap("logits", self._logits(h))
         if labels is None:
             return logits
         # HF-style contract: labels == input_ids; the shift happens HERE

@@ -92,13 +92,22 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.group_name = group_name
 
     def _clip(self, params_grads):
+        out, _ = self._clip_with_norm(params_grads)
+        return out
+
+    def _clip_with_norm(self, params_grads):
+        """``(clipped_pairs, global_norm)`` — the norm is computed for the
+        scale anyway; callers that want to surface it (TrainStep's
+        ``train_grad_norm`` gauge, the numerics observatory) read it here
+        instead of re-reducing every gradient. ``global_norm`` is None
+        when nothing was clippable."""
         sq = []
         for p, g in params_grads:
             if g is None or p.stop_gradient:
                 continue
             sq.append(jnp.sum(jnp.square(g.data.astype(jnp.float32))))
         if not sq:
-            return params_grads
+            return params_grads, None
         global_norm = jnp.sqrt(sum(sq))
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
@@ -109,7 +118,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
             a = g.data
             out.append((p, Tensor(a * scale.astype(a.dtype),
                                   stop_gradient=True)))
-        return out
+        return out, global_norm
 
     def __repr__(self):
         return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"

@@ -37,14 +37,18 @@ Eight pieces (see docs/OBSERVABILITY.md):
   wall-clock into productive/compile/checkpoint/data-stall/exposed-
   collective/restart/rollback bins (``goodput_seconds_total{bin}``,
   ``job_goodput_fraction``).
+- **numerics** — in-graph tensor-health telemetry: the ``numerics.tap``
+  model seam, sampled instrumented train-step twin (``numerics_*``
+  families, ``PADDLE_TPU_NUMERICS``), NaN provenance JSON on NaNGuard
+  rollbacks, and calibration-grade per-tap activation-range sketches.
 
 Importing this package applies the env gates (a no-op when the vars are
 unset), so ``import paddle_tpu`` alone arms the exporter/recorder/tracer
 in production jobs.
 """
 from . import (  # noqa: F401
-    comm, fleet, flight_recorder, goodput, memory, metrics, profile,
-    step_timer, trace,
+    comm, fleet, flight_recorder, goodput, memory, metrics, numerics,
+    profile, step_timer, trace,
 )
 from .comm import (  # noqa: F401
     comm_scope, comm_totals, compute_scope, payload_bytes,
@@ -56,7 +60,7 @@ from .metrics import (  # noqa: F401
 from .step_timer import StepTimer, peak_flops  # noqa: F401
 
 __all__ = ["metrics", "step_timer", "comm", "flight_recorder", "trace",
-           "memory", "profile", "fleet", "goodput",
+           "memory", "profile", "fleet", "goodput", "numerics",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "get_registry", "start_exporter", "maybe_start_exporter",
            "StepTimer", "peak_flops", "comm_scope", "comm_totals",

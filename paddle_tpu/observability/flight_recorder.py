@@ -336,6 +336,16 @@ def _ledger_appendix() -> dict:
             out["heartbeats"] = hbs
     except Exception:
         pass
+    try:
+        from . import numerics
+        ns = numerics.last_sample()
+        if ns is not None:
+            # last-known tensor health: a crash dump that says WHICH
+            # layer's activations were already drifting is worth far
+            # more than one that only says the process died
+            out["numerics"] = ns
+    except Exception:
+        pass
     return out
 
 

@@ -1,0 +1,519 @@
+"""Numerics observatory — in-graph tensor-health telemetry.
+
+The rest of the observability stack can say a step was *slow* (step_timer),
+where the HBM went (memory) and which rank straggled (fleet) — this module
+says whether the numbers inside the compiled program are *healthy*, and
+when they are not, which layer broke first. Three pieces:
+
+* **tap seam** — ``numerics.tap(name, x)`` threaded through the model
+  (``LlamaDecoderLayer``/attention/MLP/loss-head). Disarmed it is ONE
+  module-attribute read returning ``x`` unchanged — the traced program is
+  bit-identical to a never-instrumented build (guarded by a tier-1
+  compile-key test). Armed during the trace of an *instrumented*
+  executable it records per-tap abs-max / mean / rms / non-finite-count
+  scalars *inside the program* (no host round-trips), in execution — i.e.
+  topological — order.
+* **sampling** — ``TrainStep`` compiles a SECOND cached executable (same
+  compile-once contract as train/eval) that additionally emits the tap
+  scalars, per-parameter-bucket gradient norms + non-finite counts
+  (riding the PR 7 ``FlatLayout`` buckets, so the per-param kernel storm
+  does not return) and update/param-norm ratios from the fused optimizer
+  deltas. It runs every ``PADDLE_TPU_NUMERICS_EVERY`` steps when
+  ``PADDLE_TPU_NUMERICS=1``; results land in the ``numerics_*`` metric
+  families, a trace span, and the process :class:`NumericsObservatory`.
+* **consumers** — (1) NaN provenance: on a ``NaNGuard`` trip the guard
+  forces an instrumented *probe* replay of the last-consumed batch
+  (stashed — the batch is never donated) against the restored
+  checkpoint state with the tripped step's exact rng key, and
+  :func:`write_provenance` names the first non-finite tap/bucket in
+  topological order in ``nan_provenance_rank<r>_<pid>.json``;
+  (2) calibration: per-tap running abs-max + log2-bucketed percentile
+  sketches accumulate across sampled steps —
+  :meth:`NumericsObservatory.calibration_summary` is committed into the
+  checkpoint aux state (the substrate the quantized-serving roadmap item
+  consumes) and the serving engine's sampled decode taps publish
+  activation-range drift against it.
+
+See docs/OBSERVABILITY.md#numerics-observatory for the tap-seam contract,
+sampling model, provenance JSON schema and calibration summary format.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["tap", "scope", "suppress", "collect", "armed", "every",
+           "sample_this_step", "provenance_enabled", "numerics_metrics",
+           "NumericsObservatory", "get_observatory", "last_sample",
+           "write_provenance", "reduce_stats", "host_sample"]
+
+
+# -- env knobs ---------------------------------------------------------------
+
+def armed() -> bool:
+    """Master switch (``PADDLE_TPU_NUMERICS``): unset/0 keeps every
+    seam a no-op — no second executable, no gauges, bit-identical
+    programs. Read per call so tests (and live operators via a
+    relaunch) can flip it without caching surprises."""
+    return os.environ.get("PADDLE_TPU_NUMERICS", "0") not in \
+        ("0", "", "false", "off", "no")
+
+
+def every() -> int:
+    """Sampling period in steps (``PADDLE_TPU_NUMERICS_EVERY``, default
+    32): the instrumented executable runs on steps where
+    ``step % every == 0`` (plus step 1, so a blow-up in the first
+    window still leaves one sample). Malformed/non-positive values
+    fall back to the default."""
+    val = os.environ.get("PADDLE_TPU_NUMERICS_EVERY")
+    try:
+        n = int(val) if val else 32
+    except ValueError:
+        return 32
+    return n if n > 0 else 32
+
+
+def sample_this_step(step: int) -> bool:
+    """Should ``step`` (1-based) run the instrumented executable?"""
+    if not armed():
+        return False
+    return step == 1 or step % every() == 0
+
+
+def provenance_enabled() -> bool:
+    """Is the NaN-provenance replay armed? Default: rides the master
+    switch; ``PADDLE_TPU_NUMERICS_PROVENANCE=1`` forces it on (batch
+    stash + on-trip probe compile) with sampling off, ``0`` forces it
+    off even when numerics is armed."""
+    val = os.environ.get("PADDLE_TPU_NUMERICS_PROVENANCE")
+    if val is None or val == "":
+        return armed()
+    return val not in ("0", "false", "off", "no")
+
+
+# -- the tap seam ------------------------------------------------------------
+
+#: trace-time collector: None when disarmed (the ONE attribute read on
+#: the disarmed hot path), a list of (name, stats) while an instrumented
+#: executable is being traced. Module-global on purpose — the seam must
+#: be reachable from any model without threading a handle through every
+#: forward signature.
+_active: Optional[list] = None
+#: name-scope stack (``layers.3`` …) and the remat suppression depth
+_stack: List[str] = []
+_suppress: int = 0
+
+
+def _stats(a):
+    """(absmax, mean, rms, nonfinite_count) of an array, accumulated in
+    f32 — four scalars per tap, fused into the surrounding program by
+    XLA (one pass over a value that was already live)."""
+    import jax.numpy as jnp
+    f = a.astype(jnp.float32)
+    return (jnp.max(jnp.abs(f)), jnp.mean(f),
+            jnp.sqrt(jnp.mean(jnp.square(f))),
+            jnp.sum(jnp.logical_not(jnp.isfinite(f)).astype(jnp.int32)))
+
+
+def tap(name: str, x):
+    """Record tensor-health scalars for ``x`` when an instrumented trace
+    is collecting; ALWAYS returns ``x`` unchanged (identity — the tap
+    must never perturb the program's values). Accepts a framework
+    ``Tensor`` or a raw array. Disarmed cost: one module-attribute read."""
+    col = _active
+    if col is None or _suppress:
+        return x
+    a = getattr(x, "data", x)
+    full = ".".join(_stack + [name]) if _stack else name
+    col.append((full, _stats(a)))
+    return x
+
+
+@contextmanager
+def scope(name):
+    """Prefix taps in the body with ``<name>.`` (the model's per-layer
+    seam: ``with numerics.scope(f"layers.{i}")``). No-op when disarmed."""
+    if _active is None:
+        yield
+        return
+    _stack.append(str(name))
+    try:
+        yield
+    finally:
+        _stack.pop()
+
+
+@contextmanager
+def suppress():
+    """Silence taps in the body. Used around ``recompute`` (remat)
+    regions: values appended to the collector from inside a remat trace
+    would escape its scope as leaked tracers — the caller taps the
+    region's *output* instead."""
+    global _suppress
+    _suppress += 1
+    try:
+        yield
+    finally:
+        _suppress -= 1
+
+
+class _Collection:
+    """Handle returned by :func:`collect`; ``taps`` is a name->stats
+    dict (names deduplicated in call order) after the block exits."""
+
+    def __init__(self):
+        self.taps: Dict[str, tuple] = {}
+
+
+@contextmanager
+def collect(enabled: bool = True):
+    """Arm the collector for the body (an instrumented trace). Nested
+    arming is not supported — the inner collect wins the taps (traces
+    never nest instrumented programs in practice). ``enabled=False``
+    yields an empty collection without touching the seam, so the
+    disarmed trace stays bit-identical."""
+    col = _Collection()
+    if not enabled:
+        yield col
+        return
+    global _active
+    prev, _active = _active, []
+    try:
+        yield col
+    finally:
+        raw, _active = _active, prev
+        for name, st in raw:
+            key, k = name, 1
+            while key in col.taps:
+                k += 1
+                key = f"{name}#{k}"
+            col.taps[key] = st
+
+
+def reduce_stats(st, axis: str):
+    """Reduce one tap's per-shard stats across a shard_map mesh axis so
+    the instrumented bucketed-dp step emits replicated globals:
+    max→pmax, mean→pmean, rms→sqrt(pmean(rms²)), count→psum."""
+    import jax
+    import jax.numpy as jnp
+    absmax, mean, rms, nonfinite = st
+    return (jax.lax.pmax(absmax, axis), jax.lax.pmean(mean, axis),
+            jnp.sqrt(jax.lax.pmean(jnp.square(rms), axis)),
+            jax.lax.psum(nonfinite, axis))
+
+
+def host_sample(nums: dict, loss_val=None, tap_order=None) -> dict:
+    """Convert one instrumented executable's device-side numerics output
+    tree (``{"taps", "grads", "updates", "grad_norm"}``) to plain host
+    floats/ints — ONE device_get for the whole tree, on a sampled step
+    that already paid a host sync for its loss. ``tap_order`` restores
+    the taps' execution order (jax pytrees iterate dicts key-sorted;
+    provenance scans topologically)."""
+    import jax
+    h = jax.device_get(nums)
+    taps = h.get("taps", {})
+    if tap_order:
+        taps = {n: taps[n] for n in tap_order if n in taps}
+    sample = {
+        "taps": {n: (float(s[0]), float(s[1]), float(s[2]), int(s[3]))
+                 for n, s in taps.items()},
+        "grads": {n: (float(s[0]), int(s[1]))
+                  for n, s in h.get("grads", {}).items()},
+        "updates": {n: (float(s[0]), float(s[1]))
+                    for n, s in h.get("updates", {}).items()},
+    }
+    gn = h.get("grad_norm")
+    sample["grad_norm"] = float(gn) if gn is not None else None
+    if loss_val is not None:
+        sample["loss"] = float(jax.device_get(loss_val))
+    return sample
+
+
+# -- metric families ---------------------------------------------------------
+
+def numerics_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The ``numerics_*`` families (docs/OBSERVABILITY.md metric family
+    index): per-tap activation gauges, per-bucket gradient/update
+    gauges, the sample counter, and the serving decode-path twins."""
+    r = registry or get_registry()
+    return {
+        "samples": r.counter("numerics_samples_total",
+                             "instrumented numerics samples taken"),
+        "absmax": r.gauge("numerics_tap_absmax",
+                          "per-tap activation abs-max (last sample)"),
+        "rms": r.gauge("numerics_tap_rms",
+                       "per-tap activation rms (last sample)"),
+        "nonfinite": r.gauge("numerics_tap_nonfinite",
+                             "per-tap non-finite element count"),
+        "grad_norm": r.gauge("numerics_grad_norm",
+                             "per-parameter-bucket gradient L2 norm"),
+        "grad_nonfinite": r.gauge("numerics_grad_nonfinite",
+                                  "per-bucket non-finite gradient count"),
+        "update_ratio": r.gauge(
+            "numerics_update_ratio",
+            "per-bucket optimizer update-norm / param-norm ratio"),
+        "decode_absmax": r.gauge(
+            "numerics_decode_absmax",
+            "per-tap decode-path activation abs-max (serving)"),
+        "decode_drift": r.gauge(
+            "numerics_decode_drift_ratio",
+            "decode abs-max / training calibration abs-max"),
+    }
+
+
+# -- calibration sketch ------------------------------------------------------
+
+class _Sketch:
+    """Bounded-memory per-tap range sketch: running abs-max plus a
+    log2-bucketed histogram of sampled abs-max values — mergeable, and
+    good for the coarse percentiles (p50/p99) a quantization calibration
+    pass needs. Exact values are not the point; the *exponent* is."""
+
+    __slots__ = ("n", "absmax", "buckets")
+
+    def __init__(self):
+        self.n = 0
+        self.absmax = 0.0
+        self.buckets: Dict[int, int] = {}
+
+    @staticmethod
+    def _bucket(v: float) -> int:
+        if not math.isfinite(v):
+            return 1 << 20          # the "non-finite" bucket, sorts last
+        if v <= 0.0:
+            return -(1 << 20)       # zeros sort first
+        return int(math.floor(math.log2(v)))
+
+    def add(self, v: float):
+        self.n += 1
+        if math.isfinite(v) and v > self.absmax:
+            self.absmax = v
+        b = self._bucket(v)
+        self.buckets[b] = self.buckets.get(b, 0) + 1
+
+    def percentile(self, q: float) -> float:
+        """Upper edge (2^(b+1)) of the bucket holding quantile ``q``."""
+        if not self.n:
+            return 0.0
+        target = q * self.n
+        seen = 0
+        for b in sorted(self.buckets):
+            seen += self.buckets[b]
+            if seen >= target:
+                if b <= -(1 << 20):
+                    return 0.0
+                if b >= 1 << 20:
+                    return float("inf")
+                return float(2.0 ** (b + 1))
+        return self.absmax
+
+    def summary(self) -> dict:
+        return {"n": self.n, "absmax": self.absmax,
+                "p50": self.percentile(0.50), "p99": self.percentile(0.99),
+                "buckets": {str(k): v for k, v in sorted(
+                    self.buckets.items())}}
+
+    def merge(self, doc: dict):
+        self.n += int(doc.get("n", 0))
+        self.absmax = max(self.absmax, float(doc.get("absmax", 0.0)))
+        for k, v in (doc.get("buckets") or {}).items():
+            k = int(k)
+            self.buckets[k] = self.buckets.get(k, 0) + int(v)
+
+
+# -- the observatory ---------------------------------------------------------
+
+class NumericsObservatory:
+    """Host-side accumulator behind the module seams: keeps the last
+    instrumented sample (for postmortems), folds each sample's tap
+    abs-maxes into per-tap calibration sketches, and publishes the
+    ``numerics_*`` gauges + a trace span per sample."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or get_registry()
+        self._m = numerics_metrics(self.registry)
+        self.last: Optional[dict] = None
+        self.last_step: Optional[int] = None
+        self.sketches: Dict[str, _Sketch] = {}
+
+    # -- training samples ---------------------------------------------------
+    def record_sample(self, step: int, sample: dict):
+        """Fold one host-converted instrumented-step sample in:
+        ``{"taps": {name: (absmax, mean, rms, nonfinite)},
+        "grads": {bucket: (norm, nonfinite)},
+        "updates": {bucket: (update_norm, param_norm)},
+        "grad_norm": float|None, "loss": float}``."""
+        t0 = time.perf_counter_ns()
+        self.last = sample
+        self.last_step = int(step)
+        m = self._m
+        m["samples"].inc()
+        nonfinite_total = 0
+        worst_absmax = 0.0
+        for name, (absmax, _mean, rms, nonf) in sample["taps"].items():
+            m["absmax"].set(absmax, tap=name)
+            m["rms"].set(rms, tap=name)
+            m["nonfinite"].set(nonf, tap=name)
+            nonfinite_total += int(nonf)
+            if math.isfinite(absmax):
+                worst_absmax = max(worst_absmax, absmax)
+            self.sketches.setdefault(name, _Sketch()).add(float(absmax))
+        for name, (norm, nonf) in sample.get("grads", {}).items():
+            m["grad_norm"].set(norm, bucket=name)
+            m["grad_nonfinite"].set(nonf, bucket=name)
+        for name, (unorm, pnorm) in sample.get("updates", {}).items():
+            m["update_ratio"].set(unorm / pnorm if pnorm else 0.0,
+                                  bucket=name)
+        from . import trace
+        if trace.active() is not None:
+            t1 = time.perf_counter_ns()
+            trace.span("numerics", "sample", t0, t1, args={
+                "step": int(step), "taps": len(sample["taps"]),
+                "nonfinite_total": nonfinite_total,
+                "worst_absmax": worst_absmax,
+                "grad_norm": sample.get("grad_norm")})
+
+    # -- serving decode samples ---------------------------------------------
+    def record_decode(self, taps: Dict[str, tuple]):
+        """Publish a sampled decode step's tap abs-maxes and — when a
+        training calibration sketch exists for the tap — the
+        activation-range drift ratio vs the calibrated abs-max (the
+        "is serving seeing ranges the quantization calibration never
+        saw" gauge)."""
+        m = self._m
+        for name, st in taps.items():
+            absmax = float(st[0])
+            m["decode_absmax"].set(absmax, tap=name)
+            sk = self.sketches.get(name)
+            if sk is not None and sk.absmax > 0:
+                m["decode_drift"].set(absmax / sk.absmax, tap=name)
+
+    # -- calibration export -------------------------------------------------
+    def calibration_summary(self) -> dict:
+        """Per-tap range summaries accumulated over every instrumented
+        sample so far — the checkpoint-aux calibration substrate
+        (``FitResilience`` commits it under the ``"numerics"`` key)."""
+        return {"version": 1, "taps": {name: sk.summary() for name, sk
+                                       in sorted(self.sketches.items())}}
+
+    def load_summary(self, doc: dict):
+        """Merge a previously exported summary (resume continues the
+        sketches; a serving process loads the training calibration for
+        the decode drift gauges)."""
+        for name, s in (doc.get("taps") or {}).items():
+            self.sketches.setdefault(name, _Sketch()).merge(s)
+
+
+_observatory: Optional[NumericsObservatory] = None
+
+
+def get_observatory() -> NumericsObservatory:
+    global _observatory
+    if _observatory is None:
+        _observatory = NumericsObservatory()
+    return _observatory
+
+
+def last_sample() -> Optional[dict]:
+    """The most recent instrumented sample (with its step), or None —
+    the flight recorder appends this to crash/watchdog postmortems so a
+    dump carries the last-known tensor health."""
+    obs = _observatory
+    if obs is None or obs.last is None:
+        return None
+    return {"step": obs.last_step, **obs.last}
+
+
+# -- NaN provenance ----------------------------------------------------------
+
+def _first_nonfinite(sample: dict) -> Optional[dict]:
+    """First non-finite site in topological order: forward taps (their
+    recorded order IS execution order), then the loss, then the gradient
+    buckets (backward — a finite forward with non-finite grads names the
+    bucket that overflowed)."""
+    for name, (absmax, mean, _rms, nonf) in sample["taps"].items():
+        if int(nonf) > 0 or not math.isfinite(float(absmax)) \
+                or not math.isfinite(float(mean)):
+            return {"kind": "tap", "name": name,
+                    "nonfinite_count": int(nonf)}
+    loss = sample.get("loss")
+    if loss is not None and not math.isfinite(float(loss)):
+        return {"kind": "loss", "name": "loss", "nonfinite_count": 1}
+    for name, (norm, nonf) in sample.get("grads", {}).items():
+        if int(nonf) > 0 or not math.isfinite(float(norm)):
+            return {"kind": "grad_bucket", "name": name,
+                    "nonfinite_count": int(nonf)}
+    return None
+
+
+def _rank() -> int:
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def write_provenance(train_step, step: int, trip_kind: str,
+                     out_dir: Optional[str] = None) -> Optional[str]:
+    """The NaNGuard consumer: force an instrumented probe replay of the
+    stashed last batch through ``train_step`` (forward + grads only —
+    nothing donated, nothing updated; the tripped step's exact rng key)
+    and write ``nan_provenance_rank<r>_<pid>.json`` naming the first
+    non-finite tap/bucket in topological order. Returns the path, or
+    None when no stash/probe is available. The caller restores the last
+    committed checkpoint FIRST, so the replay runs against the same
+    state training resumes from — a trip whose replay comes back
+    all-finite is recorded with ``verdict: "finite_in_graph"`` (a
+    host-side corruption, e.g. the chaos corrupt-loss seam, or an
+    update-order transient the rollback already cleared)."""
+    probe = getattr(train_step, "numerics_probe_last", None)
+    if probe is None:
+        return None
+    sample = probe()
+    if sample is None:
+        return None
+    first = _first_nonfinite(sample)
+    doc = {
+        "schema": "nan_provenance_v1",
+        "step": int(step),
+        "trip_kind": trip_kind,
+        "rank": _rank(),
+        "pid": os.getpid(),
+        "unix_time": time.time(),
+        "verdict": "nonfinite_in_graph" if first is not None
+                   else "finite_in_graph",
+        "first_nonfinite": first,
+        "replay": {
+            "loss": sample.get("loss"),
+            "grad_norm": sample.get("grad_norm"),
+            "taps": {n: {"absmax": float(s[0]), "mean": float(s[1]),
+                         "rms": float(s[2]), "nonfinite": int(s[3])}
+                     for n, s in sample["taps"].items()},
+            "grad_buckets": {n: {"norm": float(s[0]),
+                                 "nonfinite": int(s[1])}
+                             for n, s in sample.get("grads", {}).items()},
+        },
+    }
+    d = out_dir or os.environ.get("PADDLE_TPU_TRACE_DIR",
+                                  "/tmp/paddle_tpu_trace")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(
+        d, f"nan_provenance_rank{_rank()}_{os.getpid()}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    from . import flight_recorder
+    now = time.time_ns()
+    flight_recorder.record(
+        flight_recorder.KIND_USER, "nan_provenance", now, now,
+        aux=int(step), args={"step": int(step), "trip_kind": trip_kind,
+                             "verdict": doc["verdict"],
+                             "first_nonfinite": first, "path": path})
+    return path

@@ -92,6 +92,9 @@ class StepTimer:
         self._c_steps = r.counter("train_steps_total", "steps completed")
         self._c_samples = r.counter("train_samples_total",
                                     "samples consumed")
+        self._g_gnorm = r.gauge(
+            "train_grad_norm",
+            "global gradient L2 norm (clip path, per step)")
         self._t0 = None
         self._data_time = 0.0
         self._comm0 = None
@@ -115,9 +118,14 @@ class StepTimer:
         self._t0 = time.perf_counter()
 
     def end_step(self, samples: Optional[int] = None,
-                 tokens: Optional[int] = None) -> dict:
+                 tokens: Optional[int] = None,
+                 grad_norm: Optional[float] = None) -> dict:
         if self._t0 is None:
             return {}
+        if grad_norm is not None:
+            # the clip path computes this every step and used to throw
+            # it away — surfaced per docs/OBSERVABILITY.md#numerics
+            self._g_gnorm.set(float(grad_norm))
         t1 = time.perf_counter()
         busy = t1 - self._t0
         comm1 = comm_totals()

@@ -103,6 +103,14 @@ class FitResilience(Callback):
             # same committed step as model+opt: the restored iterator
             # resumes at exactly the batch after the last trained one
             self.pipeline.load_state_dict(state["data"])
+        if isinstance(state, dict) and "numerics" in state:
+            # resume the calibration sketches where the previous
+            # incarnation left them (merge: sketches are additive)
+            from paddle_tpu.observability import numerics
+            try:
+                numerics.get_observatory().load_summary(state["numerics"])
+            except Exception:
+                pass  # calibration is telemetry; never block a resume
         restored = self.manager.last_restored_step
         meta = self.manager.metadata(restored)
         self._step0 = int(meta.get("global_step", restored))
@@ -176,6 +184,18 @@ class FitResilience(Callback):
             state["optimizer"] = opt.state_dict()
         if self.pipeline is not None:
             state["data"] = self.pipeline.state_dict()
+        from paddle_tpu.observability import numerics
+        if numerics.armed():
+            # calibration aux state (docs/OBSERVABILITY.md#numerics):
+            # per-tap activation-range sketches accumulated over every
+            # instrumented sample — committed with the weights so a
+            # resumed run (see restore()) keeps accumulating, and the
+            # quantized-serving calibration pass reads them offline.
+            # apply_restored_state ignores unknown keys, so rollback
+            # paths are untouched.
+            summary = numerics.get_observatory().calibration_summary()
+            if summary["taps"]:
+                state["numerics"] = summary
         return state
 
     def _final_save(self, gs: int):

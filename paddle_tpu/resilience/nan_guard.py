@@ -112,6 +112,7 @@ class NaNGuard(Callback):
                 "exhausted; the run is numerically unstable")
         restored = self._restore_last_commit()
         rollback_counter(self.registry).inc()
+        provenance = self._write_provenance(step, kind)
         if restored is not None and step > restored:
             # the steps between the restored commit and the trip were
             # just thrown away — reclassify their ledger seconds from
@@ -129,8 +130,32 @@ class NaNGuard(Callback):
              if restored is not None else
              "no committed checkpoint to roll back to — continuing with "
              "current (possibly poisoned) parameters") +
-            f" (rollback {self.rollbacks}/{self.max_rollbacks})",
+            f" (rollback {self.rollbacks}/{self.max_rollbacks})" +
+            (f"; NaN provenance: {provenance}" if provenance else ""),
             RuntimeWarning, stacklevel=2)
+
+    def _write_provenance(self, step: int, kind: str) -> Optional[str]:
+        """NaN provenance (docs/OBSERVABILITY.md#numerics): instrumented
+        replay of the batch that tripped us against the just-restored
+        state, naming the first non-finite tap/bucket in topological
+        order in ``nan_provenance_rank<r>_<pid>.json`` + a flight-recorder
+        event. Needs ``PADDLE_TPU_NUMERICS`` (or _PROVENANCE) armed and a
+        compiled-TrainStep model (the batch stash lives there); any
+        failure is swallowed — provenance is evidence, not a remedy, and
+        must never break the rollback that just saved the run."""
+        from paddle_tpu.observability import numerics
+        if not numerics.provenance_enabled():
+            return None
+        train_step = getattr(getattr(self, "model", None),
+                             "_train_step", None)
+        if train_step is None:
+            return None
+        try:
+            return numerics.write_provenance(train_step, step, kind)
+        except Exception:
+            warnings.warn("[nan_guard] provenance replay failed",
+                          RuntimeWarning, stacklevel=2)
+            return None
 
     def _restore_last_commit(self) -> Optional[int]:
         mgr = self.manager

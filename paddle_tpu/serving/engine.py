@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from typing import Callable, List, Optional, Sequence
 
 import jax
@@ -235,6 +236,16 @@ class ServingEngine:
         #: so it equals the number of compiles of the ONE unified step
         self.step_traces = 0
         self._step = self._build_step()
+        # numerics twin (docs/OBSERVABILITY.md#numerics): an instrumented
+        # build of the SAME unified step, compiled lazily on the first
+        # sampled step when PADDLE_TPU_NUMERICS is armed — it substitutes
+        # for the plain step on sampled steps (taps are identity, so the
+        # logits are the same program), feeding the decode-path
+        # activation-range drift gauges. Disarmed: both stay None and the
+        # engine is byte-for-byte the pre-numerics engine.
+        self._numerics_step = None
+        self._numerics_order = None
+        self._decode_steps = 0
 
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
@@ -285,15 +296,17 @@ class ServingEngine:
             self._st = {**train, **frozen, **buffers}
 
     # -- the one compiled step ---------------------------------------------
-    def _build_step(self):
+    def _build_step(self, instrument: bool = False):
         from paddle_tpu.core.autograd import no_grad
         from paddle_tpu.core.tensor import Tensor
         from paddle_tpu.jit.functional import swap_state
+        from paddle_tpu.observability import numerics
         from paddle_tpu.ops import paged_attention as pa
 
         model, backbone, project = self.model, self._backbone, self._project
         nl = self.model.cfg.num_hidden_layers
         impl = self.attn_impl
+        tap_order = [] if instrument else None
 
         def step(stt, tokens, k_pools, v_pools, bt, cu, ctx, sid, pos,
                  ssq, sbk, last_idx):
@@ -304,8 +317,8 @@ class ServingEngine:
                 Tensor(k_pools[i]), Tensor(v_pools[i]), Tensor(bt),
                 Tensor(cu), Tensor(ctx), Tensor(sid), Tensor(pos),
                 Tensor(ssq), Tensor(sbk)) for i in range(nl)]
-            with no_grad(), swap_state(model, stt,
-                                       collect_buffers=False), \
+            with numerics.collect(instrument) as col, no_grad(), \
+                    swap_state(model, stt, collect_buffers=False), \
                     pa.impl_override(impl):
                 h, new_caches = backbone(Tensor(tokens), caches=caches)
                 # logits at each sequence's LAST packed token (rows of
@@ -315,12 +328,19 @@ class ServingEngine:
                 logits = project(hsel)             # [max_batch, 1, V]
             kps = tuple(c.k_pool.data for c in new_caches)
             vps = tuple(c.v_pool.data for c in new_caches)
-            return logits.data[:, 0].astype(jnp.float32), kps, vps
+            out = logits.data[:, 0].astype(jnp.float32), kps, vps
+            if not instrument:
+                return out
+            # trace-time fill of the execution-order cell (jax pytrees
+            # iterate dicts key-sorted; the drift gauges want model order)
+            tap_order[:] = list(col.taps)
+            return out + (col.taps,)
 
         # donating the pools lets XLA update them in place on TPU; the
         # CPU backend can't honor donation (harmless warning), so gate it
         donate = (2, 3) if jax.default_backend() == "tpu" else ()
-        return jax.jit(step, donate_argnums=donate)
+        fn = jax.jit(step, donate_argnums=donate)
+        return (fn, tap_order) if instrument else fn
 
     def memory_report(self):
         """XLA's memory accounting of the ONE unified step
@@ -578,14 +598,34 @@ class ServingEngine:
             # cached all-sentinel maps instead of rebuilding per step
             ssq, sbk = self._null_step_maps
 
+        from paddle_tpu.observability import numerics
+
+        # numerics sampling (docs/OBSERVABILITY.md#numerics): on a
+        # sampled step the instrumented twin SUBSTITUTES for the plain
+        # step — same program values (taps are identity), one extra
+        # output carrying the per-tap activation stats that feed the
+        # decode drift gauges. Lazy compile: the twin is traced on the
+        # first sampled step only; disarmed engines never build it.
+        self._decode_steps += 1
+        step_fn, taps_out = self._step, None
+        if numerics.sample_this_step(self._decode_steps):
+            if self._numerics_step is None:
+                self._numerics_step, self._numerics_order = \
+                    self._build_step(instrument=True)
+            step_fn = self._numerics_step
+
         t0 = time.perf_counter_ns()
         compiles0 = self.step_traces
         try:
-            logits, kps, vps = self._step(
+            out = step_fn(
                 self._st, jnp.asarray(tokens), self.cache.k_pools,
                 self.cache.v_pools, jnp.asarray(bt), jnp.asarray(cu),
                 jnp.asarray(ctx), jnp.asarray(sid), jnp.asarray(pos),
                 jnp.asarray(ssq), jnp.asarray(sbk), jnp.asarray(last_idx))
+            if step_fn is self._step:
+                logits, kps, vps = out
+            else:
+                logits, kps, vps, taps_out = out
         except Exception as e:
             # RESOURCE_EXHAUSTED gets one postmortem (ledger owners +
             # the unified step's memory report) before re-raising into
@@ -600,6 +640,16 @@ class ServingEngine:
         compiled = self.step_traces - compiles0
         self._m_steps.inc(kind="unified")
         arr = np.asarray(logits)
+        if taps_out is not None:
+            try:
+                h = jax.device_get(taps_out)
+                order = self._numerics_order or list(h)
+                numerics.get_observatory().record_decode(
+                    {n: tuple(float(v) for v in h[n])
+                     for n in order if n in h})
+            except Exception:
+                warnings.warn("[numerics] decode sample publication "
+                              "failed", RuntimeWarning)
 
         for i, (seq, n, is_prefill) in enumerate(entries):
             if is_prefill:

@@ -107,7 +107,7 @@ class TestRuleLevelBitExact:
         train = {n: params[n].data for n in names}
         lrs = [np.float32(o.get_lr())]
 
-        new_train, new_flats, _ = fused_clip_and_update(
+        new_train, new_flats, _, _ = fused_clip_and_update(
             o, layout, train, grads, flats, lrs, lambda g: g)
         per = split_flat_states(layout, new_flats)
 

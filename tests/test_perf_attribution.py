@@ -244,7 +244,7 @@ class TestBenchReportGate:
 #: family-name prefixes owned by this framework's telemetry
 _FAMILY_PREFIXES = ("comm_", "train_", "serving_", "ckpt_",
                     "resilience_", "data_", "loader_", "attribution_",
-                    "hbm_", "fleet_", "goodput_", "job_")
+                    "hbm_", "fleet_", "goodput_", "job_", "numerics_")
 
 #: backticked doc tokens that look like families but are not registry
 #: metrics: `comm_bytes` is the chrome-trace counter-track name,
@@ -284,7 +284,11 @@ _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           # HBM-ledger owner names (the {owner} label
                           # values of hbm_bytes, docs/OBSERVABILITY.md
                           # #memory), not families themselves
-                          "serving_params", "data_prefetch"}
+                          "serving_params", "data_prefetch",
+                          # bench.py --numerics report-gate headline
+                          # (ISSUE 14) — a stdout {"metric","value"}
+                          # line, not a registry family
+                          "numerics_step_overhead_frac"}
 
 
 def _documented_families():
@@ -334,6 +338,7 @@ def _registered_families():
     from paddle_tpu.observability.fleet import fleet_metrics
     from paddle_tpu.observability.goodput import goodput_metrics
     from paddle_tpu.observability.memory import memory_metrics
+    from paddle_tpu.observability.numerics import numerics_metrics
     from paddle_tpu.resilience.counters import (
         nonfinite_counter, preemption_counter, rollback_counter,
         watchdog_metrics)
@@ -347,6 +352,7 @@ def _registered_families():
     fleet_metrics()
     goodput_metrics()
     memory_metrics()
+    numerics_metrics()
     serving_metrics()
     nonfinite_counter(), rollback_counter(), preemption_counter()
     watchdog_metrics()
