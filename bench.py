@@ -383,7 +383,14 @@ def bench_serve():
     (``serving_p99_ttft_seconds`` LOWER_BETTER /
     ``serving_decode_tokens_per_sec`` HIGHER_BETTER, ``_cpu_smoke``
     suffix off-TPU), so ``--report`` holds the RPA win against
-    regression. On TPU the model is the headline 0.7B bf16 Llama config;
+    regression. A second, shared-prefix Poisson trace (every request =
+    one long common prefix + a short unique tail) runs cache-off then
+    cache-on and emits the prefix-cache headlines
+    (``serving_prefix_cache_hit_rate`` / ``serving_shared_prefix_speedup``
+    HIGHER_BETTER, ``serving_cached_p99_ttft_seconds`` /
+    ``serving_cold_p99_ttft_seconds`` LOWER_BETTER), gating the 2x
+    effective-throughput claim. On TPU the model is the headline 0.7B
+    bf16 Llama config;
     elsewhere a smoke config keeps the bench runnable anywhere. Results
     ride the ``--emit-metrics`` JSON schema.
     """
@@ -467,11 +474,69 @@ def bench_serve():
             "step_compiles": stats["step_compiles"],
         }
 
+    def run_shared_prefix(prefix_cache):
+        """Shared-prefix Poisson trace (ISSUE 15): every request opens
+        with the same long system prefix and diverges in a short unique
+        tail — the traffic shape the block-granular prefix cache exists
+        for. Same workload cache-on vs cache-off, so the effective-
+        throughput ratio (generated tokens over wall-clock INCLUDING
+        queue/prefill time) is the cache's end-to-end win."""
+        if on_tpu:
+            pfx_len, tail_lo, tail_hi, gen_n, n, gap = 256, 8, 24, 24, 24, 0.02
+        else:
+            pfx_len, tail_lo, tail_hi, gen_n, n, gap = 96, 2, 6, 2, 10, 0.002
+        engine = ServingEngine(model, attn_impl=impls[0],
+                               prefix_cache=prefix_cache, **eng_kw)
+        engine.start()
+        rng = np.random.RandomState(1)
+        prefix = list(rng.randint(1, cfg.vocab_size, pfx_len))
+        # warmup: compiles the step and (cache-on) registers the prefix
+        engine.submit(prefix, max_new_tokens=2).result(timeout=600)
+        gaps = rng.exponential(gap, n)
+        tails = [list(rng.randint(1, cfg.vocab_size,
+                                  rng.randint(tail_lo, tail_hi + 1)))
+                 for _ in range(n)]
+        handles = []
+        t0 = _time.perf_counter()
+        for g, tail in zip(gaps, tails):
+            _time.sleep(g)
+            handles.append(engine.submit(prefix + tail,
+                                         max_new_tokens=gen_n))
+        engine.drain(timeout=600)
+        elapsed = _time.perf_counter() - t0
+        results = [h.result(timeout=1) for h in handles]
+        stats = engine.stats()
+        engine.shutdown()
+        ttfts = np.array([r["ttft_s"] for r in results])
+        gen_tokens = int(sum(r["num_generated"] for r in results))
+        pc = stats.get("prefix_cache") or {}
+        return {
+            "prefix_cache": bool(prefix_cache),
+            "prefix_len": pfx_len,
+            "requests": n,
+            "ttft_p50_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+            "ttft_p99_ms": round(float(np.percentile(ttfts, 99)) * 1e3, 2),
+            "effective_tokens_per_sec": round(gen_tokens / elapsed, 1),
+            "elapsed_s": round(elapsed, 3),
+            "hit_rate": pc.get("hit_rate", 0.0),
+            "hit_tokens": pc.get("hit_tokens", 0),
+            "evictions": pc.get("evictions", 0),
+        }
+
     out = {}
     for impl in impls:
         out[impl] = run_trace(impl)
         print(json.dumps({impl: out[impl]}), file=sys.stderr, flush=True)
         gc.collect()
+    shared = {"cold": run_shared_prefix(False),
+              "cached": run_shared_prefix(True)}
+    shared["speedup"] = round(
+        shared["cached"]["effective_tokens_per_sec"]
+        / max(shared["cold"]["effective_tokens_per_sec"], 1e-9), 2)
+    out["shared_prefix"] = shared
+    print(json.dumps({"shared_prefix": shared}), file=sys.stderr,
+          flush=True)
+    gc.collect()
     primary = out[impls[0]]
     # flatten the primary impl's numbers at the top level (the committed
     # BENCH_r0*.json "parsed" shape earlier rounds gated on)
@@ -490,6 +555,19 @@ def bench_serve():
     print(json.dumps({"metric": f"serving_decode_tokens_per_sec{sfx}",
                       "value": primary["tokens_per_sec"],
                       "unit": "tokens/sec"}))
+    print(json.dumps({"metric": f"serving_prefix_cache_hit_rate{sfx}",
+                      "value": shared["cached"]["hit_rate"],
+                      "unit": "fraction"}))
+    print(json.dumps({"metric": f"serving_cached_p99_ttft_seconds{sfx}",
+                      "value": round(shared["cached"]["ttft_p99_ms"] / 1e3,
+                                     4),
+                      "unit": "seconds"}))
+    print(json.dumps({"metric": f"serving_cold_p99_ttft_seconds{sfx}",
+                      "value": round(shared["cold"]["ttft_p99_ms"] / 1e3, 4),
+                      "unit": "seconds"}))
+    print(json.dumps({"metric": f"serving_shared_prefix_speedup{sfx}",
+                      "value": shared["speedup"],
+                      "unit": "x"}))
     return out
 
 
@@ -992,6 +1070,11 @@ REPORT_HIGHER_BETTER = {
     # --chaos goodput ledger headline — restart/rollback badput must
     # not silently grow
     "job_goodput_fraction",
+    # block-granular prefix cache on shared-prefix traffic (ISSUE 15):
+    # fraction of admissions that reused cached KV blocks, and the
+    # cache-on/cache-off effective-throughput ratio on the same trace
+    "serving_prefix_cache_hit_rate",
+    "serving_shared_prefix_speedup",
 }
 REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        # step-glue fusion/overlap trajectory (ISSUE 7):
@@ -1002,6 +1085,12 @@ REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        # serving tail latency under the RPA kernel
                        # (ISSUE 8): bench.py --serve p99 TTFT
                        "serving_p99_ttft_seconds",
+                       # shared-prefix trace tail latency with the
+                       # prefix cache on and off (ISSUE 15) — the
+                       # cached path must hold its TTFT win and the
+                       # cold oracle must not quietly degrade either
+                       "serving_cached_p99_ttft_seconds",
+                       "serving_cold_p99_ttft_seconds",
                        # static program-audit headlines (ISSUE 9,
                        # bench.py --audit / paddle_tpu.analysis): dp
                        # collective census, bytes the step keeps

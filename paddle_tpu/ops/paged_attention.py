@@ -54,7 +54,7 @@ import jax.numpy as jnp
 __all__ = ["PagedLayerCache", "RaggedLayerCache", "write_to_pool",
            "write_tokens_to_pool", "gather_pool", "paged_attention_step",
            "ragged_gather_attention", "ragged_paged_attention_step",
-           "paged_attention_impl", "impl_override"]
+           "paged_attention_impl", "impl_override", "mesh_override"]
 
 
 class PagedLayerCache(NamedTuple):
@@ -209,6 +209,35 @@ def impl_override(value):
         _impl_local.value = prev
 
 
+@contextlib.contextmanager
+def mesh_override(mesh):
+    """Pin a tensor-parallel mesh for the ragged calls traced inside
+    the block on this thread (``None`` = single-device, a no-op). The
+    serving engine wraps its unified step's trace in this; the rpa
+    branch of :func:`ragged_paged_attention_step` reads it to shard_map
+    the Pallas kernel over the model-parallel axis (the kernel is
+    opaque to GSPMD — the gather fallback needs nothing, XLA partitions
+    it from the pool/projection shardings alone)."""
+    prev = getattr(_impl_local, "mesh", None)
+    _impl_local.mesh = mesh
+    try:
+        yield
+    finally:
+        _impl_local.mesh = prev
+
+
+def _tp_mesh():
+    """(mesh, mp_axis_name) when a tensor-parallel mesh with a >1
+    model axis is pinned on this thread, else None."""
+    mesh = getattr(_impl_local, "mesh", None)
+    if mesh is None:
+        return None
+    for cand in ("mp", "model", "tp"):
+        if cand in mesh.axis_names and mesh.shape[cand] > 1:
+            return mesh, cand
+    return None
+
+
 def write_tokens_to_pool(pool, new, block_tables, seq_ids, positions):
     """Scatter ``new`` [T, n_kv, hd] into ``pool`` at each token's
     ``positions`` through its sequence's block-table row. Padding tokens
@@ -272,9 +301,34 @@ def ragged_paged_attention_step(q, k, v, k_pool, v_pool, block_tables,
     if paged_attention_impl() == "rpa":
         from paddle_tpu.ops.pallas.ragged_paged_attention import \
             ragged_paged_attention
-        out = ragged_paged_attention(
-            q, k_pool, v_pool, block_tables, cu_seqlens, context_lens,
-            step_seq, step_blk, sm_scale=scale)
+        tp = _tp_mesh()
+        if tp is not None:
+            # SPMD over the kernel's head dimension (ISSUE 15): Pallas
+            # is opaque to GSPMD, so shard_map runs one kernel instance
+            # per mp shard — q over n_heads, pools over n_kv (whole GQA
+            # groups stay together because n_heads/n_kv shard by the
+            # same factor), metadata replicated. Attention is
+            # embarrassingly parallel across heads: no collective is
+            # introduced here (the o_proj psum stays GSPMD's).
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+            mesh, ax = tp
+            heads = P(None, ax, None)
+            pools = P(None, None, ax, None)
+            rep = P()
+            out = shard_map(
+                lambda qa, kp, vp, bt, cu, ctx, ssq, sbk:
+                    ragged_paged_attention(qa, kp, vp, bt, cu, ctx,
+                                           ssq, sbk, sm_scale=scale),
+                mesh=mesh,
+                in_specs=(heads, pools, pools, rep, rep, rep, rep, rep),
+                out_specs=heads, check_rep=False)(
+                q, k_pool, v_pool, block_tables, cu_seqlens,
+                context_lens, step_seq, step_blk)
+        else:
+            out = ragged_paged_attention(
+                q, k_pool, v_pool, block_tables, cu_seqlens,
+                context_lens, step_seq, step_blk, sm_scale=scale)
     else:
         out = ragged_gather_attention(
             q, k_pool, v_pool, block_tables, seq_ids, positions,

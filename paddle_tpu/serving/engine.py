@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .kv_cache import PagedKVCache
+from .kv_cache import PagedKVCache, chain_hash
 from .scheduler import Request, RequestState, Scheduler
 
 __all__ = ["ServingEngine", "RequestHandle", "serving_metrics"]
@@ -105,12 +105,31 @@ def _build_serving_metrics(reg) -> dict:
         # see pool pressure and compile churn without polling /healthz
         "kv_headroom": reg.gauge(
             "serving_kv_headroom",
-            "fraction of KV-cache blocks still free (pool pressure "
-            "before preemption-by-recompute starts churning)"),
+            "fraction of KV-cache blocks allocatable (free + reclaimable "
+            "prefix-cached — the pressure signal before "
+            "preemption-by-recompute starts churning)"),
+        "kv_reclaimable": reg.gauge(
+            "serving_kv_reclaimable",
+            "fraction of KV-cache blocks parked refcount-0 in the prefix "
+            "cache's reclaimable LRU tier (cache capacity, not pressure)"),
         "step_compiles": reg.gauge(
             "serving_step_compiles",
             "compiles of the ONE unified step executable (>1 means the "
             "compile-once contract broke)"),
+        # prefix-cache KV reuse (ISSUE 15)
+        "prefix_lookups": reg.counter(
+            "serving_prefix_cache_lookups",
+            "admissions that consulted the prefix-cache index"),
+        "prefix_hits": reg.counter(
+            "serving_prefix_cache_hits",
+            "admissions that reused at least one cached KV block"),
+        "prefix_evictions": reg.counter(
+            "serving_prefix_cache_evictions",
+            "reclaimable cached blocks repurposed by the allocator"),
+        "prefix_token_fraction": reg.gauge(
+            "serving_prefix_cached_token_fraction",
+            "cumulative fraction of prompt tokens served from the prefix "
+            "cache instead of being prefilled"),
     }
 
 
@@ -161,7 +180,11 @@ class ServingEngine:
                  block_size: int = 16, prefill_chunk: int = 16,
                  max_blocks_per_seq: Optional[int] = None,
                  warm_start_from: Optional[str] = None,
-                 attn_impl: Optional[str] = None):
+                 attn_impl: Optional[str] = None,
+                 prefix_cache: Optional[bool] = None,
+                 mesh=None):
+        import os
+
         from paddle_tpu.jit.functional import functional_state
         from paddle_tpu.models.generation import decode_surfaces
         from paddle_tpu.ops import paged_attention as pa
@@ -182,6 +205,45 @@ class ServingEngine:
         nl = cfg.num_hidden_layers
         n_kv = cfg.num_key_value_heads
         hd = cfg.hidden_size // cfg.num_attention_heads
+        #: block-granular prefix-cache KV reuse (ISSUE 15) — on by
+        #: default; PADDLE_TPU_PREFIX_CACHE=0 (or prefix_cache=False)
+        #: restores the cache-off engine, the bit-parity oracle
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "PADDLE_TPU_PREFIX_CACHE", "1").lower() not in (
+                "0", "off", "false")
+        self.prefix_cache_enabled = bool(prefix_cache)
+        #: tensor-parallel serving (ISSUE 15): mesh= shards the weights
+        #: and the per-layer KV pools over the model-parallel axis; with
+        #: no explicit mesh, PADDLE_TPU_SERVING_MP=N builds an mp mesh
+        #: over the first N local devices
+        if mesh is None:
+            mp_env = int(os.environ.get("PADDLE_TPU_SERVING_MP", "0"))
+            if mp_env > 1:
+                from jax.sharding import Mesh
+                devs = jax.devices()
+                if len(devs) < mp_env:
+                    raise ValueError(
+                        f"PADDLE_TPU_SERVING_MP={mp_env} but only "
+                        f"{len(devs)} devices are visible")
+                mesh = Mesh(np.array(devs[:mp_env]), ("mp",))
+        self.mesh = mesh
+        self._mp_axis = None
+        if mesh is not None:
+            from paddle_tpu.distributed.fleet.mpu import _mp_axis
+            self._mp_axis = _mp_axis(mesh)
+            mp = mesh.shape[self._mp_axis]
+            if mp > 1 and n_kv % mp:
+                raise ValueError(
+                    f"tensor-parallel serving shards the KV pools over "
+                    f"the '{self._mp_axis}' axis: num_key_value_heads "
+                    f"{n_kv} must divide by its size {mp}")
+            if mp > 1 and not getattr(cfg, "tensor_parallel", False):
+                warnings.warn(
+                    "ServingEngine(mesh=) over a model built without "
+                    "tensor_parallel=True: weights stay replicated; only "
+                    "the KV pools shard", RuntimeWarning)
+            self._shard_state()
         # position cap = the attention layers' RoPE table length.
         # MoeConfig carries no cap of its own — its attention blocks are
         # built from _attn_cfg(), so read the cap from there (falling
@@ -194,7 +256,10 @@ class ServingEngine:
         if max_blocks_per_seq is None:
             max_blocks_per_seq = min(max_blocks, -(-max_pos // block_size))
         self.cache = PagedKVCache(nl, max_blocks, block_size, n_kv, hd,
-                                  max_blocks_per_seq, dtype)
+                                  max_blocks_per_seq, dtype,
+                                  prefix_cache=self.prefix_cache_enabled)
+        if self.mesh is not None:
+            self.cache.shard_pools(self.mesh, self._mp_axis)
         self.max_model_len = min(self.cache.max_seq_len, max_pos)
         self.max_batch = int(max_batch)
         self.prefill_chunk = int(prefill_chunk)
@@ -253,6 +318,10 @@ class ServingEngine:
         self._shutdown = False
         self._handles = {}  # req_id -> RequestHandle
         self._published_preemptions = 0
+        # prefix-cache counter cursors (registry counters are process-
+        # global; publish per-engine deltas like preemptions do)
+        self._published_prefix = {"lookups": 0, "hits": 0, "evictions": 0}
+        self._prompt_tokens_prefilled = 0
         self._init_metrics()
 
     # -- weights -----------------------------------------------------------
@@ -294,6 +363,29 @@ class ServingEngine:
             self._load_into_model(self.model, path, step)
             train, frozen, buffers = functional_state(self.model)
             self._st = {**train, **frozen, **buffers}
+            if self.mesh is not None:
+                self._shard_state()
+
+    def _shard_state(self):
+        """Tensor-parallel mode: place every functional-state leaf on
+        the engine mesh — parameters by their mpu-layer PartitionSpec
+        annotation (``shard_tensor`` stamped it at construction),
+        everything else replicated. One device_put per leaf; the
+        compiled step's in-shardings follow the committed arrays, so
+        ``warm_start_from=`` / ``load_weights`` spin-up is unchanged."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from paddle_tpu.distributed import spec_of
+
+        named = dict(self.model.named_parameters())
+        for n, b in self.model.named_buffers():
+            if b is not None:
+                named[n] = b
+        rep = PartitionSpec()
+        self._st = {
+            k: jax.device_put(v, NamedSharding(
+                self.mesh, spec_of(named[k]) if k in named else rep))
+            for k, v in self._st.items()}
 
     # -- the one compiled step ---------------------------------------------
     def _build_step(self, instrument: bool = False):
@@ -319,7 +411,7 @@ class ServingEngine:
                 Tensor(ssq), Tensor(sbk)) for i in range(nl)]
             with numerics.collect(instrument) as col, no_grad(), \
                     swap_state(model, stt, collect_buffers=False), \
-                    pa.impl_override(impl):
+                    pa.impl_override(impl), pa.mesh_override(self.mesh):
                 h, new_caches = backbone(Tensor(tokens), caches=caches)
                 # logits at each sequence's LAST packed token (rows of
                 # empty metadata slots gather token 0 — discarded by the
@@ -410,7 +502,12 @@ class ServingEngine:
         self._m_preempt = m["preemptions"]
         self._m_steps = m["steps"]
         self._m_kv_headroom = m["kv_headroom"]
+        self._m_kv_reclaimable = m["kv_reclaimable"]
         self._m_step_compiles = m["step_compiles"]
+        self._m_prefix_lookups = m["prefix_lookups"]
+        self._m_prefix_hits = m["prefix_hits"]
+        self._m_prefix_evictions = m["prefix_evictions"]
+        self._m_prefix_token_fraction = m["prefix_token_fraction"]
         self.cache.gauge_in_use()
         self._register_memory_owners()
 
@@ -458,9 +555,27 @@ class ServingEngine:
         if new > 0:
             self._m_preempt.inc(new)
             self._published_preemptions += new
-        self._m_kv_headroom.set(
-            self.cache.allocator.num_free()
-            / max(self.cache.allocator.capacity, 1))
+        # headroom splits free vs reclaimable (ISSUE 15): cached
+        # refcount-0 blocks are evictable capacity, not pressure — the
+        # headroom gauge counts both so load shedding doesn't misread a
+        # warm cache as a full pool
+        alloc = self.cache.allocator
+        cap = max(alloc.capacity, 1)
+        reclaim = alloc.num_reclaimable()
+        self._m_kv_headroom.set((alloc.num_free() + reclaim) / cap)
+        self._m_kv_reclaimable.set(reclaim / cap)
+        pc = self.cache.prefix_cache
+        if pc is not None:
+            for key, counter in (("lookups", self._m_prefix_lookups),
+                                 ("hits", self._m_prefix_hits),
+                                 ("evictions", self._m_prefix_evictions)):
+                new = getattr(pc, key) - self._published_prefix[key]
+                if new > 0:
+                    counter.inc(new)
+                    self._published_prefix[key] += new
+            seen = pc.hit_tokens + self._prompt_tokens_prefilled
+            if seen:
+                self._m_prefix_token_fraction.set(pc.hit_tokens / seen)
         self._m_step_compiles.set(self.step_traces)
         # per-iteration HBM poll (the serving half of the StepTimer
         # poll): refresh the ledger-backed hbm_* gauges
@@ -556,6 +671,19 @@ class ServingEngine:
                 seq._queue_wait_observed = True
                 self._m_queue_wait.observe(
                     seq.slot_time - seq.arrival_time)
+
+        # copy-on-write divergence (ISSUE 15): a fully-cached aligned
+        # prompt shares all but its last matched block; that one is
+        # device-copied into the sequence's private block BEFORE the
+        # step, so the final-token write lands in owned storage and the
+        # shared block stays immutable. The held source reference drops
+        # once the copy ran (back to the cache's refcount).
+        for seq, _ in prefills:
+            if seq.cow_src is not None and seq.cow_index is not None \
+                    and seq.cow_index < len(seq.block_ids):
+                self.cache.copy_block(seq.cow_src,
+                                      seq.block_ids[seq.cow_index])
+                self.scheduler._release_cow(seq)
 
         entries = [(seq, 1, False) for seq in decode] + \
                   [(seq, n, True) for seq, n in prefills]
@@ -665,7 +793,10 @@ class ServingEngine:
                                      "preemptions": seq.preemptions})
                 seq.prefill_pos += n
                 seq.num_cached += n
+                seq.prefilled_tokens += n
+                self._prompt_tokens_prefilled += n
                 self._m_tokens.inc(n, kind="prompt")
+                self._commit_cached_blocks(seq)
                 if seq.prefill_pos == len(seq.pending_tokens):
                     # prompt fully cached: sample the continuation (the
                     # request's first token — or, after preemption, the
@@ -675,8 +806,34 @@ class ServingEngine:
                     self._emit_token(seq, tok)
             else:
                 seq.num_cached += 1
+                self._commit_cached_blocks(seq)
                 tok = self._sample(arr[i], seq)
                 self._emit_token(seq, tok)
+
+    def _commit_cached_blocks(self, seq: Request):
+        """Register every newly-completed full block in the prefix
+        index. Runs right after a step advanced ``num_cached`` and
+        BEFORE the sampled token can finish the request — a request
+        that ends this step still leaves its blocks cached (they park
+        as reclaimable when ``finish`` drops the refcounts). Committed
+        blocks are never written again (sequence writes land at
+        ``num_cached`` and beyond), so the index entry is immutable."""
+        pc = self.cache.prefix_cache
+        if pc is None:
+            return
+        bs = self.cache.block_size
+        full = seq.num_cached // bs
+        if full <= seq.committed_blocks:
+            return
+        # the cached token stream: pending covers prompt (+ recompute
+        # text); decode appends generated tokens in write order
+        stream = seq.prompt_tokens + seq.generated
+        for i in range(seq.committed_blocks, full):
+            d = chain_hash(seq.committed_hash,
+                           stream[i * bs:(i + 1) * bs])
+            pc.register(d, seq.block_ids[i])
+            seq.committed_hash = d
+        seq.committed_blocks = full
 
     def _sample(self, logits_row: np.ndarray, seq: Request) -> int:
         if seq.temperature == 0:
@@ -876,22 +1033,38 @@ class ServingEngine:
         """Lock-free snapshot (every field below is individually
         synchronized): /healthz must answer even while a step holds the
         engine lock through a first-time XLA compile."""
-        return {
+        alloc = self.cache.allocator
+        cap = max(alloc.capacity, 1)
+        free = alloc.num_free()
+        reclaim = alloc.num_reclaimable()
+        pc = self.cache.prefix_cache
+        out = {
             "running": self.scheduler.num_running,
             "waiting": self.scheduler.num_waiting,
-            "kv_blocks_in_use": self.cache.allocator.blocks_in_use(),
-            "kv_blocks_free": self.cache.allocator.num_free(),
+            "kv_blocks_in_use": alloc.blocks_in_use(),
+            "kv_blocks_free": free,
+            "kv_blocks_reclaimable": reclaim,
             "preemptions": self.scheduler.num_preemptions,
             "step_compiles": self.step_traces,
             "attn_impl": self.attn_impl,
             "step_tokens": self.step_tokens,
             # pool pressure BEFORE preemption-by-recompute starts
-            # churning: fraction of KV blocks still free (the /healthz
-            # field operators watch)
-            "kv_headroom": round(
-                self.cache.allocator.num_free()
-                / max(self.cache.allocator.capacity, 1), 4),
+            # churning: ALLOCATABLE fraction — free plus reclaimable
+            # prefix-cached blocks (the /healthz field operators watch),
+            # split below so the HBM ledger and load shedding don't
+            # misread a warm cache as pressure
+            "kv_headroom": round((free + reclaim) / cap, 4),
+            "kv_free_fraction": round(free / cap, 4),
+            "kv_reclaimable_fraction": round(reclaim / cap, 4),
             "max_batch": self.max_batch,
             "max_model_len": self.max_model_len,
             "block_size": self.cache.block_size,
+            "prefix_cache": None,
+            "tensor_parallel": (int(self.mesh.shape[self._mp_axis])
+                                if self.mesh is not None else 1),
         }
+        if pc is not None:
+            s = pc.stats()
+            s["hit_rate"] = round(s["hits"] / max(s["lookups"], 1), 4)
+            out["prefix_cache"] = s
+        return out

@@ -1,13 +1,27 @@
 """Block-paged KV-cache manager for the serving engine.
 
-Two halves:
+Three halves:
 
 * :class:`BlockAllocator` — host-side accounting over a fixed pool of
   ``num_blocks`` token blocks: a free list, per-block refcounts
   (refcounting keeps the door open for prefix sharing / request forks —
-  a shared block is freed only when its last holder drops it), and leak
-  assertions. Physical **block 0 is reserved as the null block** (see
-  ``ops/paged_attention.py``) and is never handed out.
+  a shared block is freed only when its last holder drops it), an LRU
+  **reclaimable tier** for prefix-cached blocks whose refcount dropped
+  to zero (they keep their contents and are evicted only when the free
+  list runs dry), and leak assertions. Physical **block 0 is reserved
+  as the null block** (see ``ops/paged_attention.py``) and is never
+  handed out.
+
+* :class:`PrefixCache` — the block-granular prefix index (ISSUE 15):
+  every *full* ``block_size``-aligned chunk of a sequence's cached
+  token stream is chain-hashed (``h_i = blake2b(h_{i-1} || tokens_i)``,
+  so a block's digest commits to its entire prefix) and mapped to the
+  committed physical block. Admission matches the longest registered
+  prefix and increfs the matched blocks into the new sequence's table;
+  only the uncached tail prefills. Registered blocks are IMMUTABLE —
+  the engine only registers a block after the step that wrote its last
+  token ran, and sequence writes land strictly beyond ``num_cached``,
+  so an index entry stays valid until the allocator evicts the block.
 
 * :class:`PagedKVCache` — the device state: one ``[num_blocks + 1,
   block_size, n_kv, hd]`` K pool and V pool per layer (the +1 row is
@@ -15,31 +29,51 @@ Two halves:
   functionally through the engine's compiled step (the jitted function
   takes the pools as inputs and returns the updated ones — nothing is
   mutated in place, so the executable never recompiles), plus the
-  allocator and the block-table padding helper.
+  allocator, the block-table padding helper, the copy-on-write block
+  copy (one jitted program, physical src/dst are traced scalars) and
+  the optional ``mp``-axis pool sharding for tensor-parallel serving.
 
 Sizing math (docs/SERVING.md): a request of total length ``T`` (prompt +
 generated) holds ``ceil(T / block_size)`` blocks, so worst-case pool
 demand for ``B`` concurrent requests of max total length ``T_max`` is
 ``B * ceil(T_max / block_size)`` blocks; internal fragmentation is at
 most ``block_size - 1`` tokens per sequence instead of the
-``T_max - T`` of a contiguous worst-case layout.
+``T_max - T`` of a contiguous worst-case layout. With the prefix cache
+on, refcount-0 cached blocks additionally occupy otherwise-free blocks
+— they are *reclaimable* capacity, not pressure: ``can_allocate``
+counts them and ``allocate`` evicts LRU-first before failing.
 """
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import Dict, List, Optional, Sequence
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["BlockAllocator", "PagedKVCache"]
+__all__ = ["BlockAllocator", "PagedKVCache", "PrefixCache", "chain_hash"]
 
 #: physical block id reserved as the write-off target for padding
 NULL_BLOCK = 0
 
+#: chain seed for the first block's digest (no parent)
+_HASH_SEED = b"\x00" * 16
+
+
+def chain_hash(parent: Optional[bytes], tokens: Sequence[int]) -> bytes:
+    """Digest of one full token block, chained to its prefix: two blocks
+    collide only if their entire token prefixes agree (16-byte blake2b —
+    keyed content addressing, not cryptographic auth)."""
+    h = hashlib.blake2b(parent or _HASH_SEED, digest_size=16)
+    h.update(np.asarray(tokens, dtype=np.int64).tobytes())
+    return h.digest()
+
 
 class BlockAllocator:
-    """Refcounted free-list allocator over block ids ``1..num_blocks``."""
+    """Refcounted free-list allocator over block ids ``1..num_blocks``
+    with an LRU reclaimable tier for prefix-cached refcount-0 blocks."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 1:
@@ -49,6 +83,16 @@ class BlockAllocator:
         # ids 1..num_blocks (0 is the null block); popped from the end
         self._free: List[int] = list(range(num_blocks, 0, -1))
         self._refcount: Dict[int, int] = {}
+        # refcount-0 blocks still holding registered prefix-cache
+        # contents, LRU order (oldest first — the eviction order)
+        self._reclaimable: "OrderedDict[int, bytes]" = OrderedDict()
+        # block id -> prefix digest for every REGISTERED block (live or
+        # parked); registration survives free/park until eviction
+        self._cached_key: Dict[int, bytes] = {}
+        #: called (block_id, key) under the allocator lock when an LRU
+        #: reclaimable block is repurposed — the PrefixCache drops its
+        #: index entry here (must not re-enter the allocator)
+        self._evict_cb: Optional[Callable[[int, bytes], None]] = None
 
     @property
     def capacity(self) -> int:
@@ -58,25 +102,44 @@ class BlockAllocator:
         with self._lock:
             return len(self._free)
 
+    def num_reclaimable(self) -> int:
+        with self._lock:
+            return len(self._reclaimable)
+
     def blocks_in_use(self) -> int:
         with self._lock:
             return len(self._refcount)
 
     def can_allocate(self, n: int) -> bool:
+        """Reclaimable blocks count as capacity: they are evicted before
+        an allocation is allowed to fail."""
         with self._lock:
-            return len(self._free) >= n
+            return len(self._free) + len(self._reclaimable) >= n
 
     def allocate(self, n: int = 1) -> List[int]:
         """``n`` fresh blocks at refcount 1; raises ``MemoryError`` when
-        the pool can't cover the request (callers preempt on that)."""
+        the pool can't cover the request (callers preempt on that).
+        Free-list blocks go first; then LRU reclaimable cached blocks
+        are evicted (their prefix-index entries invalidated via the
+        eviction callback) — a cache entry is never worth failing an
+        allocation for."""
         with self._lock:
-            if len(self._free) < n:
+            if len(self._free) + len(self._reclaimable) < n:
                 raise MemoryError(
-                    f"KV block pool exhausted: need {n}, "
-                    f"free {len(self._free)}/{self.num_blocks}")
-            out = [self._free.pop() for _ in range(n)]
-            for b in out:
+                    f"KV block pool exhausted: need {n}, free "
+                    f"{len(self._free)}+{len(self._reclaimable)} "
+                    f"reclaimable /{self.num_blocks}")
+            out = []
+            for _ in range(n):
+                if self._free:
+                    b = self._free.pop()
+                else:
+                    b, key = self._reclaimable.popitem(last=False)
+                    del self._cached_key[b]
+                    if self._evict_cb is not None:
+                        self._evict_cb(b, key)
                 self._refcount[b] = 1
+                out.append(b)
             return out
 
     def incref(self, block_id: int):
@@ -86,7 +149,10 @@ class BlockAllocator:
             self._refcount[block_id] += 1
 
     def free(self, block_ids: Sequence[int]):
-        """Drop one reference per id; blocks return to the pool at 0."""
+        """Drop one reference per id. At refcount 0 a registered
+        (prefix-cached) block PARKS in the reclaimable tier — contents
+        kept, evictable LRU — while an unregistered block returns to
+        the free list."""
         with self._lock:
             for b in block_ids:
                 rc = self._refcount.get(b)
@@ -94,7 +160,11 @@ class BlockAllocator:
                     raise ValueError(f"double free of block {b}")
                 if rc == 1:
                     del self._refcount[b]
-                    self._free.append(b)
+                    key = self._cached_key.get(b)
+                    if key is not None:
+                        self._reclaimable[b] = key  # MRU end
+                    else:
+                        self._free.append(b)
                 else:
                     self._refcount[b] = rc - 1
 
@@ -102,13 +172,132 @@ class BlockAllocator:
         with self._lock:
             return self._refcount.get(block_id, 0)
 
+    # -- prefix-cache hooks ------------------------------------------------
+    def mark_cached(self, block_id: int, key: bytes):
+        """Register a LIVE block as prefix-cache backed: when its
+        refcount later hits 0 it parks as reclaimable instead of
+        returning to the free list."""
+        with self._lock:
+            if block_id not in self._refcount:
+                raise ValueError(
+                    f"block {block_id} is not allocated (cannot cache)")
+            self._cached_key[block_id] = key
+
+    def reuse_cached(self, block_id: int) -> bool:
+        """Claim one reference on a registered block for a cache hit:
+        incref a live holder, or resurrect a parked reclaimable block at
+        refcount 1. False when the block was already evicted (the
+        caller treats the walk as a miss from here on)."""
+        with self._lock:
+            if block_id not in self._cached_key:
+                return False  # evicted (and possibly reallocated)
+            if block_id in self._refcount:
+                self._refcount[block_id] += 1
+                return True
+            if block_id in self._reclaimable:
+                del self._reclaimable[block_id]
+                self._refcount[block_id] = 1
+                return True
+            return False
+
+    def is_cached(self, block_id: int) -> bool:
+        with self._lock:
+            return block_id in self._cached_key
+
     def assert_no_leaks(self):
-        """Every block is back in the pool (end-of-drain invariant)."""
+        """Every block is back in the pool (end-of-drain invariant).
+        Parked reclaimable blocks are NOT leaks — they are evictable
+        capacity — but every block must be accounted for exactly once."""
         with self._lock:
             leaked = sorted(self._refcount)
             if leaked:
                 raise AssertionError(
                     f"{len(leaked)} KV blocks leaked: {leaked[:16]}")
+            total = len(self._free) + len(self._reclaimable)
+            if total != self.num_blocks:
+                raise AssertionError(
+                    f"pool accounting broke: {len(self._free)} free + "
+                    f"{len(self._reclaimable)} reclaimable != "
+                    f"{self.num_blocks}")
+
+
+class PrefixCache:
+    """Hash index over committed full KV blocks (ISSUE 15).
+
+    ``match`` walks the chain hashes of a prompt's full blocks and
+    CLAIMS every hit (incref / resurrect through the allocator) so a
+    concurrent eviction can't invalidate an earlier link mid-walk;
+    ``register`` is called by the engine's post-step commit pass — only
+    for blocks whose final token the executed step wrote, so an indexed
+    block is always immutable. Counters are cumulative; the engine
+    publishes deltas into the ``serving_prefix_cache_*`` metric
+    families."""
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = block_size
+        self._index: Dict[bytes, int] = {}   # digest -> physical block
+        self.lookups = 0
+        self.hits = 0
+        self.evictions = 0
+        self.hit_tokens = 0      # prompt tokens served from the cache
+        allocator._evict_cb = self._on_evict
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def _on_evict(self, block_id: int, key: bytes):
+        # under the allocator lock — dict surgery only
+        if self._index.get(key) == block_id:
+            del self._index[key]
+        self.evictions += 1
+
+    def lookup(self, digest: bytes) -> Optional[int]:
+        return self._index.get(digest)
+
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], List[bytes]]:
+        """Longest registered full-block prefix of ``tokens``: returns
+        the CLAIMED physical blocks (one reference each, caller owns)
+        and their digests. The caller applies the at-least-one-token
+        prefill cap (scheduler admission) — this walk is pure content
+        matching at block granularity."""
+        self.lookups += 1
+        bs = self.block_size
+        blocks: List[int] = []
+        digests: List[bytes] = []
+        parent = None
+        for i in range(len(tokens) // bs):
+            d = chain_hash(parent, tokens[i * bs:(i + 1) * bs])
+            b = self._index.get(d)
+            if b is None or not self.allocator.reuse_cached(b):
+                if b is not None:
+                    # index raced an eviction path — drop the stale entry
+                    self._index.pop(d, None)
+                break
+            blocks.append(b)
+            digests.append(d)
+            parent = d
+        if blocks:
+            self.hits += 1
+        return blocks, digests
+
+    def register(self, digest: bytes, block_id: int):
+        """Index a completed full block. First writer wins: duplicate
+        content keeps the existing entry and the caller's block simply
+        stays a plain (uncached) block."""
+        if digest in self._index:
+            return
+        self.allocator.mark_cached(block_id, digest)
+        self._index[digest] = block_id
+
+    def stats(self) -> dict:
+        return {
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "evictions": self.evictions,
+            "hit_tokens": self.hit_tokens,
+            "entries": len(self._index),
+        }
 
 
 class PagedKVCache:
@@ -117,7 +306,7 @@ class PagedKVCache:
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
                  num_kv_heads: int, head_dim: int,
                  max_blocks_per_seq: Optional[int] = None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, prefix_cache: bool = False):
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
         self.num_layers = num_layers
@@ -125,12 +314,15 @@ class PagedKVCache:
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq or num_blocks
         self.allocator = BlockAllocator(num_blocks)
+        self.prefix_cache = (PrefixCache(self.allocator, block_size)
+                             if prefix_cache else None)
         # +1: physical block 0 is the null block and backs no sequence
         shape = (num_blocks + 1, block_size, num_kv_heads, head_dim)
         self.k_pools = tuple(jnp.zeros(shape, dtype)
                              for _ in range(num_layers))
         self.v_pools = tuple(jnp.zeros(shape, dtype)
                              for _ in range(num_layers))
+        self._copy_fn = None  # lazily-jitted COW block copy
 
     @property
     def max_seq_len(self) -> int:
@@ -145,6 +337,33 @@ class PagedKVCache:
         threading: the old arrays are dropped, nothing recompiles)."""
         self.k_pools = tuple(k_pools)
         self.v_pools = tuple(v_pools)
+
+    def shard_pools(self, mesh, axis: str):
+        """Tensor-parallel serving: place every pool with the KV-head
+        dimension sharded over the mesh's ``axis``. One device_put per
+        pool at engine construction; the compiled step keeps the
+        sharding through its functional threading."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(mesh, P(None, None, axis, None))
+        self.k_pools = tuple(jax.device_put(p, sh) for p in self.k_pools)
+        self.v_pools = tuple(jax.device_put(p, sh) for p in self.v_pools)
+
+    def copy_block(self, src: int, dst: int):
+        """Copy-on-write: duplicate physical block ``src`` into ``dst``
+        across every layer's K and V pool. One jitted program for the
+        engine's lifetime — src/dst are traced scalars, so the first
+        divergence compiles it and every later COW reuses it."""
+        import jax
+
+        if self._copy_fn is None:
+            def _copy(kps, vps, s, d):
+                return (tuple(p.at[d].set(p[s]) for p in kps),
+                        tuple(p.at[d].set(p[s]) for p in vps))
+            donate = (0, 1) if jax.default_backend() == "tpu" else ()
+            self._copy_fn = jax.jit(_copy, donate_argnums=donate)
+        self.k_pools, self.v_pools = self._copy_fn(
+            self.k_pools, self.v_pools, jnp.int32(src), jnp.int32(dst))
 
     def pad_block_table(self, block_ids: Sequence[int]) -> np.ndarray:
         """[max_blocks_per_seq] int32 row, null-padded."""

@@ -14,13 +14,29 @@ the next waiting request between steps, which (together with the fixed
 token budget) keeps the unified executable's shapes, and therefore its
 single compilation, constant.
 
+Prefix-cache-aware admission (ISSUE 15): when the engine's
+``PagedKVCache`` carries a :class:`~.kv_cache.PrefixCache`, a request
+entering a slot first matches its prompt's longest registered
+full-block prefix — matched blocks are claimed (incref / resurrection
+through the allocator) straight into its block table and only the
+uncached tail prefills. A prompt whose FULL length is cached is capped
+at ``len(prompt) - 1`` matched tokens (at least one token must run to
+produce the sampling logits); because that cap lands mid-block, the
+last matched block becomes a **copy-on-write source**: the scheduler
+holds one claimed reference on it (``cow_src``) and the engine copies
+its contents into the sequence's freshly-allocated private block before
+the step runs, so the final-token write can never touch shared state.
+
 When the block pool can't cover a needed allocation, the sequence with
 the LATEST arrival is preempted (vLLM's recompute policy, protecting
 FCFS order): its blocks are freed, and it re-enters the waiting queue
 with ``prompt + generated-so-far`` as its new prefill text. On
 readmission the recompute-prefill rebuilds its KV state and the sampled
 continuation picks up exactly where it left off — under greedy decoding
-the final output is identical to the unpreempted run. Because decode is
+the final output is identical to the unpreempted run. With the prefix
+cache on, a preempted sequence's committed blocks park as reclaimable
+instead of being erased, so readmission's prefix match recovers them
+and only the genuinely uncached tail recomputes. Because decode is
 planned before prefill and victims are always strictly YOUNGER than the
 sequence needing blocks, a plan can never direct the engine at a
 sequence whose blocks a later planning stage of the same plan took: an
@@ -80,6 +96,26 @@ class Request:
     prefill_pos: int = 0     # pending tokens already cached
     num_cached: int = 0      # total tokens written to the KV cache
     generated: List[int] = field(default_factory=list)
+    # -- prefix-cache state (ISSUE 15) -------------------------------------
+    #: prompt tokens recovered from the prefix cache at the LAST admission
+    cached_prompt_tokens: int = 0
+    #: prompt tokens actually prefilled over the request's whole life
+    #: (incl. preemption recompute) — the recompute-tail test's subject
+    prefilled_tokens: int = 0
+    #: lifetime accumulators across every admission: pending-token demand
+    #: and cache-matched tokens — ``prefilled_tokens ≤ admitted_pending
+    #: − cached_tokens_total`` is the "recompute only the uncached tail"
+    #: invariant tests pin
+    admitted_pending_total: int = 0
+    cached_tokens_total: int = 0
+    #: full blocks already registered in the prefix index + the chain
+    #: digest of the last one (the next block's hash parent)
+    committed_blocks: int = 0
+    committed_hash: Optional[bytes] = None
+    #: copy-on-write: claimed source block + the logical index of the
+    #: private destination block the engine copies it into pre-step
+    cow_src: Optional[int] = None
+    cow_index: Optional[int] = None
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -200,6 +236,50 @@ class Scheduler:
             req.state = RequestState.PREFILL
             if req.slot_time is None:
                 req.slot_time = time.perf_counter()
+            req.admitted_pending_total += len(req.pending_tokens)
+            self._prefix_admit(req)
+
+    def _prefix_admit(self, seq: Request):
+        """Match the longest cached prefix of ``seq.pending_tokens`` and
+        seed its block table with the claimed blocks. Fully-cached
+        prompts are capped at ``len - 1`` tokens (the last token must
+        prefill to produce sampling logits); the cap lands mid-block, so
+        the final matched block turns into a held COW source instead of
+        a table entry."""
+        pc = self.cache.prefix_cache
+        if pc is None or seq.block_ids:
+            return
+        tokens = seq.pending_tokens
+        if len(tokens) <= self.cache.block_size:
+            return  # no full block can match under the one-token cap
+        blocks, digests = pc.match(tokens)
+        if not blocks:
+            return
+        matched = len(blocks) * self.cache.block_size
+        if matched >= len(tokens):
+            # fully-cached aligned prompt: the last matched block is the
+            # COW source (we hold its claimed reference until the engine
+            # copies it); usable cache shrinks to len - 1 tokens
+            seq.cow_src = blocks.pop()
+            seq.cow_index = len(blocks)
+            matched = len(tokens) - 1
+        seq.block_ids = blocks
+        seq.prefill_pos = matched
+        seq.num_cached = matched
+        seq.cached_prompt_tokens = matched
+        seq.cached_tokens_total += matched
+        seq.committed_blocks = len(blocks)
+        seq.committed_hash = digests[len(blocks) - 1] if blocks else None
+        pc.hit_tokens += matched
+
+    def _release_cow(self, seq: Request):
+        """Drop a held COW source reference (preempt/finish/abort before
+        the engine performed the copy — or after: the engine clears
+        ``cow_src`` once the copy ran)."""
+        if seq.cow_src is not None:
+            self.cache.allocator.free([seq.cow_src])
+            seq.cow_src = None
+        seq.cow_index = None
 
     def _plan_prefills(self, budget: int) -> List[Tuple[Request, int]]:
         """FCFS prefill packing: each PREFILL-state sequence gets up to
@@ -287,13 +367,19 @@ class Scheduler:
     def preempt(self, seq: Request):
         """Preemption-by-recompute: free every block, requeue with
         prompt+generated as the new prefill text. Greedy decoding makes
-        the resumed continuation token-identical."""
+        the resumed continuation token-identical. With the prefix cache
+        on, the freed committed blocks PARK as reclaimable — readmission
+        re-matches them and recomputes only the uncached tail."""
+        self._release_cow(seq)
         self.cache.allocator.free(seq.block_ids)
         seq.block_ids = []
         self.release_slot(seq)
         seq.pending_tokens = list(seq.prompt_tokens) + list(seq.generated)
         seq.prefill_pos = 0
         seq.num_cached = 0
+        seq.cached_prompt_tokens = 0
+        seq.committed_blocks = 0
+        seq.committed_hash = None
         seq.state = RequestState.WAITING
         seq.preemptions += 1
         self.num_preemptions += 1
@@ -310,7 +396,10 @@ class Scheduler:
 
     def finish(self, seq: Request, state: RequestState,
                reason: str = "stop"):
-        """Return every resource; the engine records metrics/callbacks."""
+        """Return every resource; the engine records metrics/callbacks.
+        Registered blocks park in the reclaimable tier — a finished
+        request's prompt stays servable from cache."""
+        self._release_cow(seq)
         self.cache.allocator.free(seq.block_ids)
         seq.block_ids = []
         self.release_slot(seq)

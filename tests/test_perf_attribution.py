@@ -267,6 +267,17 @@ _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           "data_stall", "ckpt_s", "hbm_in_use",
                           "serving_p99_ttft_seconds",
                           "serving_decode_tokens_per_sec",
+                          # bench.py --serve shared-prefix report-gate
+                          # headlines (ISSUE 15, docs/SERVING.md) —
+                          # stdout {"metric","value"} lines, not
+                          # registry families
+                          "serving_prefix_cache_hit_rate",
+                          "serving_shared_prefix_speedup",
+                          "serving_cached_p99_ttft_seconds",
+                          "serving_cold_p99_ttft_seconds",
+                          # commplan geometry label (ISSUE 15,
+                          # docs/SERVING.md), not a metric family
+                          "serving_mp2",
                           # bench.py --audit report-gate headlines
                           # (docs/ANALYSIS.md), not registry families
                           "train_step_allreduce_count",

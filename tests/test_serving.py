@@ -350,6 +350,16 @@ def test_preemption_recompute_no_leak():
     assert eng.scheduler.num_preemptions >= 1
     eng.cache.allocator.assert_no_leaks()
     assert eng.step_traces == 1
+    # recompute-tail invariant (ISSUE 15): across every admission, a
+    # request prefills AT MOST its pending demand minus what the prefix
+    # cache served — readmission never recomputes a cached block
+    for hd in handles:
+        r = hd._req
+        assert r.prefilled_tokens <= \
+            r.admitted_pending_total - r.cached_tokens_total
+        if r.preemptions == 0:
+            assert r.prefilled_tokens == \
+                r.admitted_pending_total - r.cached_tokens_total
 
 
 def test_submit_validation(served):
