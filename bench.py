@@ -431,8 +431,21 @@ def bench_serve():
     if on_tpu:
         model.bfloat16()
 
-    def run_trace(impl):
-        engine = ServingEngine(model, attn_impl=impl, **eng_kw)
+    def run_trace(impl, ledger=True):
+        # ledger=False builds a disarmed engine (the hot path pays only
+        # attribute reads on None) — the pair prices the request ledger
+        # for the serving_request_ledger_overhead_frac headline
+        env_prev = os.environ.get("PADDLE_TPU_REQUEST_LEDGER")
+        if not ledger:
+            os.environ["PADDLE_TPU_REQUEST_LEDGER"] = "0"
+        try:
+            engine = ServingEngine(model, attn_impl=impl, **eng_kw)
+        finally:
+            if not ledger:
+                if env_prev is None:
+                    os.environ.pop("PADDLE_TPU_REQUEST_LEDGER", None)
+                else:
+                    os.environ["PADDLE_TPU_REQUEST_LEDGER"] = env_prev
         engine.start()
         rng = np.random.RandomState(0)
         # warmup request compiles the unified step outside the timed
@@ -528,6 +541,37 @@ def bench_serve():
         out[impl] = run_trace(impl)
         print(json.dumps({impl: out[impl]}), file=sys.stderr, flush=True)
         gc.collect()
+    # per-request cost summary (ISSUE 16): the exemplar ring after the
+    # primary trace — errors/preempted/slow-tail always kept, the rest
+    # sampled (PADDLE_TPU_REQUEST_LOG_SAMPLE)
+    from paddle_tpu.observability import requests as obs_requests
+    led = obs_requests.active()
+    if led is not None:
+        ex = led.exemplars()
+        if ex:
+            cols = ("req_id", "kept", "queue_wait_s", "ttft_s",
+                    "latency_s", "itl_p99_s", "prefilled_tokens",
+                    "cached_tokens", "decode_tokens", "preemptions",
+                    "kv_block_seconds")
+            print("request cost exemplars (kept=%d of %d completed):"
+                  % (len(ex), led.completed_total), file=sys.stderr)
+            print(" | ".join(cols), file=sys.stderr)
+            for r in ex:
+                print(" | ".join(str(r.get(c)) for c in cols),
+                      file=sys.stderr)
+            sys.stderr.flush()
+    # disarmed twin of the primary trace prices the ledger: the headline
+    # is the throughput it costs (≤1% gate — LOWER_BETTER in --report)
+    ledger_off = run_trace(impls[0], ledger=False)
+    out["ledger_off"] = ledger_off
+    tps_on = out[impls[0]]["tokens_per_sec"]
+    tps_off = ledger_off["tokens_per_sec"]
+    ledger_overhead = round(1.0 - tps_on / max(tps_off, 1e-9), 4)
+    out["ledger_overhead_frac"] = ledger_overhead
+    print(json.dumps({"ledger_off": ledger_off,
+                      "ledger_overhead_frac": ledger_overhead}),
+          file=sys.stderr, flush=True)
+    gc.collect()
     shared = {"cold": run_shared_prefix(False),
               "cached": run_shared_prefix(True)}
     shared["speedup"] = round(
@@ -568,6 +612,10 @@ def bench_serve():
     print(json.dumps({"metric": f"serving_shared_prefix_speedup{sfx}",
                       "value": shared["speedup"],
                       "unit": "x"}))
+    print(json.dumps({"metric":
+                      f"serving_request_ledger_overhead_frac{sfx}",
+                      "value": out["ledger_overhead_frac"],
+                      "unit": "fraction"}))
     return out
 
 
@@ -1091,6 +1139,10 @@ REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        # cold oracle must not quietly degrade either
                        "serving_cached_p99_ttft_seconds",
                        "serving_cold_p99_ttft_seconds",
+                       # throughput cost of the per-request ledger
+                       # (ISSUE 16): armed-vs-disarmed decode rate on
+                       # the same Poisson trace — must stay ≤ 1%
+                       "serving_request_ledger_overhead_frac",
                        # static program-audit headlines (ISSUE 9,
                        # bench.py --audit / paddle_tpu.analysis): dp
                        # collective census, bytes the step keeps

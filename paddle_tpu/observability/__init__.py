@@ -41,6 +41,13 @@ Eight pieces (see docs/OBSERVABILITY.md):
   model seam, sampled instrumented train-step twin (``numerics_*``
   families, ``PADDLE_TPU_NUMERICS``), NaN provenance JSON on NaNGuard
   rollbacks, and calibration-grade per-tap activation-range sketches.
+- **requests** — per-request serving ledger (queue wait, prefill/cached/
+  decode tokens, ITL samples, KV block-seconds), W3C ``traceparent``
+  helpers, tail-sampled exemplar log (``PADDLE_TPU_REQUEST_LOG_DIR``),
+  and the ``/statusz`` payload/renderer (``PADDLE_TPU_REQUEST_LEDGER``).
+- **slo** — declarative serving SLO targets (``PADDLE_TPU_SLO_*``) with
+  multi-window burn-rate gauges (``serving_slo_*``) computed online
+  from ledger completions.
 
 Importing this package applies the env gates (a no-op when the vars are
 unset), so ``import paddle_tpu`` alone arms the exporter/recorder/tracer
@@ -48,7 +55,7 @@ in production jobs.
 """
 from . import (  # noqa: F401
     comm, fleet, flight_recorder, goodput, memory, metrics, numerics,
-    profile, step_timer, trace,
+    profile, requests, slo, step_timer, trace,
 )
 from .comm import (  # noqa: F401
     comm_scope, comm_totals, compute_scope, payload_bytes,
@@ -61,6 +68,7 @@ from .step_timer import StepTimer, peak_flops  # noqa: F401
 
 __all__ = ["metrics", "step_timer", "comm", "flight_recorder", "trace",
            "memory", "profile", "fleet", "goodput", "numerics",
+           "requests", "slo",
            "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "get_registry", "start_exporter", "maybe_start_exporter",
            "StepTimer", "peak_flops", "comm_scope", "comm_totals",

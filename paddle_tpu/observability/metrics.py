@@ -313,9 +313,10 @@ class MetricsExporter:
     """Background HTTP exposition server (daemon thread).
 
     Serves ``/metrics`` (Prometheus text), ``/metrics.json``,
-    ``/fleetz`` (the fleet/goodput rollup) and ``/healthz``
+    ``/fleetz`` (the fleet/goodput rollup), ``/healthz``
     (rank/job_id/last_step_age_seconds — the wedged-but-listening probe)
-    on ``port`` (0 picks an ephemeral port — ``self.port`` holds the
+    and ``/statusz`` (live SLO burn rates + request-ledger rollup) on
+    ``port`` (0 picks an ephemeral port — ``self.port`` holds the
     bound one)."""
 
     def __init__(self, port: int, registry: Optional[MetricsRegistry] = None,
@@ -343,6 +344,19 @@ class MetricsExporter:
                     body = json.dumps(
                         {"status": "ok", **fleet.healthz_fields()}).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/statusz"):
+                    # SLO observatory (no engine in scope here, so no
+                    # scheduler-occupancy section — the serving Server's
+                    # /statusz carries that)
+                    from . import requests as obs_requests
+                    payload = obs_requests.statusz_payload()
+                    if "format=json" in self.path:
+                        body = json.dumps(payload).encode()
+                        ctype = "application/json"
+                    else:
+                        body = obs_requests.render_statusz_html(
+                            payload).encode()
+                        ctype = "text/html; charset=utf-8"
                 else:
                     self.send_error(404)
                     return

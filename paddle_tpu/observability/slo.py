@@ -1,0 +1,286 @@
+"""Declarative serving SLOs with multi-window burn-rate gauges.
+
+Google-SRE-style SLO accounting over the request ledger
+(``observability.requests``): each completed request is classified
+good/bad against declarative env targets, and per-SLO **burn rates**
+are computed online over a fast and a slow trailing window —
+``burn = bad_fraction / error_budget``, so burn 1.0 consumes the budget
+exactly at the objective's rate, and the classic multi-window page rule
+("burning 14.4x over BOTH the fast and slow window") becomes a single
+``serving_slo_alert`` gauge transition. Computed host-side from ledger
+completions only; nothing here touches the serving hot path.
+
+Targets (unset = SLO not tracked; arming is all-or-nothing per target):
+
+- ``PADDLE_TPU_SLO_TTFT_P99_S``  — 99% of requests reach their first
+  token within this many seconds (bad: ``ttft > target``; a request
+  that failed before any token is bad too).
+- ``PADDLE_TPU_SLO_ITL_P99_S``   — 99% of requests keep their own p99
+  inter-token gap under this many seconds (single-token requests carry
+  no ITL sample and are skipped).
+- ``PADDLE_TPU_SLO_SUCCESS``     — availability objective as a
+  fraction (e.g. ``0.999``); bad: the request failed.
+
+Tuning: ``PADDLE_TPU_SLO_WINDOWS`` = ``fast:slow`` seconds (default
+``300:3600``), ``PADDLE_TPU_SLO_BURN_ALERT`` = page threshold (default
+``14.4`` — the 1h/5m fast-burn pair from the SRE workbook).
+
+Families (``serving_slo_*``, docs/OBSERVABILITY.md): targets, per-window
+burn rates/bad fractions, the alert gauge, and a good/bad event counter.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+__all__ = ["SloMonitor", "slo_metrics", "maybe_arm_from_env",
+           "configure", "reset", "snapshot", "active"]
+
+#: the armed monitor — ledger completions read this attribute; None =
+#: no SLO targets configured
+_monitor: Optional["SloMonitor"] = None
+_armed_from_env = False
+
+#: latency-style targets: (slo name, env knob, objective fraction)
+_LATENCY_KNOBS = (
+    ("ttft_p99", "PADDLE_TPU_SLO_TTFT_P99_S", 0.99),
+    ("itl_p99", "PADDLE_TPU_SLO_ITL_P99_S", 0.99),
+)
+
+DEFAULT_WINDOWS_S = (300.0, 3600.0)
+DEFAULT_BURN_ALERT = 14.4
+
+_slo_metrics_cache = None
+
+
+def slo_metrics(registry=None) -> dict:
+    """The ``serving_slo_*`` families (created on first use — mirrors
+    ``serving.engine.serving_metrics``)."""
+    global _slo_metrics_cache
+    if registry is None and _slo_metrics_cache is not None:
+        return _slo_metrics_cache
+    from .metrics import get_registry
+    reg = registry if registry is not None else get_registry()
+    d = {
+        "target": reg.gauge(
+            "serving_slo_target",
+            "configured SLO target, by slo (seconds for latency SLOs, "
+            "fraction for success)"),
+        "burn": reg.gauge(
+            "serving_slo_burn_rate",
+            "error-budget burn rate by slo and window (fast/slow): "
+            "bad_fraction / budget — 1.0 spends the budget exactly at "
+            "the objective's rate"),
+        "bad_fraction": reg.gauge(
+            "serving_slo_bad_fraction",
+            "fraction of requests violating the SLO in the window"),
+        "alert": reg.gauge(
+            "serving_slo_alert",
+            "1 while the burn rate exceeds the page threshold over "
+            "BOTH windows (the SRE multi-window fast-burn rule)"),
+        "events": reg.counter(
+            "serving_slo_events_total",
+            "ledger completions classified against each SLO, by "
+            "verdict (good/bad)"),
+    }
+    if registry is None:
+        _slo_metrics_cache = d
+    return d
+
+
+class SloMonitor:
+    """Online multi-window burn-rate accounting over ledger completions.
+
+    ``targets`` maps slo name -> (target value, objective fraction);
+    the error budget is ``1 - objective``. Events live in one trailing
+    deque per SLO, evicted past the slow window; gauges refresh on
+    every observation and on :meth:`snapshot` (so an idle system's burn
+    rate decays as its window drains)."""
+
+    def __init__(self, targets: Dict[str, Tuple[float, float]],
+                 windows_s: Tuple[float, float] = DEFAULT_WINDOWS_S,
+                 alert_threshold: float = DEFAULT_BURN_ALERT):
+        if not targets:
+            raise ValueError("SloMonitor needs at least one target")
+        fast, slow = float(windows_s[0]), float(windows_s[1])
+        if fast <= 0 or slow < fast:
+            raise ValueError(
+                f"windows must satisfy 0 < fast <= slow, got "
+                f"{windows_s}")
+        self.targets = dict(targets)
+        self.windows_s = (fast, slow)
+        self.alert_threshold = float(alert_threshold)
+        self._lock = threading.Lock()
+        self._events: Dict[str, deque] = {n: deque() for n in targets}
+        self._m = slo_metrics()
+        for name, (target, _obj) in self.targets.items():
+            self._m["target"].set(target, slo=name)
+
+    # -- classification ----------------------------------------------------
+    def _verdict(self, name: str, rec) -> Optional[bool]:
+        """True = bad, False = good, None = not applicable."""
+        target, _obj = self.targets[name]
+        failed = rec.state == "failed"
+        if name == "ttft_p99":
+            if rec.ttft_s is None:
+                return True if failed else None
+            return rec.ttft_s > target
+        if name == "itl_p99":
+            p99 = rec.itl_percentile(0.99)
+            return None if p99 is None else p99 > target
+        if name == "success":
+            return failed
+        return None
+
+    def observe(self, rec, now: Optional[float] = None):
+        """Classify one completed :class:`~.requests.RequestRecord`
+        against every armed SLO and refresh the gauges."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            for name in self.targets:
+                bad = self._verdict(name, rec)
+                if bad is None:
+                    continue
+                self._events[name].append((now, bool(bad)))
+                self._m["events"].inc(slo=name,
+                                      verdict="bad" if bad else "good")
+            self._recompute(now)
+
+    # -- burn-rate math ----------------------------------------------------
+    def _recompute(self, now: float):
+        """Gauge refresh (lock held)."""
+        fast, slow = self.windows_s
+        for name, (_target, objective) in self.targets.items():
+            ev = self._events[name]
+            while ev and now - ev[0][0] > slow:
+                ev.popleft()
+            budget = max(1.0 - objective, 1e-9)
+            burns = {}
+            for wname, wlen in (("fast", fast), ("slow", slow)):
+                in_w = [bad for (t, bad) in ev if now - t <= wlen]
+                frac = (sum(in_w) / len(in_w)) if in_w else 0.0
+                burns[wname] = frac / budget
+                self._m["burn"].set(round(burns[wname], 4),
+                                    slo=name, window=wname)
+                self._m["bad_fraction"].set(round(frac, 4),
+                                            slo=name, window=wname)
+            alerting = (burns["fast"] >= self.alert_threshold
+                        and burns["slow"] >= self.alert_threshold)
+            self._m["alert"].set(1.0 if alerting else 0.0, slo=name)
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._recompute(now)
+            out = {"enabled": True,
+                   "windows_s": list(self.windows_s),
+                   "alert_threshold": self.alert_threshold,
+                   "slos": {}}
+            for name, (target, objective) in self.targets.items():
+                out["slos"][name] = {
+                    "target": target,
+                    "objective": objective,
+                    "events_in_window": len(self._events[name]),
+                    "burn_rate": {
+                        "fast": self._m["burn"].value(slo=name,
+                                                      window="fast"),
+                        "slow": self._m["burn"].value(slo=name,
+                                                      window="slow")},
+                    "alerting": bool(
+                        self._m["alert"].value(slo=name) >= 1.0),
+                }
+            return out
+
+
+# ---------------------------------------------------------------------------
+# module seam
+# ---------------------------------------------------------------------------
+
+def _parse_windows(raw: str) -> Tuple[float, float]:
+    parts = [p for p in raw.replace(",", ":").split(":") if p.strip()]
+    if len(parts) != 2:
+        raise ValueError(raw)
+    fast, slow = float(parts[0]), float(parts[1])
+    if fast <= 0 or slow < fast:
+        raise ValueError(raw)
+    return fast, slow
+
+
+def maybe_arm_from_env() -> Optional["SloMonitor"]:
+    """Arm the monitor from ``PADDLE_TPU_SLO_*`` (idempotent; no target
+    set = stays disarmed). Called by the ledger's arming path, so a
+    serving engine + env targets is all an operator configures."""
+    global _monitor, _armed_from_env
+    if _monitor is not None or _armed_from_env:
+        return _monitor
+    _armed_from_env = True
+    targets: Dict[str, Tuple[float, float]] = {}
+    for name, knob, objective in _LATENCY_KNOBS:
+        raw = os.environ.get(knob, "").strip()
+        if not raw:
+            continue
+        try:
+            t = float(raw)
+        except ValueError:
+            continue
+        if t > 0:
+            targets[name] = (t, objective)
+    raw = os.environ.get("PADDLE_TPU_SLO_SUCCESS", "").strip()
+    if raw:
+        try:
+            obj = float(raw)
+            if 0.0 < obj < 1.0:
+                targets["success"] = (obj, obj)
+        except ValueError:
+            pass
+    if not targets:
+        return None
+    windows = DEFAULT_WINDOWS_S
+    raw = os.environ.get("PADDLE_TPU_SLO_WINDOWS", "").strip()
+    if raw:
+        try:
+            windows = _parse_windows(raw)
+        except ValueError:
+            pass
+    alert = DEFAULT_BURN_ALERT
+    raw = os.environ.get("PADDLE_TPU_SLO_BURN_ALERT", "").strip()
+    if raw:
+        try:
+            alert = float(raw)
+        except ValueError:
+            pass
+    _monitor = SloMonitor(targets, windows_s=windows,
+                          alert_threshold=alert)
+    return _monitor
+
+
+def configure(targets: Dict[str, Tuple[float, float]],
+              windows_s: Tuple[float, float] = DEFAULT_WINDOWS_S,
+              alert_threshold: float = DEFAULT_BURN_ALERT) -> "SloMonitor":
+    """Explicit (non-env) arming — tests and embedding applications."""
+    global _monitor, _armed_from_env
+    _monitor = SloMonitor(targets, windows_s=windows_s,
+                          alert_threshold=alert_threshold)
+    _armed_from_env = True
+    return _monitor
+
+
+def reset():
+    """Disarm (tests): the next ``maybe_arm_from_env`` re-reads env."""
+    global _monitor, _armed_from_env
+    _monitor = None
+    _armed_from_env = False
+
+
+def active() -> Optional["SloMonitor"]:
+    return _monitor
+
+
+def snapshot() -> dict:
+    mon = _monitor
+    if mon is None:
+        return {"enabled": False}
+    return mon.snapshot()

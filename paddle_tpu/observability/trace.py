@@ -265,10 +265,74 @@ def _goodput_rollup(ranks: List[dict], aligned: List[tuple]) -> dict:
                 bins["productive"] / wall, 6) if wall > 0 else 0.0}
 
 
+def _request_rollup(aligned: List[tuple]) -> dict:
+    """``merge --requests``: stitch each request's serving spans across
+    rank/pid lanes into one per-request summary, keyed by the W3C trace
+    id every serving span carries (``args.trace`` — the id the HTTP
+    server echoed to the client). A client holding a ``traceparent``
+    from an error body looks its request up here; cross-process chains
+    (future router -> replica hops) fold into the same entry because
+    the id survives the hop. Spans with no trace id fall back to a
+    ``req:<id>`` key (pre-ledger writers)."""
+    reqs: Dict[str, dict] = {}
+    for ts, r, ev in aligned:
+        if ev.get("cat") != "serving":
+            continue
+        a = ev.get("args") or {}
+        key = a.get("trace") or (
+            f"req:{a['req']}" if a.get("req") is not None else None)
+        if key is None:
+            continue
+        end = ts + int(ev.get("dur", 0)) if ev.get("type") == "span" else ts
+        q = reqs.setdefault(key, {
+            "trace_id": a.get("trace"), "req_id": a.get("req"),
+            "lanes": set(), "spans": 0, "first_ns": ts, "last_ns": end,
+            "queue_wait_s": None, "prefill_chunks": 0,
+            "prefill_tokens": 0, "compiles": 0, "preemptions": 0})
+        q["lanes"].add(r["label"])
+        q["spans"] += 1
+        q["first_ns"] = min(q["first_ns"], ts)
+        q["last_ns"] = max(q["last_ns"], end)
+        name = ev.get("name")
+        if name == "queue_wait":
+            q["queue_wait_s"] = round((end - ts) / 1e9, 6)
+        elif name == "prefill_chunk":
+            q["prefill_chunks"] += 1
+            q["prefill_tokens"] += int(a.get("tokens", 0))
+            q["compiles"] += int(a.get("compiles", 0))
+        elif name == "preempted":
+            q["preemptions"] = max(q["preemptions"],
+                                   int(a.get("preemptions", 0)))
+        elif name == "request_done":
+            # the authoritative completion record (ledger-enriched)
+            for src, dst in (("finish_reason", "finish_reason"),
+                             ("prompt_len", "prompt_len"),
+                             ("generated", "generated"),
+                             ("prefilled_tokens", "prefilled_tokens"),
+                             ("cached_tokens", "cached_tokens"),
+                             ("decode_tokens", "decode_tokens"),
+                             ("kv_block_seconds", "kv_block_seconds"),
+                             ("ttft_s", "ttft_s"),
+                             ("latency_s", "latency_s"),
+                             ("itl_p50_ms", "itl_p50_ms"),
+                             ("itl_p99_ms", "itl_p99_ms")):
+                if src in a:
+                    q[dst] = a[src]
+            q["preemptions"] = max(q["preemptions"],
+                                   int(a.get("preemptions", 0)))
+    out = {}
+    for key, q in reqs.items():
+        q["lanes"] = sorted(q["lanes"])
+        q["wall_s"] = round((q["last_ns"] - q["first_ns"]) / 1e9, 6)
+        del q["first_ns"], q["last_ns"]
+        out[key] = q
+    return {"requests": out, "count": len(out)}
+
+
 def merge(trace_dir: str, out_trace: Optional[str] = None,
           out_summary: Optional[str] = None,
           pattern: str = "trace_rank*.jsonl",
-          goodput: bool = False) -> dict:
+          goodput: bool = False, requests: bool = False) -> dict:
     """Merge every per-rank trace file under ``trace_dir`` onto one
     clock. Writes a chrome trace (default ``merged_trace.json``) and a
     summary (default ``merge_summary.json``) into ``trace_dir`` and
@@ -425,6 +489,8 @@ def merge(trace_dir: str, out_trace: Optional[str] = None,
     }
     if goodput:
         summary["goodput"] = _goodput_rollup(ranks, aligned)
+    if requests:
+        summary["requests"] = _request_rollup(aligned)
 
     out_trace = out_trace or os.path.join(trace_dir, "merged_trace.json")
     out_summary = out_summary or os.path.join(trace_dir,
@@ -452,14 +518,21 @@ def _main(argv: Optional[List[str]] = None) -> int:
     mp.add_argument("--goodput", action="store_true",
                     help="reclassify merged step spans into the goodput "
                          "ledger bins (offline job_goodput_fraction)")
+    mp.add_argument("--requests", action="store_true",
+                    help="group serving spans by W3C trace id across "
+                         "lanes; emit a per-request summary (ttft, itl "
+                         "percentiles, preemptions, KV block-seconds)")
     args = ap.parse_args(argv)
     if args.cmd == "merge":
         s = merge(args.trace_dir, out_trace=args.out,
-                  out_summary=args.summary, goodput=args.goodput)
+                  out_summary=args.summary, goodput=args.goodput,
+                  requests=args.requests)
         keys = ["ranks", "events", "steps_compared", "skew",
                 "straggler_counts", "out_trace", "out_summary"]
         if args.goodput:
             keys.append("goodput")
+        if args.requests:
+            keys.append("requests")
         print(json.dumps({k: s[k] for k in keys}, indent=1))
     return 0
 

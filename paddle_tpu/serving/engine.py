@@ -96,7 +96,17 @@ def _build_serving_metrics(reg) -> dict:
             "serving_engine_steps_total", "compiled steps run, by kind"),
         "rejections": reg.counter(
             "serving_rejections_total",
-            "requests shed by graceful degradation"),
+            "requests shed by graceful degradation, by reason "
+            "(queue_full / deadline)"),
+        # request-ledger headline numbers (ISSUE 16): scrapeable
+        # without /statusz
+        "in_flight": reg.gauge(
+            "serving_requests_in_flight",
+            "requests accepted but not yet finished (queued + running)"),
+        "kv_block_seconds": reg.counter(
+            "serving_kv_block_seconds_total",
+            "pool occupancy integral: KV blocks held by live sequences "
+            "x seconds held (the per-request cost ledger's denominator)"),
         "kv_blocks": reg.gauge(
             "serving_kv_blocks_in_use",
             "KV-cache blocks currently held by live sequences"),
@@ -145,6 +155,10 @@ class RequestHandle:
         return self._req.req_id
 
     @property
+    def trace_id(self) -> Optional[str]:
+        return self._req.trace_id
+
+    @property
     def token_ids(self) -> List[int]:
         return list(self._req.generated)
 
@@ -161,6 +175,7 @@ class RequestHandle:
             raise RuntimeError(f"request {r.req_id} failed: {r.error}")
         return {
             "request_id": r.req_id,
+            "trace_id": r.trace_id,
             "token_ids": list(r.generated),
             "num_generated": len(r.generated),
             "prompt_len": len(r.prompt_tokens),
@@ -318,6 +333,13 @@ class ServingEngine:
         self._shutdown = False
         self._handles = {}  # req_id -> RequestHandle
         self._published_preemptions = 0
+        # per-request cost ledger (ISSUE 16): armed per-engine at
+        # construction — PADDLE_TPU_REQUEST_LEDGER=0 builds a disarmed
+        # engine whose hot path pays only attribute reads on None
+        from paddle_tpu.observability import requests as obs_requests
+        self._ledger = obs_requests.maybe_arm()
+        self._new_trace_id = obs_requests.new_trace_id
+        self._published_block_seconds = 0.0
         # prefix-cache counter cursors (registry counters are process-
         # global; publish per-engine deltas like preemptions do)
         self._published_prefix = {"lookups": 0, "hits": 0, "evictions": 0}
@@ -501,6 +523,8 @@ class ServingEngine:
         self._m_tokens = m["tokens"]
         self._m_preempt = m["preemptions"]
         self._m_steps = m["steps"]
+        self._m_in_flight = m["in_flight"]
+        self._m_kv_block_seconds = m["kv_block_seconds"]
         self._m_kv_headroom = m["kv_headroom"]
         self._m_kv_reclaimable = m["kv_reclaimable"]
         self._m_step_compiles = m["step_compiles"]
@@ -576,6 +600,15 @@ class ServingEngine:
             seen = pc.hit_tokens + self._prompt_tokens_prefilled
             if seen:
                 self._m_prefix_token_fraction.set(pc.hit_tokens / seen)
+        self._m_in_flight.set(len(self._handles))
+        # pool-occupancy cost: the allocator's exact integral, published
+        # as a counter delta against a per-engine cursor (same pattern
+        # as preemptions — the registry counter is process-global)
+        bs_total = alloc.block_seconds_total()
+        d = bs_total - self._published_block_seconds
+        if d > 0:
+            self._m_kv_block_seconds.inc(d)
+            self._published_block_seconds = bs_total
         self._m_step_compiles.set(self.step_traces)
         # per-iteration HBM poll (the serving half of the StepTimer
         # poll): refresh the ledger-backed hbm_* gauges
@@ -589,9 +622,13 @@ class ServingEngine:
     def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                eos_token_id: Optional[int] = None,
-               on_token: Optional[Callable] = None) -> RequestHandle:
+               on_token: Optional[Callable] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Enqueue a request; returns immediately with a handle. Tokens
-        stream through ``on_token(request, token_id)`` as they decode."""
+        stream through ``on_token(request, token_id)`` as they decode.
+        ``trace_id`` carries a client-supplied W3C trace id (the server's
+        ``traceparent`` parse); absent, the engine mints one — either
+        way every span/response for the request carries it."""
         prompt_tokens = list(prompt_tokens)
         if not prompt_tokens:
             raise ValueError("empty prompt")
@@ -614,13 +651,16 @@ class ServingEngine:
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
                       top_p=float(top_p), eos_token_id=eos_token_id,
-                      on_token=on_token)
+                      on_token=on_token,
+                      trace_id=trace_id or self._new_trace_id())
         handle = RequestHandle(req)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("engine is shut down")
             self._handles[req.req_id] = handle
             self.scheduler.add(req)
+            if self._ledger is not None:
+                self._ledger.admit(req)
             self._m_requests.inc(outcome="accepted")
             self._update_gauges()
             self._cv.notify_all()
@@ -633,6 +673,12 @@ class ServingEngine:
         happened."""
         with self._lock:
             plan = self.scheduler.schedule()
+            if self._ledger is not None:
+                # step-boundary occupancy sample: bill each slotted
+                # request's previous holding level for the elapsed
+                # interval (scheduler.preempt/finish tick pre-free, so
+                # no interval is lost when blocks go back)
+                self._ledger.note_occupancy_many(self.scheduler.slotted())
             # belt-and-braces against plan staleness: never act on a
             # sequence that lost its slot/blocks during planning (a
             # later allocation in the same plan may have preempted it)
@@ -787,10 +833,13 @@ class ServingEngine:
                     # the "slow TTFT because XLA compiled" signal,
                     # distinct from admission or preemption
                     trace.span("serving", "prefill_chunk", t0, t1,
-                               args={"req": seq.req_id, "tokens": n,
+                               args={"req": seq.req_id,
+                                     "trace": seq.trace_id, "tokens": n,
                                      "pos": seq.prefill_pos,
                                      "compiles": compiled,
                                      "preemptions": seq.preemptions})
+                if self._ledger is not None:
+                    self._ledger.note_prefill(seq, n, compiled)
                 seq.prefill_pos += n
                 seq.num_cached += n
                 seq.prefilled_tokens += n
@@ -845,11 +894,15 @@ class ServingEngine:
 
     def _emit_token(self, seq: Request, tok: int):
         now = time.perf_counter()
+        itl = None
         if seq.first_token_time is None:
             seq.first_token_time = now
             self._m_ttft.observe(now - seq.arrival_time)
         elif seq.last_token_time is not None:
-            self._m_itl.observe(now - seq.last_token_time)
+            itl = now - seq.last_token_time
+            self._m_itl.observe(itl)
+        if self._ledger is not None:
+            self._ledger.note_token(seq, itl)
         seq.last_token_time = now
         seq.generated.append(int(tok))
         self._m_tokens.inc(kind="generated")
@@ -871,19 +924,25 @@ class ServingEngine:
             else "failed")
         if seq.latency() is not None:
             self._m_latency.observe(seq.latency())
-        self._emit_request_chain(seq, reason)
+        rec = (self._ledger.complete(seq)
+               if self._ledger is not None else None)
+        self._emit_request_chain(seq, reason, rec)
         handle = self._handles.pop(seq.req_id, None)
         if handle is not None:
             handle._done.set()
         with self._cv:
             self._cv.notify_all()
 
-    def _emit_request_chain(self, seq: Request, reason: str):
+    def _emit_request_chain(self, seq: Request, reason: str, rec=None):
         """The per-request span chain (docs/SERVING.md): queue_wait →
         [prefill_chunk spans emitted live] → decode → request_done. The
         retrospective spans use the request's recorded timestamps, so a
         slow TTFT decomposes into admission wait vs prefill/compile time
-        vs preemption recompute right in the merged trace."""
+        vs preemption recompute right in the merged trace. Every span
+        carries the W3C trace id, so ``trace merge --requests`` can
+        stitch the chain across processes; ``rec`` (the completed ledger
+        record, when armed) enriches ``request_done`` with the cost
+        summary the merge rollup reports."""
         from paddle_tpu.observability import trace
         if trace.active() is None:
             return
@@ -891,18 +950,19 @@ class ServingEngine:
         def ns(t):
             return int(t * 1e9)  # perf_counter -> perf_counter_ns clock
 
-        rid = seq.req_id
+        rid, tid = seq.req_id, seq.trace_id
         admitted = seq.slot_time
         if admitted is not None:
             trace.span("serving", "queue_wait", ns(seq.arrival_time),
-                       ns(admitted), args={"req": rid})
+                       ns(admitted), args={"req": rid, "trace": tid})
         if seq.first_token_time is not None:
             end = seq.finish_time or seq.last_token_time \
                 or seq.first_token_time
             trace.span("serving", "decode", ns(seq.first_token_time),
                        ns(end),
-                       args={"req": rid, "tokens": len(seq.generated)})
-        args = {"req": rid, "finish_reason": reason,
+                       args={"req": rid, "trace": tid,
+                             "tokens": len(seq.generated)})
+        args = {"req": rid, "trace": tid, "finish_reason": reason,
                 "prompt_len": len(seq.prompt_tokens),
                 "generated": len(seq.generated),
                 "preemptions": seq.preemptions}
@@ -910,6 +970,15 @@ class ServingEngine:
             args["ttft_s"] = round(seq.ttft(), 6)
         if seq.latency() is not None:
             args["latency_s"] = round(seq.latency(), 6)
+        if rec is not None:
+            args["prefilled_tokens"] = rec.prefilled_tokens
+            args["cached_tokens"] = rec.cached_tokens
+            args["decode_tokens"] = rec.decode_tokens
+            args["kv_block_seconds"] = round(rec.kv_block_seconds, 6)
+            p50, p99 = (rec.itl_percentile(0.5), rec.itl_percentile(0.99))
+            if p50 is not None:
+                args["itl_p50_ms"] = round(p50 * 1e3, 3)
+                args["itl_p99_ms"] = round(p99 * 1e3, 3)
         trace.mark("serving", "request_done",
                    ts_ns=ns(seq.finish_time or time.perf_counter()),
                    args=args)
@@ -1045,6 +1114,12 @@ class ServingEngine:
             "kv_blocks_free": free,
             "kv_blocks_reclaimable": reclaim,
             "preemptions": self.scheduler.num_preemptions,
+            # ledger headline numbers (ISSUE 16): scrapeable without
+            # /statusz — in-flight counts accepted-but-unfinished, and
+            # the block-seconds integral is the allocator's exact one
+            "requests_in_flight": len(self._handles),
+            "kv_block_seconds_total": round(
+                alloc.block_seconds_total(), 4),
             "step_compiles": self.step_traces,
             "attn_impl": self.attn_impl,
             "step_tokens": self.step_tokens,

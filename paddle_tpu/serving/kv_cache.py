@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -83,6 +84,12 @@ class BlockAllocator:
         # ids 1..num_blocks (0 is the null block); popped from the end
         self._free: List[int] = list(range(num_blocks, 0, -1))
         self._refcount: Dict[int, int] = {}
+        # block-seconds occupancy integral: bill the PREVIOUS holding
+        # level for each elapsed interval at every occupancy transition
+        # (left-continuous — the exact pool-level cost the per-request
+        # ledger approximates at step granularity)
+        self._occ_t = time.monotonic()
+        self._occ_seconds = 0.0
         # refcount-0 blocks still holding registered prefix-cache
         # contents, LRU order (oldest first — the eviction order)
         self._reclaimable: "OrderedDict[int, bytes]" = OrderedDict()
@@ -93,6 +100,22 @@ class BlockAllocator:
         #: reclaimable block is repurposed — the PrefixCache drops its
         #: index entry here (must not re-enter the allocator)
         self._evict_cb: Optional[Callable[[int, bytes], None]] = None
+
+    def _occ_tick_locked(self, now: Optional[float] = None):
+        """Accrue block-seconds at the current holding level (lock
+        held; called BEFORE any occupancy mutation)."""
+        now = time.monotonic() if now is None else now
+        dt = now - self._occ_t
+        if dt > 0:
+            self._occ_seconds += len(self._refcount) * dt
+            self._occ_t = now
+
+    def block_seconds_total(self) -> float:
+        """Cumulative pool occupancy integral (blocks held by live
+        sequences x seconds held) since construction."""
+        with self._lock:
+            self._occ_tick_locked()
+            return self._occ_seconds
 
     @property
     def capacity(self) -> int:
@@ -124,6 +147,7 @@ class BlockAllocator:
         eviction callback) — a cache entry is never worth failing an
         allocation for."""
         with self._lock:
+            self._occ_tick_locked()
             if len(self._free) + len(self._reclaimable) < n:
                 raise MemoryError(
                     f"KV block pool exhausted: need {n}, free "
@@ -154,6 +178,7 @@ class BlockAllocator:
         kept, evictable LRU — while an unregistered block returns to
         the free list."""
         with self._lock:
+            self._occ_tick_locked()
             for b in block_ids:
                 rc = self._refcount.get(b)
                 if rc is None:
@@ -189,6 +214,7 @@ class BlockAllocator:
         refcount 1. False when the block was already evicted (the
         caller treats the walk as a miss from here on)."""
         with self._lock:
+            self._occ_tick_locked()
             if block_id not in self._cached_key:
                 return False  # evicted (and possibly reallocated)
             if block_id in self._refcount:

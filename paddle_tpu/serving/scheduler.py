@@ -83,6 +83,11 @@ class Request:
     on_token: Optional[Callable] = None
     req_id: int = field(default_factory=lambda: next(_req_counter))
     arrival_time: float = field(default_factory=time.perf_counter)
+    #: W3C trace id (32 lowercase hex) — client-supplied via
+    #: ``traceparent`` or engine-generated at submit; stamped into every
+    #: trace span of this request so ``trace merge --requests`` can
+    #: stitch the cross-process chain
+    trace_id: Optional[str] = None
 
     # -- runtime state (engine/scheduler managed) --------------------------
     state: RequestState = RequestState.WAITING
@@ -370,6 +375,12 @@ class Scheduler:
         the resumed continuation token-identical. With the prefix cache
         on, the freed committed blocks PARK as reclaimable — readmission
         re-matches them and recomputes only the uncached tail."""
+        from paddle_tpu.observability import requests as obs_requests
+        led = obs_requests._active
+        if led is not None:
+            # close out the occupancy interval at the pre-free level —
+            # the request holds zero blocks until readmission
+            led.note_occupancy(seq, time.monotonic())
         self._release_cow(seq)
         self.cache.allocator.free(seq.block_ids)
         seq.block_ids = []
@@ -385,7 +396,8 @@ class Scheduler:
         self.num_preemptions += 1
         from paddle_tpu.observability import trace
         trace.mark("serving", "preempted",
-                   args={"req": seq.req_id, "preemptions": seq.preemptions,
+                   args={"req": seq.req_id, "trace": seq.trace_id,
+                         "preemptions": seq.preemptions,
                          "generated": len(seq.generated)})
         self.add(seq)
 
@@ -399,6 +411,11 @@ class Scheduler:
         """Return every resource; the engine records metrics/callbacks.
         Registered blocks park in the reclaimable tier — a finished
         request's prompt stays servable from cache."""
+        from paddle_tpu.observability import requests as obs_requests
+        led = obs_requests._active
+        if led is not None:
+            # bill the final holding interval before the blocks go back
+            led.note_occupancy(seq, time.monotonic())
         self._release_cow(seq)
         self.cache.allocator.free(seq.block_ids)
         seq.block_ids = []

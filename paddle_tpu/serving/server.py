@@ -20,6 +20,9 @@ Stdlib-only (``http.server`` on daemon threads, mirroring
 * ``GET /healthz`` — liveness + queue/batch occupancy; reports
   ``"status": "degraded"`` while the scheduler queue exceeds
   ``max_queue_depth``.
+* ``GET /statusz`` — the SLO observatory page (HTML; ``?format=json``
+  for the raw payload): live burn rates, scheduler occupancy, top-K
+  in-flight requests by KV block-seconds (docs/SERVING.md).
 * ``GET /metrics`` / ``GET /metrics.json`` — the observability
   registry's Prometheus-text / JSON expositions (serving_* families
   included; see docs/SERVING.md).
@@ -34,6 +37,14 @@ Graceful degradation (docs/RESILIENCE.md): with ``max_queue_depth`` set,
 queueing unboundedly, and each request may carry a ``"deadline_s"``
 budget — the server answers ``504`` when it can't finish in time rather
 than holding the connection to the global timeout.
+
+Distributed tracing (ISSUE 16): ``POST /generate`` parses an incoming
+W3C ``traceparent`` header (or mints a fresh trace id), threads the
+trace id through the engine — every ``trace.span`` for the request
+carries it — and echoes a ``traceparent`` on EVERY response, success or
+error, plus a ``trace_id`` field in the final NDJSON record and all
+error bodies, so clients can correlate a failure with server-side spans
+(``trace merge --requests``).
 """
 from __future__ import annotations
 
@@ -113,6 +124,22 @@ class Server:
                            if depth is not None else {})})
                 elif self.path.startswith("/fleetz"):
                     self._json(200, fleet.fleetz_snapshot())
+                elif self.path.startswith("/statusz"):
+                    from paddle_tpu.observability import (
+                        requests as obs_requests)
+                    payload = obs_requests.statusz_payload(
+                        engine_stats=server_ref.engine.stats())
+                    if "format=json" in self.path:
+                        self._json(200, payload)
+                    else:
+                        body = obs_requests.render_statusz_html(
+                            payload).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/html; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                 elif self.path.startswith("/metrics.json"):
                     self._json(200, get_registry().to_json())
                 elif self.path.startswith("/metrics"):
@@ -145,11 +172,22 @@ class Server:
                 if not self.path.startswith("/generate"):
                     self._json(404, {"error": "not found"})
                     return
+                # trace identity exists from the first byte: a rejected
+                # request still hands the client an id it can bring to a
+                # postmortem (headers parse before the body can fail)
+                from paddle_tpu.observability import (
+                    requests as obs_requests)
+                trace_id = obs_requests.parse_traceparent(
+                    self.headers.get("traceparent")) \
+                    or obs_requests.new_trace_id()
+                tp = {"traceparent":
+                      obs_requests.format_traceparent(trace_id)}
                 body = self._read_body()
                 if not isinstance(body, dict) or not isinstance(
                         body.get("prompt_ids"), list):
                     self._json(400, {"error": "body must be a JSON "
-                                     "object with prompt_ids"})
+                                     "object with prompt_ids",
+                                     "trace_id": trace_id}, headers=tp)
                     return
                 if self._overloaded():
                     # shed load instead of queueing unboundedly: the
@@ -159,9 +197,10 @@ class Server:
                     self._json(
                         503, {"error": "server overloaded: scheduler "
                               "queue exceeds max_queue_depth "
-                              f"{server_ref.max_queue_depth}"},
+                              f"{server_ref.max_queue_depth}",
+                              "trace_id": trace_id},
                         headers={"Retry-After":
-                                 str(server_ref.retry_after_s)})
+                                 str(server_ref.retry_after_s), **tp})
                     return
                 try:
                     deadline_s = body.get("deadline_s")
@@ -170,7 +209,8 @@ class Server:
                     if deadline_s is not None and deadline_s <= 0:
                         raise ValueError("deadline_s must be > 0")
                 except (TypeError, ValueError) as e:
-                    self._json(400, {"error": f"bad deadline_s: {e}"})
+                    self._json(400, {"error": f"bad deadline_s: {e}",
+                                     "trace_id": trace_id}, headers=tp)
                     return
                 timeout = server_ref.request_timeout \
                     if deadline_s is None \
@@ -190,16 +230,18 @@ class Server:
                         top_k=int(body.get("top_k", 0)),
                         top_p=float(body.get("top_p", 1.0)),
                         eos_token_id=body.get("eos_token_id"),
-                        on_token=on_token if stream else None)
+                        on_token=on_token if stream else None,
+                        trace_id=trace_id)
                 except (ValueError, TypeError, RuntimeError) as e:
                     # TypeError: well-formed JSON, wrong field types
                     # (e.g. "max_new_tokens": null) — still a 400
-                    self._json(400, {"error": str(e)})
+                    self._json(400, {"error": str(e),
+                                     "trace_id": trace_id}, headers=tp)
                     return
                 if stream:
-                    self._stream_response(handle, tokens_q, timeout)
+                    self._stream_response(handle, tokens_q, timeout, tp)
                 else:
-                    self._sync_response(handle, timeout)
+                    self._sync_response(handle, timeout, tp)
 
             def _profile_capture(self):
                 """Bounded on-demand device-trace window. 400 on a
@@ -239,31 +281,37 @@ class Server:
                     except Exception:
                         pass  # best-effort; the 504 already went out
 
-            def _sync_response(self, handle, timeout):
+            def _sync_response(self, handle, timeout, tp):
+                # getattr: duck-typed engines (tests, shims) may hand
+                # back handles without the id fields
+                ids = {"request_id": getattr(handle, "req_id", None),
+                       "trace_id": getattr(handle, "trace_id", None)}
                 try:
                     res = handle.result(timeout)
                 except TimeoutError:
+                    from .engine import serving_metrics
+                    serving_metrics()["rejections"].inc(reason="deadline")
                     self._json(504, {"error": "request timed out after "
-                                     f"{timeout}s"})
+                                     f"{timeout}s", **ids}, headers=tp)
                     self._abort(handle)
                     return
                 except RuntimeError as e:
-                    self._json(500, {"error": str(e)})
+                    self._json(500, {"error": str(e), **ids}, headers=tp)
                     return
-                self._json(200, _result_json(res))
+                self._json(200, _result_json(res), headers=tp)
 
-            def _stream_response(self, handle, tokens_q, timeout):
+            def _stream_response(self, handle, tokens_q, timeout, tp):
                 # a disconnect mid-stream aborts the engine-side request
                 # too: decoding thousands of tokens into a dead socket
                 # would hold a batch slot + KV blocks that live requests
                 # are being 503-shed for
                 try:
-                    self._stream_body(handle, tokens_q, timeout)
+                    self._stream_body(handle, tokens_q, timeout, tp)
                 except (BrokenPipeError, ConnectionResetError):
                     self._abort(handle)
                     raise
 
-            def _stream_body(self, handle, tokens_q, timeout):
+            def _stream_body(self, handle, tokens_q, timeout, tp):
                 import time as _time
                 from paddle_tpu.observability import trace
 
@@ -271,6 +319,8 @@ class Server:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
+                for k, v in tp.items():
+                    self.send_header(k, v)
                 self.end_headers()
 
                 def chunk(obj):
@@ -293,9 +343,13 @@ class Server:
                     while True:
                         if _time.monotonic() > deadline:
                             outcome = "stalled"
+                            from .engine import serving_metrics
+                            serving_metrics()["rejections"].inc(
+                                reason="deadline")
                             chunk({"done": True,
                                    "error": "stream stalled: no token for "
-                                   f"{timeout}s"})
+                                   f"{timeout}s",
+                                   "trace_id": handle.trace_id})
                             self.wfile.write(b"0\r\n\r\n")
                             self._abort(handle)
                             return
@@ -322,13 +376,15 @@ class Server:
                                 chunk({"done": True, **_result_json(res)})
                             except (TimeoutError, RuntimeError) as e:
                                 outcome = "error"
-                                chunk({"done": True, "error": str(e)})
+                                chunk({"done": True, "error": str(e),
+                                       "trace_id": handle.trace_id})
                             self.wfile.write(b"0\r\n\r\n")
                             return
                 finally:
                     trace.span("serving", "stream", t_stream0,
                                _time.perf_counter_ns(),
                                args={"req": handle.req_id,
+                                     "trace": handle.trace_id,
                                      "tokens": sent,
                                      "outcome": outcome})
 

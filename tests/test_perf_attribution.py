@@ -299,7 +299,11 @@ _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           # bench.py --numerics report-gate headline
                           # (ISSUE 14) — a stdout {"metric","value"}
                           # line, not a registry family
-                          "numerics_step_overhead_frac"}
+                          "numerics_step_overhead_frac",
+                          # bench.py --serve ledger-cost headline
+                          # (ISSUE 16) — a report-gate stdout line, not
+                          # a registry family
+                          "serving_request_ledger_overhead_frac"}
 
 
 def _documented_families():
@@ -350,6 +354,8 @@ def _registered_families():
     from paddle_tpu.observability.goodput import goodput_metrics
     from paddle_tpu.observability.memory import memory_metrics
     from paddle_tpu.observability.numerics import numerics_metrics
+    from paddle_tpu.observability.requests import request_metrics
+    from paddle_tpu.observability.slo import slo_metrics
     from paddle_tpu.resilience.counters import (
         nonfinite_counter, preemption_counter, rollback_counter,
         watchdog_metrics)
@@ -365,6 +371,8 @@ def _registered_families():
     memory_metrics()
     numerics_metrics()
     serving_metrics()
+    request_metrics()
+    slo_metrics()
     nonfinite_counter(), rollback_counter(), preemption_counter()
     watchdog_metrics()
     return {n for n in get_registry().names()

@@ -1,0 +1,461 @@
+"""Request-level serving observability (ISSUE 16).
+
+Coverage contract: W3C traceparent parse/format round trip and the HTTP
+echo (client-supplied id on every response, errors included); ledger
+token exactness against the bit-identical greedy stream (prefilled +
+cached covers the prompt, decode equals the continuation) and the
+ledger-disarmed twin producing the same tokens; the tail sampler
+keeping every error/preempted record; multi-window burn rates tripping
+on a sustained breach (and NOT on a fast-window-only burst) then
+recovering as the windows drain; the ``/statusz`` contract on both HTTP
+front ends; the ``serving_rejections_total{reason}`` split; and ``trace
+merge --requests`` cross-checked against the live ledger.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import get_registry, slo
+from paddle_tpu.observability import requests as obs_requests
+from paddle_tpu.observability.requests import (RequestLedger, RequestRecord,
+                                               format_traceparent,
+                                               new_trace_id,
+                                               parse_traceparent)
+from paddle_tpu.serving import Server, ServingEngine
+
+
+def _tiny(seed=11):
+    pt.seed(seed)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=True))
+    m.eval()
+    return m
+
+
+def _eager_continuation(model, prompt, max_new_tokens):
+    out = model.generate(pt.to_tensor(np.asarray(prompt)[None, :]),
+                         max_new_tokens=max_new_tokens,
+                         temperature=0.0).numpy()[0]
+    return [int(t) for t in out[len(prompt):]]
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One model + armed-ledger engine shared module-wide (compile
+    once); the ledger is on by default — no env needed."""
+    model = _tiny(11)
+    eng = ServingEngine(model, max_batch=4, max_blocks=32, block_size=4,
+                        prefill_chunk=4)
+    assert eng._ledger is not None  # armed by default
+    return model, eng
+
+
+def _post(url, body, headers=None, timeout=60):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+# ---------------- traceparent helpers ----------------------------------------
+def test_traceparent_parse_format_roundtrip():
+    tid = new_trace_id()
+    assert len(tid) == 32 and int(tid, 16) != 0
+    hdr = format_traceparent(tid)
+    assert parse_traceparent(hdr) == tid
+    assert hdr.startswith("00-") and hdr.endswith("-01")
+    assert parse_traceparent(format_traceparent(tid, sampled=False)) == tid
+    # uppercase inbound headers normalize per spec
+    assert parse_traceparent(hdr.upper()) == tid
+    for bad in (None, "", "garbage", hdr + "-extra",
+                "00-" + "0" * 32 + "-" + "a" * 16 + "-01",   # zero trace id
+                "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # zero parent
+                "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",   # forbidden ver
+                "00-" + "g" * 32 + "-" + "b" * 16 + "-01",   # non-hex
+                "00-" + "a" * 31 + "-" + "b" * 16 + "-01"):  # short
+        assert parse_traceparent(bad) is None
+
+
+# ---------------- ledger exactness vs the greedy stream ----------------------
+def test_ledger_token_exactness(served):
+    model, eng = served
+    led = eng._ledger
+    old_rate = led.sample_rate
+    led.sample_rate = 1.0  # keep every completion in the exemplar ring
+    try:
+        rng = np.random.RandomState(3)
+        prompts = [[int(t) for t in rng.randint(1, 128, n)]
+                   for n in (6, 5, 7)]
+        handles = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        eng.run_until_idle()
+        results = [h.result(timeout=60) for h in handles]
+    finally:
+        led.sample_rate = old_rate
+    recs = {d["trace_id"]: d for d in led.exemplars()}
+    for p, h, res in zip(prompts, handles, results):
+        assert res["token_ids"] == _eager_continuation(model, p, 4)
+        rec = recs[h.trace_id]
+        # token exactness against the scheduler's lifetime accumulators:
+        # cold + cached covers the prompt, decode equals the stream
+        assert rec["prefilled_tokens"] + rec["cached_tokens"] == len(p)
+        assert rec["decode_tokens"] == len(res["token_ids"]) == 4
+        assert rec["state"] == "done" and rec["finish_reason"] == "length"
+        assert rec["queue_wait_s"] is not None and rec["queue_wait_s"] >= 0
+        assert rec["ttft_s"] > 0 and rec["latency_s"] >= rec["ttft_s"]
+        # the request held blocks for a while: both cost fields moved
+        assert rec["peak_kv_blocks"] > 0 and rec["kv_block_seconds"] > 0
+    assert led.in_flight_count() == 0
+    # satellite: stats() carries the new accounting fields, and the
+    # pool-level integral is at least the per-request billing
+    st = eng.stats()
+    assert st["requests_in_flight"] == 0
+    assert st["kv_block_seconds_total"] >= sum(
+        recs[h.trace_id]["kv_block_seconds"] for h in handles) * 0.5
+
+
+def test_bit_identical_with_ledger_disarmed(served, monkeypatch):
+    """PADDLE_TPU_REQUEST_LEDGER=0: the engine holds no ledger and the
+    greedy stream is bit-identical — the ledger is host-side only."""
+    model, eng = served
+    monkeypatch.setenv("PADDLE_TPU_REQUEST_LEDGER", "0")
+    eng2 = ServingEngine(model, max_batch=4, max_blocks=32, block_size=4,
+                         prefill_chunk=4)
+    assert eng2._ledger is None
+    # the process-global ledger stays armed for the other engine
+    assert obs_requests.active() is not None
+    prompt = [int(t) for t in np.random.RandomState(9).randint(1, 128, 6)]
+    try:
+        h2 = eng2.submit(prompt, max_new_tokens=5)
+        eng2.run_until_idle()
+        off_tokens = h2.result(timeout=60)["token_ids"]
+    finally:
+        eng2.shutdown()
+    h1 = eng.submit(prompt, max_new_tokens=5)
+    eng.run_until_idle()
+    on_tokens = h1.result(timeout=60)["token_ids"]
+    assert on_tokens == off_tokens == _eager_continuation(model, prompt, 5)
+
+
+# ---------------- tail sampler ----------------------------------------------
+class _FakeReq:
+    def __init__(self, rid):
+        self.req_id = rid
+        self.trace_id = f"{rid:032x}"
+        self.arrival_time = 0.0
+        self.prompt_tokens = [1, 2, 3]
+        self.max_new_tokens = 4
+
+
+class _FakeSeq:
+    def __init__(self, rid, latency=0.1, failed=False, preemptions=0):
+        self.req_id = rid
+        self.state = "failed" if failed else "finished"
+        self.arrival_time = 0.0
+        self.slot_time = 0.01
+        self.prefilled_tokens = 3
+        self.cached_tokens_total = 0
+        self.generated = [7, 8]
+        self.preemptions = preemptions
+        self.finish_reason = "error" if failed else "length"
+        self.error = "boom" if failed else None
+        self._latency = latency
+
+    def ttft(self):
+        return None if self.error else self._latency / 2
+
+    def latency(self):
+        return self._latency
+
+
+def test_tail_sampler_keeps_every_error_preempted_and_slow(tmp_path):
+    led = RequestLedger(log_dir=str(tmp_path), sample_rate=0.0)
+    rid = iter(range(1000))
+
+    def run(**kw):
+        r = next(rid)
+        led.admit(_FakeReq(r))
+        led.complete(_FakeSeq(r, **kw))
+
+    for _ in range(30):          # unremarkable, sample_rate=0 -> dropped
+        run(latency=0.1)
+    run(latency=9.0)             # beyond the window's p95
+    run(failed=True)             # error: always kept
+    run(preemptions=2)           # preempted: always kept
+    assert led.dropped == 30
+    assert led.kept == {"error": 1, "preempted": 1, "slow_tail": 1,
+                        "sampled": 0}
+    ring = led.exemplars()
+    assert [d["kept"] for d in ring] == ["slow_tail", "error", "preempted"]
+    assert ring[1]["error"] == "boom" and ring[1]["state"] == "failed"
+    assert ring[2]["preemptions"] == 2
+    # JSONL twin: exactly the kept records, valid JSON per line
+    led.close()
+    files = list(tmp_path.glob("requests_*.jsonl"))
+    assert len(files) == 1
+    lines = [json.loads(ln) for ln in files[0].read_text().splitlines()]
+    assert [d["kept"] for d in lines] == ["slow_tail", "error", "preempted"]
+
+
+# ---------------- burn rates -------------------------------------------------
+def _rec(ttft_s, failed=False):
+    r = RequestRecord(req_id=0, trace_id=None, arrival_s=0.0,
+                      prompt_len=4, max_new_tokens=4)
+    r.state = "failed" if failed else "done"
+    r.ttft_s = ttft_s
+    return r
+
+
+def test_burn_rate_trips_on_sustained_breach_and_recovers():
+    mon = slo.configure({"ttft_p99": (0.5, 0.99)}, windows_s=(10.0, 100.0),
+                        alert_threshold=2.0)
+    try:
+        t0 = 1000.0
+        for i in range(10):                      # sustained breach
+            mon.observe(_rec(5.0), now=t0 + i)
+        snap = mon.snapshot(now=t0 + 10.0)
+        s = snap["slos"]["ttft_p99"]
+        # all-bad traffic burns at 1/budget = 100x on both windows
+        assert s["burn_rate"]["fast"] == pytest.approx(100.0)
+        assert s["burn_rate"]["slow"] == pytest.approx(100.0)
+        assert s["alerting"] is True
+        m = slo.slo_metrics()
+        assert m["alert"].value(slo="ttft_p99") == 1.0
+        assert m["burn"].value(slo="ttft_p99", window="fast") == \
+            pytest.approx(100.0)
+        # healthy traffic: the fast window drains first — slow-window
+        # residue alone must NOT page (the multi-window rule)
+        for i in range(40):
+            mon.observe(_rec(0.01), now=t0 + 30.0 + i)
+        snap = mon.snapshot(now=t0 + 70.0)
+        s = snap["slos"]["ttft_p99"]
+        assert s["burn_rate"]["fast"] == pytest.approx(0.0)
+        assert s["burn_rate"]["slow"] > 0.0
+        assert s["alerting"] is False
+        assert m["alert"].value(slo="ttft_p99") == 0.0
+        # and the slow window eventually forgets the breach entirely
+        snap = mon.snapshot(now=t0 + 500.0)
+        s = snap["slos"]["ttft_p99"]
+        assert s["burn_rate"]["slow"] == pytest.approx(0.0)
+        assert s["events_in_window"] == 0
+    finally:
+        slo.reset()
+
+
+def test_slo_verdicts_and_env_arming(monkeypatch):
+    mon = slo.SloMonitor({"ttft_p99": (0.5, 0.99),
+                          "itl_p99": (0.05, 0.99),
+                          "success": (0.999, 0.999)})
+    # ttft: breach / ok / failed-before-first-token / not-applicable
+    assert mon._verdict("ttft_p99", _rec(0.9)) is True
+    assert mon._verdict("ttft_p99", _rec(0.1)) is False
+    assert mon._verdict("ttft_p99", _rec(None, failed=True)) is True
+    assert mon._verdict("ttft_p99", _rec(None)) is None
+    # itl: per-request p99 vs target; no samples -> skipped
+    r = _rec(0.1)
+    r.itl_samples_s = [0.01] * 9 + [0.2]   # nearest-rank p99 = the max
+    assert mon._verdict("itl_p99", r) is True
+    assert mon._verdict("itl_p99", _rec(0.1)) is None
+    # success: failure is the only bad
+    assert mon._verdict("success", _rec(None, failed=True)) is True
+    assert mon._verdict("success", _rec(0.1)) is False
+    # env arming parses targets + windows + threshold
+    slo.reset()
+    try:
+        monkeypatch.setenv("PADDLE_TPU_SLO_TTFT_P99_S", "0.25")
+        monkeypatch.setenv("PADDLE_TPU_SLO_SUCCESS", "0.995")
+        monkeypatch.setenv("PADDLE_TPU_SLO_WINDOWS", "60:600")
+        monkeypatch.setenv("PADDLE_TPU_SLO_BURN_ALERT", "6.0")
+        mon = slo.maybe_arm_from_env()
+        assert mon is not None
+        assert mon.targets == {"ttft_p99": (0.25, 0.99),
+                               "success": (0.995, 0.995)}
+        assert mon.windows_s == (60.0, 600.0)
+        assert mon.alert_threshold == 6.0
+        assert slo.maybe_arm_from_env() is mon  # idempotent
+    finally:
+        slo.reset()
+
+
+# ---------------- HTTP contract ----------------------------------------------
+def test_http_traceparent_echo_and_statusz(served):
+    model, eng = served
+    tid = "ab" * 16
+    srv = Server(eng).start()
+    try:
+        prompt = [int(t) for t in
+                  np.random.RandomState(5).randint(1, 128, 6)]
+        # client-supplied trace id echoes on header AND body
+        code, headers, body = _post(
+            srv.url, {"prompt_ids": prompt, "max_new_tokens": 3},
+            headers={"traceparent": format_traceparent(tid)})
+        assert code == 200
+        assert parse_traceparent(headers["traceparent"]) == tid
+        res = json.loads(body)
+        assert res["trace_id"] == tid and "request_id" in res
+        # absent header: a fresh valid id is minted and echoed
+        code, headers, body = _post(
+            srv.url, {"prompt_ids": prompt, "max_new_tokens": 3})
+        assert code == 200
+        minted = json.loads(body)["trace_id"]
+        assert len(minted) == 32 and int(minted, 16) != 0
+        assert parse_traceparent(headers["traceparent"]) == minted
+        # streaming: header echo + trace id in the final NDJSON record
+        code, headers, body = _post(
+            srv.url, {"prompt_ids": prompt, "max_new_tokens": 3,
+                      "stream": True},
+            headers={"traceparent": format_traceparent(tid)})
+        assert code == 200
+        assert parse_traceparent(headers["traceparent"]) == tid
+        last = json.loads(body.decode().strip().split("\n")[-1])
+        assert last["done"] is True and last["trace_id"] == tid
+        # error responses carry the id too (satellite a)
+        code, headers, body = _post(
+            srv.url, {"prompt_ids": "nope"},
+            headers={"traceparent": format_traceparent(tid)})
+        assert code == 400
+        assert json.loads(body)["trace_id"] == tid
+        assert parse_traceparent(headers["traceparent"]) == tid
+        # /healthz gained the accounting fields (satellite b)
+        hz = json.loads(urllib.request.urlopen(
+            srv.url + "/healthz", timeout=10).read())
+        assert hz["requests_in_flight"] == 0
+        assert hz["kv_block_seconds_total"] > 0
+        # /statusz: JSON contract + HTML rendering
+        sz = json.loads(urllib.request.urlopen(
+            srv.url + "/statusz?format=json", timeout=10).read())
+        assert sz["requests"]["enabled"] is True
+        assert sz["requests"]["completed"] >= 1
+        assert "top_in_flight" in sz["requests"] and "slo" in sz
+        assert sz["engine"]["requests_in_flight"] == 0
+        html = urllib.request.urlopen(
+            srv.url + "/statusz", timeout=10).read().decode()
+        assert "<h1>/statusz</h1>" in html
+        assert "KV block-seconds" in html
+    finally:
+        srv.close(stop_engine=False)
+
+
+def test_statusz_on_metrics_exporter():
+    from paddle_tpu.observability.metrics import (MetricsExporter,
+                                                  MetricsRegistry)
+    exp = MetricsExporter(0, MetricsRegistry())
+    try:
+        base = f"http://127.0.0.1:{exp.port}"
+        sz = json.loads(urllib.request.urlopen(
+            base + "/statusz?format=json", timeout=10).read())
+        assert "slo" in sz and "requests" in sz
+        assert "engine" not in sz  # no engine attached to the exporter
+        html = urllib.request.urlopen(
+            base + "/statusz", timeout=10).read().decode()
+        assert "<h1>/statusz</h1>" in html
+    finally:
+        exp.stop()
+
+
+def test_rejection_reasons_split():
+    """serving_rejections_total splits queue_full vs deadline, and both
+    shed paths hand back a trace id (stub engine: no compile cost)."""
+    reg = get_registry()
+    rej = reg.counter("serving_rejections_total")
+
+    class _StuckHandle:
+        def result(self, timeout=None):
+            time.sleep(min(timeout or 0.0, 0.2))
+            raise TimeoutError("never finishes")
+
+        def wait(self, timeout=None):
+            return False
+
+    class _StubEngine:
+        def __init__(self, waiting=0):
+            self.waiting = waiting
+
+        def start(self):
+            return self
+
+        def shutdown(self, drain=True):
+            pass
+
+        def stats(self):
+            return {"running": 0, "waiting": self.waiting}
+
+        def submit(self, prompt_ids, **kw):
+            h = _StuckHandle()
+            h.req_id = 7
+            h.trace_id = kw.get("trace_id")
+            return h
+
+    before_q = rej.value(reason="queue_full")
+    before_d = rej.value(reason="deadline")
+    srv = Server(_StubEngine(waiting=9), max_queue_depth=2).start()
+    try:
+        code, headers, body = _post(srv.url, {"prompt_ids": [1]})
+        assert code == 503
+        assert len(json.loads(body)["trace_id"]) == 32
+        assert "traceparent" in headers and "Retry-After" in headers
+    finally:
+        srv.close()
+    srv = Server(_StubEngine(waiting=0), request_timeout=0.1).start()
+    try:
+        code, headers, body = _post(srv.url, {"prompt_ids": [1]})
+        assert code == 504
+        b = json.loads(body)
+        assert len(b["trace_id"]) == 32 and "request_id" in b
+        assert "traceparent" in headers
+    finally:
+        srv.close()
+    assert rej.value(reason="queue_full") == before_q + 1
+    assert rej.value(reason="deadline") == before_d + 1
+
+
+# ---------------- trace merge --requests -------------------------------------
+def test_trace_merge_requests_rollup_matches_ledger(served, tmp_path):
+    from paddle_tpu.observability import trace
+    model, eng = served
+    led = eng._ledger
+    old_rate = led.sample_rate
+    led.sample_rate = 1.0
+    trace.disable()
+    trace.enable(str(tmp_path), rank=0)
+    try:
+        prompts = [list(range(1, 7)), list(range(20, 25))]
+        tids = [new_trace_id() for _ in prompts]
+        handles = [eng.submit(p, max_new_tokens=4, trace_id=t)
+                   for p, t in zip(prompts, tids)]
+        eng.run_until_idle()
+        results = [h.result(timeout=60) for h in handles]
+    finally:
+        led.sample_rate = old_rate
+        trace.disable()
+    summary = trace.merge(str(tmp_path), requests=True)
+    roll = summary["requests"]
+    assert roll["count"] >= 2
+    recs = {d["trace_id"]: d for d in led.exemplars()}
+    for p, t, h, res in zip(prompts, tids, handles, results):
+        q = roll["requests"][t]
+        rec = recs[t]
+        assert q["req_id"] == h.req_id and q["trace_id"] == t
+        assert q["lanes"] and q["spans"] >= 4
+        # span-derived prefill work vs the prompt, and the ledger-
+        # enriched completion record vs the live ledger (satellite f)
+        assert q["prefill_tokens"] == len(p)
+        assert q["prefilled_tokens"] + q["cached_tokens"] == len(p)
+        assert q["decode_tokens"] == rec["decode_tokens"] == \
+            len(res["token_ids"])
+        assert q["generated"] == len(res["token_ids"])
+        assert q["finish_reason"] == "length"
+        assert q["queue_wait_s"] is not None
+        assert q["kv_block_seconds"] == rec["kv_block_seconds"]
+        assert q["ttft_s"] == pytest.approx(rec["ttft_s"], abs=1e-5)
