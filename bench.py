@@ -619,6 +619,210 @@ def bench_serve():
     return out
 
 
+def bench_fleet(n_replicas=None):
+    """Multi-replica fleet bench (--serve --replicas N): drive N engine
+    replicas behind the cache-aware :class:`FleetRouter` with an
+    open-loop Poisson trace of shared-prefix request groups and compare
+    against a single replica under the SAME per-replica offered load —
+    the throughput ratio over N single-replica throughputs is the
+    fleet's scaling efficiency. A second, mixed long-prompt/chat trace
+    runs disaggregation ON (prefill/decode-tagged replicas, long
+    prompts prefilled off the decode path) vs OFF (all mixed) and
+    reports the chat traffic's p99 inter-token latency both ways — the
+    long-prompt-isolation number. Headlines:
+    ``serving_fleet_tokens_per_sec`` / ``serving_fleet_scaling_efficiency``
+    / ``serving_router_affinity_hit_rate`` (all HIGHER_BETTER,
+    ``_cpu_smoke`` suffix off-TPU)."""
+    import time as _time
+
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import FleetRouter, Replica, ServingEngine
+
+    if n_replicas is None:
+        n_replicas = int(os.environ.get("PADDLE_TPU_FLEET_REPLICAS", "4"))
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=128256, hidden_size=2048, intermediate_size=7168,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=4, max_position_embeddings=4096,
+            tie_word_embeddings=True)
+        eng_kw = dict(max_batch=8, max_blocks=512, block_size=16,
+                      prefill_chunk=128)
+        n_base, mean_gap, pfx_len, tail_lo, tail_hi, gen_n = \
+            16, 0.05, 64, 8, 24, 32
+        long_lo, long_hi, chat_gen, long_gen, disagg_thresh = \
+            512, 1024, 32, 8, 256
+    else:
+        cfg = LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=256,
+            tie_word_embeddings=True)
+        eng_kw = dict(max_batch=4, max_blocks=64, block_size=8,
+                      prefill_chunk=16)
+        # per-replica offered load sized well under one replica's
+        # capacity: the efficiency headline isolates router/contention
+        # overhead, not CPU-smoke GIL saturation
+        n_base, mean_gap, pfx_len, tail_lo, tail_hi, gen_n = \
+            8, 0.1, 16, 4, 8, 8
+        long_lo, long_hi, chat_gen, long_gen, disagg_thresh = \
+            64, 96, 12, 4, 48
+
+    def model_fn():
+        pt.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        if on_tpu:
+            m.bfloat16()
+        return m
+
+    def spin_up(n, roles=None, **router_kw):
+        roles = list(roles or [])
+        roles += ["mixed"] * (n - len(roles))
+        reps = [Replica(ServingEngine(model_fn(), **eng_kw), f"r{i}",
+                        role=roles[i]) for i in range(n)]
+        router = FleetRouter(reps, **router_kw)
+        router.start()
+        # warmup: compile each replica's unified step outside the
+        # timed window (prefill-role replicas too — the disagg path
+        # runs through them)
+        rng = np.random.RandomState(99)
+        for rep in reps:
+            rep.engine.submit(rng.randint(1, cfg.vocab_size, 8),
+                              max_new_tokens=2).result(timeout=600)
+        return router, reps
+
+    def run_trace(router, reqs, itl_sink=None):
+        """Open-loop Poisson drive: (gap, prompt, gen, tag) tuples.
+        ``itl_sink[tag]`` collects client-observed inter-token gaps."""
+        handles = []
+        t0 = _time.perf_counter()
+        for gap, prompt, gen, tag in reqs:
+            _time.sleep(gap)
+            on_token = None
+            if itl_sink is not None:
+                stamps = itl_sink.setdefault(tag, [])
+                marker = []
+
+                def on_token(h, tok, _s=stamps, _m=marker):
+                    now = _time.perf_counter()
+                    if _m:
+                        _s.append(now - _m[0])
+                    _m[:] = [now]
+            handles.append(router.submit(prompt, max_new_tokens=gen,
+                                         on_token=on_token))
+        results = [h.result(timeout=600) for h in handles]
+        elapsed = _time.perf_counter() - t0
+        tokens = sum(r["num_generated"] for r in results)
+        return tokens / elapsed, elapsed, results
+
+    def shared_prefix_trace(rng, n_req, gap_mean):
+        """Shared-prefix request groups (4 system prompts): the traffic
+        shape cache-aware placement exists for — after each group's
+        first request registers its blocks somewhere, affinity should
+        pin the rest of the group to that replica."""
+        prefixes = [list(rng.randint(1, cfg.vocab_size, pfx_len))
+                    for _ in range(4)]
+        gaps = rng.exponential(gap_mean, n_req)
+        out = []
+        for i in range(n_req):
+            p = prefixes[rng.randint(len(prefixes))]
+            tail = list(rng.randint(1, cfg.vocab_size,
+                                    rng.randint(tail_lo, tail_hi + 1)))
+            out.append((gaps[i], p + tail, gen_n, "chat"))
+        return out
+
+    out = {"replicas": n_replicas}
+
+    # -- scaling: same per-replica offered load, 1 vs N replicas -----------
+    router1, _ = spin_up(1)
+    tps1, el1, _ = run_trace(router1,
+                             shared_prefix_trace(np.random.RandomState(2),
+                                                 n_base, mean_gap))
+    router1.shutdown(drain=True)
+    gc.collect()
+
+    routerN, _ = spin_up(n_replicas)
+    tpsN, elN, _ = run_trace(
+        routerN, shared_prefix_trace(np.random.RandomState(2),
+                                     n_base * n_replicas,
+                                     mean_gap / n_replicas))
+    statsN = routerN.stats()
+    routerN.shutdown(drain=True)
+    gc.collect()
+
+    efficiency = round(tpsN / max(n_replicas * tps1, 1e-9), 4)
+    out["single_replica_tokens_per_sec"] = round(tps1, 1)
+    out["fleet_tokens_per_sec"] = round(tpsN, 1)
+    out["scaling_efficiency"] = efficiency
+    out["affinity_hit_rate"] = statsN.get("affinity_hit_rate") or 0.0
+    out["routing"] = statsN.get("routing")
+    print(json.dumps({"fleet_scaling": {
+        "tps_1": out["single_replica_tokens_per_sec"],
+        "tps_n": out["fleet_tokens_per_sec"],
+        "efficiency": efficiency, "routing": out["routing"]}}),
+        file=sys.stderr, flush=True)
+
+    # -- disaggregation: long-prompt/chat mix, disagg on vs off ------------
+    def mixed_trace(rng):
+        gaps = rng.exponential(mean_gap, n_base * 2)
+        reqs = []
+        for i in range(n_base * 2):
+            if i % 4 == 0:  # every 4th request drags a long prompt in
+                plen = rng.randint(long_lo, long_hi + 1)
+                reqs.append((gaps[i],
+                             list(rng.randint(1, cfg.vocab_size, plen)),
+                             long_gen, "long"))
+            else:
+                plen = rng.randint(tail_lo + 4, tail_lo + 12)
+                reqs.append((gaps[i],
+                             list(rng.randint(1, cfg.vocab_size, plen)),
+                             chat_gen, "chat"))
+        return reqs
+
+    def chat_p99_itl(disagg):
+        roles = (["prefill"] + ["decode"] * (n_replicas - 1)) if disagg \
+            else None
+        router, _ = spin_up(max(n_replicas, 2), roles=roles,
+                            disagg=disagg,
+                            prefill_threshold=disagg_thresh)
+        sink = {}
+        _, _, _ = run_trace(router, mixed_trace(np.random.RandomState(5)),
+                            itl_sink=sink)
+        stats = router.stats()
+        router.shutdown(drain=True)
+        gc.collect()
+        itls = sink.get("chat") or [0.0]
+        return (round(float(np.percentile(itls, 99)) * 1e3, 3),
+                stats.get("routing"))
+
+    disagg_itl, disagg_routing = chat_p99_itl(True)
+    mixed_itl, _ = chat_p99_itl(False)
+    out["disagg"] = {
+        "chat_p99_itl_ms_disagg_on": disagg_itl,
+        "chat_p99_itl_ms_disagg_off": mixed_itl,
+        "isolation_ratio": round(mixed_itl / max(disagg_itl, 1e-9), 3),
+        "routing": disagg_routing,
+    }
+    print(json.dumps({"fleet_disagg": out["disagg"]}), file=sys.stderr,
+          flush=True)
+
+    # report-gate headlines ({"metric","value"} stdout JSON lines)
+    sfx = "" if on_tpu else "_cpu_smoke"
+    print(json.dumps({"metric": f"serving_fleet_tokens_per_sec{sfx}",
+                      "value": out["fleet_tokens_per_sec"],
+                      "unit": "tokens/sec"}))
+    print(json.dumps({"metric": f"serving_fleet_scaling_efficiency{sfx}",
+                      "value": efficiency, "unit": "fraction"}))
+    print(json.dumps({"metric": f"serving_router_affinity_hit_rate{sfx}",
+                      "value": out["affinity_hit_rate"],
+                      "unit": "fraction"}))
+    return out
+
+
 def bench_ckpt():
     """Checkpoint subsystem bench (--ckpt): save/restore GB/s through the
     ``CheckpointManager`` and the step-loop STALL each save mode injects
@@ -1118,6 +1322,14 @@ REPORT_HIGHER_BETTER = {
     # --chaos goodput ledger headline — restart/rollback badput must
     # not silently grow
     "job_goodput_fraction",
+    # multi-replica fleet serving (ISSUE 17): bench.py --serve
+    # --replicas N — aggregate fleet decode rate, its ratio over N
+    # single-replica runs at the same per-replica offered load, and
+    # the cache-aware router's sketch-match placement rate on
+    # shared-prefix traffic
+    "serving_fleet_tokens_per_sec",
+    "serving_fleet_scaling_efficiency",
+    "serving_router_affinity_hit_rate",
     # block-granular prefix cache on shared-prefix traffic (ISSUE 15):
     # fraction of admissions that reused cached KV blocks, and the
     # cache-on/cache-off effective-throughput ratio on the same trace
@@ -1718,10 +1930,17 @@ def main():
         return
 
     if "--serve" in sys.argv:
-        serve = bench_serve()
-        print(json.dumps({"serve": serve}))
-        if metrics_out:
-            emit_metrics({"serve": serve}, metrics_out)
+        if "--replicas" in sys.argv:
+            n = int(sys.argv[sys.argv.index("--replicas") + 1])
+            fleet = bench_fleet(n)
+            print(json.dumps({"fleet": fleet}))
+            if metrics_out:
+                emit_metrics({"fleet": fleet}, metrics_out)
+        else:
+            serve = bench_serve()
+            print(json.dumps({"serve": serve}))
+            if metrics_out:
+                emit_metrics({"serve": serve}, metrics_out)
         return
 
     if "--ckpt" in sys.argv:

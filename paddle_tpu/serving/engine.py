@@ -97,7 +97,7 @@ def _build_serving_metrics(reg) -> dict:
         "rejections": reg.counter(
             "serving_rejections_total",
             "requests shed by graceful degradation, by reason "
-            "(queue_full / deadline)"),
+            "(queue_full / deadline / fleet_saturated)"),
         # request-ledger headline numbers (ISSUE 16): scrapeable
         # without /statusz
         "in_flight": reg.gauge(
@@ -1141,5 +1141,60 @@ class ServingEngine:
         if pc is not None:
             s = pc.stats()
             s["hit_rate"] = round(s["hits"] / max(s["lookups"], 1), 4)
+            # the fleet router's affinity signal: truncated digests of
+            # every registered block (docs/SERVING.md#serving-fleet)
+            s["sketch"] = pc.sketch()
             out["prefix_cache"] = s
         return out
+
+    # -- cross-replica KV handoff (fleet disaggregation) -------------------
+    def export_kv_blocks(self, digests: Sequence[bytes]) -> List[tuple]:
+        """Host-stage the KV contents of the registered blocks behind
+        ``digests`` (the chain hashes of a prefilled prompt's full
+        blocks, in chain order). Each exported block's reference is
+        claimed through ``reuse_cached`` for the duration of the copy —
+        an eviction can't tear a row mid-export — and dropped before
+        returning. Stops at the first miss (a chained digest after a
+        miss could never be admitted anyway). Returns ``[(digest, k, v),
+        ...]`` records for :meth:`import_kv_blocks` on a peer replica."""
+        pc = self.cache.prefix_cache
+        if pc is None:
+            return []
+        out: List[tuple] = []
+        for d in digests:
+            b = pc.lookup(d)
+            if b is None or not self.cache.allocator.reuse_cached(b):
+                break
+            try:
+                k, v = self.cache.export_block(b)
+            finally:
+                self.cache.allocator.free([b])
+            out.append((d, k, v))
+        return out
+
+    def import_kv_blocks(self, records: Sequence[tuple]) -> int:
+        """Adopt host-staged KV blocks from a peer replica: allocate a
+        physical block per record, write the rows, register the chain
+        digest in the prefix index, and park the block reclaimable — the
+        next admission sharing the prefix claims it like any local
+        cache hit (tail-only prefill). Already-known digests are
+        skipped (first writer wins, same as ``register``); a full pool
+        stops the import early. Returns the number of blocks adopted."""
+        pc = self.cache.prefix_cache
+        if pc is None:
+            return 0
+        n = 0
+        with self._lock:
+            for d, k, v in records:
+                if pc.lookup(d) is not None:
+                    n += 1  # prefix already resident here
+                    continue
+                try:
+                    (b,) = self.cache.allocator.allocate(1)
+                except MemoryError:
+                    break
+                self.cache.import_block(b, k, v)
+                pc.register(d, b)
+                self.cache.allocator.free([b])  # parks reclaimable
+                n += 1
+        return n

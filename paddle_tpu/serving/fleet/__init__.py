@@ -1,0 +1,27 @@
+"""paddle_tpu.serving.fleet — cache-aware multi-replica serving.
+
+The fleet subsystem (docs/SERVING.md#serving-fleet) above single-engine
+serving: N :class:`~paddle_tpu.serving.ServingEngine` replicas behind
+one router front-end.
+
+- **replica** — :class:`Replica`: one engine plus the router's view of
+  it (role tag, liveness, ``health()`` snapshot); ``build_fleet`` spins
+  up N replicas from one model factory via ``warm_start_from=``.
+- **router** — :class:`FleetRouter`: cache-aware placement by chain-hash
+  prefix sketch, least-loaded fallback, dead-replica failover with
+  tail-only recompute through the prefix cache, disaggregated
+  prefill/decode with host-staged KV block handoff; engine-interface
+  compatible (``submit/stats/abort/start/shutdown``).
+- **server** — :class:`RouterServer`: the stdlib HTTP front-end over
+  the router (``/generate``, ``/fleetz``, ``/statusz``), shedding with
+  ``serving_rejections_total{reason="fleet_saturated"}`` when every
+  live replica is at queue depth.
+"""
+from . import replica, router, server  # noqa: F401
+from .replica import Replica, build_fleet  # noqa: F401
+from .router import FleetRouter, RouteHandle, router_metrics  # noqa: F401
+from .server import RouterHandler, RouterServer  # noqa: F401
+
+__all__ = ["Replica", "build_fleet", "FleetRouter", "RouteHandle",
+           "router_metrics", "RouterServer", "RouterHandler",
+           "replica", "router", "server"]

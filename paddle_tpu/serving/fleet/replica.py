@@ -1,0 +1,95 @@
+"""Replica wrapper for the serving fleet (docs/SERVING.md#serving-fleet).
+
+A :class:`Replica` is one :class:`~paddle_tpu.serving.ServingEngine`
+plus the router's view of it: a role tag (``prefill`` / ``decode`` /
+``mixed``), a liveness bit, and a ``health()`` snapshot built from the
+engine's lock-free ``stats()`` — the same fields ``/healthz`` and
+``/statusz`` expose, so the router's scheduler view and an operator's
+probe view can never disagree.
+
+``build_fleet`` spins up N engine replicas from one model factory via
+the existing ``warm_start_from=`` seam — every replica compiles the
+same unified step against the same weights, which is what makes them
+interchangeable failover targets.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["Replica", "build_fleet", "ROLES"]
+
+ROLES = ("prefill", "decode", "mixed")
+
+
+class Replica:
+    """One engine in the fleet, as the router sees it."""
+
+    def __init__(self, engine, name: str, role: str = "mixed"):
+        if role not in ROLES:
+            raise ValueError(f"role {role!r} (want one of {ROLES})")
+        self.engine = engine
+        self.name = name
+        self.role = role
+        self.alive = True
+
+    def __repr__(self):
+        state = "live" if self.alive else "dead"
+        return f"Replica({self.name!r}, role={self.role!r}, {state})"
+
+    def kill(self):
+        """Stub-kill (the in-process stand-in for a SIGKILLed replica
+        process): mark dead, then hard-stop the engine — in-flight
+        requests fail exactly as they would when a real process
+        vanished mid-stream, which is what drives the router's
+        failover path. Idempotent."""
+        if not self.alive:
+            return
+        self.alive = False
+        try:
+            self.engine.shutdown(drain=False)
+        except Exception:
+            pass  # a dying engine can't make the kill fail
+
+    def health(self) -> dict:
+        """Liveness + capacity snapshot: the router's placement input.
+        A replica whose ``stats()`` raises is treated as dead — the
+        fleet analogue of a probe timeout."""
+        base = {"name": self.name, "role": self.role}
+        if not self.alive:
+            return {**base, "alive": False}
+        try:
+            stats = self.engine.stats()
+        except Exception:
+            self.alive = False
+            return {**base, "alive": False}
+        return {**base, "alive": True, **stats}
+
+
+def build_fleet(model_fn: Callable, n: Optional[int] = None,
+                roles: Optional[Sequence[str]] = None,
+                warm_start_from: Optional[str] = None,
+                name_prefix: str = "replica",
+                **engine_kw) -> List[Replica]:
+    """N identical engine replicas from one model factory.
+
+    ``model_fn()`` must return a fresh model instance per call (each
+    replica owns its functional state and KV pools); ``warm_start_from=``
+    threads straight into every :class:`ServingEngine`, so the whole
+    fleet serves one checkpoint. ``n`` defaults to
+    ``PADDLE_TPU_FLEET_REPLICAS`` (2 when unset); ``roles`` shorter
+    than ``n`` pads with ``mixed``.
+    """
+    from paddle_tpu.serving.engine import ServingEngine
+
+    if n is None:
+        n = int(os.environ.get("PADDLE_TPU_FLEET_REPLICAS", "2"))
+    if n < 1:
+        raise ValueError("a fleet needs at least one replica")
+    roles = list(roles or [])
+    roles += ["mixed"] * (n - len(roles))
+    return [
+        Replica(ServingEngine(model_fn(), warm_start_from=warm_start_from,
+                              **engine_kw),
+                f"{name_prefix}{i}", role=roles[i])
+        for i in range(n)]

@@ -325,6 +325,23 @@ class PrefixCache:
             "entries": len(self._index),
         }
 
+    #: bytes of each digest kept in the router-facing sketch — 8 bytes
+    #: (64 bits) keeps accidental cross-replica collisions negligible at
+    #: any realistic index size while shrinking the wire payload 2x
+    SKETCH_PREFIX_BYTES = 8
+
+    def sketch(self, limit: int = 4096) -> List[str]:
+        """Compact content summary of the index for the fleet router:
+        the hex-truncated digest of every registered block (chain hashes
+        commit to their whole prefix, so digest-set intersection IS
+        prefix overlap). Capped at ``limit`` entries — a partial sketch
+        only costs affinity accuracy, never correctness, because the
+        router treats it as a routing hint and admission re-walks the
+        real index."""
+        n = self.SKETCH_PREFIX_BYTES
+        keys = list(self._index.keys())[:limit]
+        return [d[:n].hex() for d in keys]
+
 
 class PagedKVCache:
     """Per-layer block pools + the allocator + table-shaping helpers."""
@@ -390,6 +407,38 @@ class PagedKVCache:
             self._copy_fn = jax.jit(_copy, donate_argnums=donate)
         self.k_pools, self.v_pools = self._copy_fn(
             self.k_pools, self.v_pools, jnp.int32(src), jnp.int32(dst))
+
+    # -- cross-replica block transfer (fleet disaggregation) ---------------
+    def export_block(self, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-stage one physical block's KV rows across every layer:
+        returns ``(k, v)`` numpy arrays of shape ``[num_layers,
+        block_size, n_kv, hd]``. Device->host copy only — the caller
+        must hold a reference on ``block_id`` for the duration (the
+        fleet handoff claims one via ``reuse_cached`` before calling)."""
+        k = np.stack([np.asarray(p[block_id]) for p in self.k_pools])
+        v = np.stack([np.asarray(p[block_id]) for p in self.v_pools])
+        return k, v
+
+    def import_block(self, block_id: int, k: np.ndarray, v: np.ndarray):
+        """Write host-staged KV rows into physical ``block_id`` on this
+        replica (the inverse of :meth:`export_block`). One jitted
+        row-set program for the cache's lifetime — destination id and
+        rows are traced, so repeated handoffs reuse the executable.
+        The caller owns ``block_id`` (freshly allocated) and registers
+        it with the prefix index afterwards."""
+        import jax
+
+        if getattr(self, "_import_fn", None) is None:
+            def _imp(kps, vps, kr, vr, d):
+                return (tuple(p.at[d].set(kr[i])
+                              for i, p in enumerate(kps)),
+                        tuple(p.at[d].set(vr[i])
+                              for i, p in enumerate(vps)))
+            self._import_fn = jax.jit(_imp)
+        dt = self.k_pools[0].dtype
+        self.k_pools, self.v_pools = self._import_fn(
+            self.k_pools, self.v_pools, jnp.asarray(k, dt),
+            jnp.asarray(v, dt), jnp.int32(block_id))
 
     def pad_block_table(self, block_ids: Sequence[int]) -> np.ndarray:
         """[max_blocks_per_seq] int32 row, null-padded."""

@@ -275,6 +275,13 @@ _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           "serving_shared_prefix_speedup",
                           "serving_cached_p99_ttft_seconds",
                           "serving_cold_p99_ttft_seconds",
+                          # bench.py --serve --replicas N fleet
+                          # report-gate headlines (ISSUE 17,
+                          # docs/SERVING.md#serving-fleet) — stdout
+                          # {"metric","value"} lines, not registry
+                          # families
+                          "serving_fleet_tokens_per_sec",
+                          "serving_fleet_scaling_efficiency",
                           # commplan geometry label (ISSUE 15,
                           # docs/SERVING.md), not a metric family
                           "serving_mp2",
@@ -360,6 +367,7 @@ def _registered_families():
         nonfinite_counter, preemption_counter, rollback_counter,
         watchdog_metrics)
     from paddle_tpu.serving.engine import serving_metrics
+    from paddle_tpu.serving.fleet.router import router_metrics
 
     StepTimer(peak=0)
     ckpt_metrics()
@@ -371,6 +379,7 @@ def _registered_families():
     memory_metrics()
     numerics_metrics()
     serving_metrics()
+    router_metrics()
     request_metrics()
     slo_metrics()
     nonfinite_counter(), rollback_counter(), preemption_counter()
