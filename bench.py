@@ -1169,6 +1169,10 @@ def bench_chaos():
             # steps re-run because the kill outran the async commit
             out["steps_lost"] = last_before["gs"] + 1 - first_after["gs"]
         out.update(_chaos_goodput(run_dir))
+        # the elastic counterpart: same class of event (2 of 8 hosts
+        # lost), handled as an in-place resize instead of the
+        # kill→checkpoint→relaunch above — MTTRs land side by side
+        out["resize_drill"] = bench_resize_drill(out.get("mttr_s"))
     finally:
         shutil.rmtree(run_dir, ignore_errors=True)
     if "job_goodput_fraction" in out:
@@ -1218,6 +1222,199 @@ def _chaos_goodput(run_dir: str) -> dict:
             bins.get("productive", 0.0) / binned, 4)
     if measured > 0:
         out["goodput_wall_coverage"] = round(binned / measured, 4)
+    return out
+
+
+def bench_resize_drill(relaunch_mttr_s=None):
+    """Elastic resize drill (rides ``--chaos``): 8 simulated hosts in ONE
+    process lose 2 mid-epoch and continue on 6 — the live-resharding
+    path (resilience.elastic) end to end, with the acceptance checks
+    inline: the consensus boundary lands on the same step for every
+    lane, the in-memory shard exchange reassembles model+opt
+    bit-identically (same offset math as the checkpoint-file reshard),
+    the remapped data order stays exactly-once (token-multiset digest
+    over pre+post batches equals one full epoch), zero filesystem writes
+    happen on the resize path, and the in-place MTTR comes in far under
+    the kill→checkpoint→relaunch MTTR measured by the main chaos run
+    (passed in as ``relaunch_mttr_s``). Badput lands in the ``reshard``
+    goodput bin — ``restart`` stays at 0."""
+    import builtins
+    from collections import Counter
+
+    from paddle_tpu.checkpoint.layout import flatten_state
+    from paddle_tpu.data.pipeline import DataPipeline
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.observability.goodput import GoodputLedger
+    from paddle_tpu.resilience import elastic
+    from paddle_tpu.resilience.elastic import ElasticResizeListener
+
+    OLD, NEW = 8, 6
+    rng = np.random.RandomState(7)
+    # 240 docs = lcm(8, 6) * 10: both worlds cover every doc exactly once
+    docs = [rng.randint(1, 1000, size=rng.randint(5, 48)).astype(np.int32)
+            for _ in range(240)]
+
+    class Docs:
+        def __len__(self):
+            return len(docs)
+
+        def __getitem__(self, i):
+            return docs[i]
+
+    def pipes(n):
+        return [DataPipeline(Docs(), batch_size=2, seq_len=32, pack=True,
+                             base_seed=11, shuffle=True, shard_index=k,
+                             num_shards=n, drop_last=False)
+                for k in range(n)]
+
+    def toks(batch):
+        ids, m = batch["input_ids"], batch["attention_mask"]
+        return ids[m > 0].tolist()
+
+    want = Counter()
+    for d in docs:
+        want.update(d.tolist())
+
+    # the replicated model+opt every host holds after allreduce; the
+    # deterministic "train step" makes post-resize state divergence
+    # detectable through the weights themselves
+    state = {"model": {"w": rng.randn(64, 64).astype(np.float32),
+                       "b": rng.randn(64).astype(np.float32)},
+             "opt": {"m": np.zeros((64, 64), np.float32),
+                     "step": np.int64(0)}}
+
+    def train_step(st, n_tok):
+        st["model"]["w"] *= np.float32(1.0 - 1e-4)
+        st["opt"]["m"] += np.float32(n_tok)
+        st["opt"]["step"] = st["opt"]["step"] + 1
+
+    ledger = GoodputLedger()
+    store = TCPStore(is_master=True, world_size=1)
+    listeners = [ElasticResizeListener(store=store) for _ in range(OLD)]
+    have = Counter()
+    old = pipes(OLD)
+    iters = [iter(p) for p in old]
+    kill_at, gs, boundary, t_kill = 3, 0, None, None
+    while boundary is None:
+        t0 = time.perf_counter()
+        batches = [next(it) for it in iters]
+        for b in batches:
+            have.update(toks(b))
+        train_step(state, sum(int(b["attention_mask"].sum())
+                              for b in batches))
+        gs += 1
+        ledger.record("productive", time.perf_counter() - t0)
+        if gs == kill_at:
+            # 2 of 8 hosts are going away: the doomed host's preemption
+            # notice arrives through the elastic seam on ONE lane; the
+            # consensus protocol spreads it to all
+            t_kill = time.perf_counter()
+            listeners[6].request(NEW, "preempt_2_hosts")
+        decided = [ln.should_resize(step=gs) for ln in listeners]
+        if all(decided):
+            boundary = gs
+        else:
+            assert not any(decided), "consensus boundary diverged"
+    agreed = {ln.target_world for ln in listeners}
+    assert agreed == {NEW}, f"target world diverged: {agreed}"
+
+    # --- the resize itself: all 8 publish, 6 assemble — NO filesystem ---
+    writes = []
+    _open = builtins.open
+
+    def spy(f, mode="r", *a, **k):
+        if any(c in str(mode) for c in "wxa+"):
+            writes.append(str(f))
+        return _open(f, mode, *a, **k)
+
+    import threading
+    clients = [TCPStore(host="127.0.0.1", port=store.port,
+                        is_master=False, world_size=1)
+               for _ in range(OLD)]
+    results = [None] * OLD
+
+    def one_rank(r):
+        results[r] = elastic.perform_resize(
+            clients[r], state=state, data_state=old[r].state_dict(),
+            world=OLD, rank=r, new_world=NEW, generation=0,
+            boundary_step=boundary, timeout=120)
+
+    t0 = time.perf_counter()
+    builtins.open = spy
+    try:
+        # one thread per simulated host — the same concurrent publish →
+        # barrier → assemble dance real ranks run
+        ths = [threading.Thread(target=one_rank, args=(r,), daemon=True)
+               for r in range(OLD)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(timeout=180)
+    finally:
+        builtins.open = _open
+    assert all(s is None and d is None for s, d in results[NEW:]), \
+        "departing ranks must not assemble"
+    new_states = [s for s, _ in results[:NEW]]
+    new_datas = [d for _, d in results[:NEW]]
+
+    _, f0 = flatten_state(state)
+    bit_identical = True
+    for ns in new_states:
+        _, f1 = flatten_state(ns)
+        bit_identical &= f0.keys() == f1.keys() and all(
+            f0[k][0].tobytes() == f1[k][0].tobytes() for k in f0)
+
+    new = pipes(NEW)
+    for j, p in enumerate(new):
+        p.load_state_dict(new_datas[j])
+    t_ready = time.perf_counter()
+    resize_s = t_ready - t0
+    # MTTR: preemption notice → consensus boundary → in-place reshard →
+    # ready to train on the new world
+    mttr_s = t_ready - t_kill
+    ledger.record("reshard", resize_s)
+
+    # --- continue on 6: drive the epoch to completion on the survivors
+    post_steps = 0
+    iters = [iter(p) for p in new]
+    live = list(range(NEW))
+    while live:
+        t0 = time.perf_counter()
+        done = []
+        for j in live:
+            try:
+                b = next(iters[j])
+            except StopIteration:
+                done.append(j)
+                continue
+            have.update(toks(b))
+        if len(done) < len(live):
+            train_step(new_states[0], 1)
+            post_steps += 1
+            ledger.record("productive", time.perf_counter() - t0)
+        live = [j for j in live if j not in done]
+    snap = ledger.snapshot()
+    b = snap["bins"]
+    binned = b["productive"] + b["reshard"] + b["restart"]
+    out = {"old_world": OLD, "new_world": NEW,
+           "boundary_step": boundary, "post_steps": post_steps,
+           "resize_s": round(resize_s, 4),
+           "resize_mttr_s": round(mttr_s, 4),
+           "state_bit_identical": bool(bit_identical),
+           "exactly_once": have == want,
+           "filesystem_writes_on_resize_path": len(writes),
+           "goodput_restart_s": b["restart"],
+           "goodput_reshard_s": b["reshard"],
+           # productive share of (train + downtime) — the apples-to-
+           # apples counterpart of the relaunch run's fraction, where
+           # the same membership change bins seconds of restart badput
+           "job_goodput_fraction": round(
+               b["productive"] / binned, 4) if binned > 0 else None}
+    if relaunch_mttr_s:
+        out["relaunch_mttr_s"] = relaunch_mttr_s
+        if mttr_s > 0:
+            out["resize_vs_relaunch_speedup"] = round(
+                float(relaunch_mttr_s) / mttr_s, 1)
     return out
 
 

@@ -38,7 +38,7 @@ import numpy as np
 from paddle_tpu.io.dataloader import default_collate_fn
 
 from .metrics import data_metrics
-from .packing import SequencePacker
+from .packing import IGNORE_LABEL, SequencePacker
 from .stream import ShardedStream
 
 __all__ = ["DataPipeline"]
@@ -88,6 +88,11 @@ class DataPipeline:
         # the checkpoint state or a kill between them loses the later
         # ones (they exist nowhere else)
         self._pending: list = []
+        # set by a mid-epoch elastic reshard: a new shard may start the
+        # epoch with cursor 0 yet hold pendings/carry that belong to the
+        # CURRENT (in-flight) epoch, not a finished epoch's tail — the
+        # cursor==0 tail inference below must not early-return the epoch
+        self._mid_epoch_reshard = False
         self._committed = self._capture()
 
     # -- state -----------------------------------------------------------------
@@ -105,6 +110,8 @@ class DataPipeline:
                          (self.pack and not self.drop_last and
                           self.packer.has_carry))
         if cur == 0:
+            if self._mid_epoch_reshard:
+                return e  # pendings/carry belong to the CURRENT epoch
             return e - 1 if tail_owed else e
         try:
             n = self.stream.samples_per_epoch()
@@ -125,6 +132,8 @@ class DataPipeline:
                 state["pending"] = [
                     {k: v.copy() for k, v in b.items()}
                     for b in self._pending]
+        if self._mid_epoch_reshard and self.stream.cursor == 0:
+            state["mid_epoch"] = True
         return state
 
     def state_dict(self) -> dict:
@@ -161,6 +170,7 @@ class DataPipeline:
         self._pending = [
             {k: np.asarray(v) for k, v in b.items()}
             for b in state.get("pending", [])]
+        self._mid_epoch_reshard = bool(state.get("mid_epoch", False))
         self._step = int(state["step"])
         self._committed = self._capture()
 
@@ -191,6 +201,93 @@ class DataPipeline:
             return n // self.batch_size
         return -(-n // self.batch_size)
 
+    # -- elastic reshard -------------------------------------------------------
+    @staticmethod
+    def reshard_state(states, new_num_shards: int, *, pad_id: int = 0,
+                      ignore_label: int = IGNORE_LABEL):
+        """Remap a complete set of per-shard pipeline states onto
+        ``new_num_shards`` — the :meth:`ShardedStream.reshard_state`
+        order remap plus the packing layer's carry: old shards' pending
+        batches are redistributed round-robin, and every open packer bin
+        is refolded through fresh per-shard packers (spilled batches join
+        that shard's pendings), so not a token is dropped or duplicated
+        across the membership change. ``pad_id``/``ignore_label`` must
+        match the live pipelines' packer (they are not part of the
+        carry state). Returns ``new_num_shards`` state dicts.
+        """
+        M = int(new_num_shards)
+        if not states:
+            raise ValueError("reshard_state needs every old shard's state")
+        states = sorted((dict(s) for s in states),
+                        key=lambda s: int(s["stream"]["shard_index"]))
+        for s in states:
+            if int(s.get("version", 0)) != STATE_VERSION:
+                raise ValueError(
+                    f"unsupported pipeline state version "
+                    f"{s.get('version')!r} (this build writes "
+                    f"{STATE_VERSION})")
+        drop_last = bool(states[0]["drop_last"])
+        pack = "packer" in states[0]
+        if any(bool(s["drop_last"]) != drop_last or
+               ("packer" in s) != pack for s in states):
+            raise ValueError(
+                "old shard states disagree on drop_last/pack — they do "
+                "not come from one coherent pipeline family")
+
+        new_streams = ShardedStream.reshard_state(
+            [s["stream"] for s in states], M)
+        mid_epoch = any(st["cursor"] > 0 or st.get("consumed_ahead")
+                        for st in new_streams)
+        step = max(int(s["step"]) for s in states)
+
+        pendings: list = [[] for _ in range(M)]
+        for i, b in enumerate(b for s in states
+                              for b in s.get("pending", [])):
+            pendings[i % M].append(
+                {k: np.asarray(v) for k, v in b.items()})
+
+        packers = None
+        if pack:
+            seq_len = int(states[0]["packer"]["seq_len"])
+            bsz = int(states[0]["packer"]["batch_size"])
+            if any(int(s["packer"]["seq_len"]) != seq_len or
+                   int(s["packer"]["batch_size"]) != bsz for s in states):
+                raise ValueError(
+                    "old shard states disagree on packer geometry")
+            packers = [SequencePacker(seq_len, bsz, pad_id=pad_id,
+                                      ignore_label=ignore_label)
+                       for _ in range(M)]
+            # refold every open bin (shard order, bin order) through the
+            # new shards' packers; a refold that overflows a new packer
+            # flushes a full batch straight into that shard's pendings
+            open_bins = [docs for s in states
+                         for docs in s["packer"]["bins"] if len(docs)]
+            for b_idx, docs in enumerate(open_bins):
+                j = b_idx % M
+                for chunk in docs:
+                    pendings[j].extend(packers[j].add(chunk))
+
+        out = []
+        for j in range(M):
+            st = {"version": STATE_VERSION, "step": step,
+                  "drop_last": drop_last, "stream": new_streams[j]}
+            e, cur = int(new_streams[j]["epoch"]), \
+                int(new_streams[j]["cursor"])
+            tail_owed = bool(pendings[j] or
+                             (pack and not drop_last and
+                              packers[j].has_carry))
+            if cur == 0 and not mid_epoch and tail_owed:
+                e -= 1
+            st["epoch"] = e
+            if pack:
+                st["packer"] = packers[j].state_dict()
+                if pendings[j]:
+                    st["pending"] = pendings[j]
+                if mid_epoch:
+                    st["mid_epoch"] = True
+            out.append(st)
+        return out
+
     # -- production ------------------------------------------------------------
     def _pairs_for_epoch(self) -> Iterator[tuple]:
         """(post_batch_state, batch) pairs for the remainder of the
@@ -208,10 +305,12 @@ class DataPipeline:
             # still-unflushed carry — complete that epoch, so this
             # __iter__ ends after them instead of bleeding them into the
             # next epoch's samples.
-            if self._pending or (self.stream.cursor == 0 and
+            at_tail = self.stream.cursor == 0 and \
+                not self._mid_epoch_reshard
+            if self._pending or (at_tail and
                                  not self.drop_last and
                                  self.packer.has_carry):
-                tail_of_epoch = self.stream.cursor == 0
+                tail_of_epoch = at_tail
                 while self._pending:
                     yield self._pair(self._pending.pop(0))
                 if tail_of_epoch:
@@ -229,6 +328,7 @@ class DataPipeline:
                 self._pending = self.packer.add(doc)
                 while self._pending:
                     yield self._pair(self._pending.pop(0))
+            self._mid_epoch_reshard = False  # epoch completed
             if not self.drop_last:
                 # epoch boundary: flush the carry so every token of the
                 # epoch is trained on; drop_last=True keeps the carry

@@ -81,6 +81,11 @@ class ShardedStream:
                 "the dataset with its own seeded rng if needed)")
         self.epoch = 0
         self.cursor = 0  # samples already yielded of the CURRENT epoch
+        # order-positions of THIS shard's current epoch already consumed
+        # BEYOND the cursor prefix — only ever non-empty right after an
+        # elastic reshard (old shards' cursors interleave unevenly under
+        # the new stride); __iter__ skips them without yielding
+        self.consumed_ahead: set = set()
         self._m = data_metrics(registry)
         self._budget: Optional[_BadSampleBudget] = None
         if max_bad_samples is None:
@@ -130,6 +135,11 @@ class ShardedStream:
         order = self.epoch_order(self.epoch)
         ds, budget = self.dataset, self._budget
         while self.cursor < len(order):
+            if self.cursor in self.consumed_ahead:
+                # already delivered pre-reshard by a departed peer shard
+                self.consumed_ahead.discard(self.cursor)
+                self.cursor += 1
+                continue
             i = int(order[self.cursor])
             # advance BEFORE the fetch: a checkpoint taken after this
             # sample lands downstream must not replay it
@@ -142,6 +152,7 @@ class ShardedStream:
                     yield s
         self.epoch += 1
         self.cursor = 0
+        self.consumed_ahead = set()
 
     def _iter_iterable(self):
         skip = self.cursor
@@ -187,6 +198,9 @@ class ShardedStream:
                  "drop_remainder": self.drop_remainder}
         if not self._iterable:
             state["dataset_len"] = len(self.dataset)
+        if self.consumed_ahead:
+            state["consumed_ahead"] = sorted(int(p)
+                                             for p in self.consumed_ahead)
         return state
 
     def load_state_dict(self, state: dict):
@@ -194,9 +208,11 @@ class ShardedStream:
             raise ValueError(
                 f"stream state was saved with num_shards="
                 f"{state['num_shards']}, this stream has "
-                f"{self.num_shards} — deterministic resume requires a "
-                "mesh-size-preserving restart (elastic reshard of the "
-                "DATA order is not defined; start a fresh epoch instead)")
+                f"{self.num_shards} — a membership change must remap the "
+                "data order first: gather ALL old shards' states and pass "
+                "them through ShardedStream.reshard_state(states, "
+                "new_num_shards), then load the remapped per-shard state "
+                "(paddle_tpu.resilience.elastic does this for you)")
         if int(state.get("shard_index", self.shard_index)) != \
                 self.shard_index:
             raise ValueError(
@@ -224,6 +240,8 @@ class ShardedStream:
                 "deterministic resume requires the same dataset")
         self.epoch = int(state["epoch"])
         self.cursor = int(state["cursor"])
+        self.consumed_ahead = set(
+            int(p) for p in state.get("consumed_ahead", ()))
         # a state captured with an epoch's FINAL batch has cursor at the
         # end of the order (rollover happens lazily on the next pull);
         # normalize so `epoch` always means "next epoch to iterate" and
@@ -231,3 +249,111 @@ class ShardedStream:
         if not self._iterable and self.cursor >= self.samples_per_epoch():
             self.epoch += 1
             self.cursor = 0
+            self.consumed_ahead = set()
+
+    # -- elastic reshard -------------------------------------------------------
+    @staticmethod
+    def reshard_state(states, new_num_shards: int):
+        """Remap a complete set of per-shard states onto a new world size.
+
+        ``states`` must hold every old shard's ``state_dict()`` (any
+        order, one per ``shard_index``). Returns ``new_num_shards`` state
+        dicts, index ``j`` for new shard ``j``, preserving the GLOBAL
+        sample order exactly-once: every epoch-order position any old
+        shard consumed is never yielded again, every unconsumed position
+        is yielded by exactly one new shard.
+
+        Works because old and new stride over the SAME epoch permutation
+        — truncation (``drop_remainder=True``) and wrap (False) only edit
+        the tail, so position ``p`` means the same sample under both
+        world sizes wherever both define it. Old per-shard prefixes
+        interleave unevenly under the new stride; the surplus lands in
+        ``consumed_ahead`` and the new shard skips those positions.
+        """
+        M = int(new_num_shards)
+        if M < 1:
+            raise ValueError(f"new_num_shards must be >= 1, got {M}")
+        if not states:
+            raise ValueError("reshard_state needs every old shard's state")
+        ref = dict(states[0])
+        N = int(ref["num_shards"])
+        for f in ("base_seed", "shuffle", "drop_remainder"):
+            if any(s.get(f) != ref.get(f) for s in states):
+                raise ValueError(
+                    f"old shard states disagree on {f!r} — they do not "
+                    "come from one coherent stream family")
+        if "dataset_len" not in ref:
+            raise ValueError(
+                "reshard_state needs map-style stream states (an "
+                "IterableDataset has no index space to remap)")
+        n = int(ref["dataset_len"])
+        if any(int(s["dataset_len"]) != n for s in states):
+            raise ValueError("old shard states disagree on dataset_len")
+        seen = sorted(int(s["shard_index"]) for s in states)
+        if seen != list(range(N)):
+            raise ValueError(
+                f"need exactly one state per old shard 0..{N - 1}, "
+                f"got shard indices {seen}")
+        by_idx = {int(s["shard_index"]): s for s in states}
+
+        def _epoch_len(world):
+            rem = n % world
+            if rem == 0:
+                return n
+            return (n - rem) if ref["drop_remainder"] else \
+                n + (world - rem)
+
+        L_old, L_new = _epoch_len(N), _epoch_len(M)
+        per_old = L_old // N
+
+        # normalize epoch rollover per shard (state_dict captures the raw
+        # cursor; a shard that just finished its epoch means epoch+1/0)
+        norm = {}
+        for k, s in by_idx.items():
+            e, c = int(s["epoch"]), int(s["cursor"])
+            ahead = set(int(p) for p in s.get("consumed_ahead", ()))
+            if c >= per_old:
+                e, c, ahead = e + 1, 0, set()
+            norm[k] = (e, c, ahead)
+        epochs = {e for e, _, _ in norm.values()}
+        if len(epochs) > 1:
+            raise ValueError(
+                f"old shard states sit in different epochs {sorted(epochs)}"
+                " — reshard at a consensus step boundary, where lockstep "
+                "shards agree on the epoch")
+        epoch = epochs.pop()
+
+        # the globally consumed epoch-order positions
+        consumed = set()
+        for k, (_, c, ahead) in norm.items():
+            for i in range(c):
+                consumed.add(k + i * N)
+            for i in ahead:
+                consumed.add(k + i * N)
+        if consumed and max(consumed) >= L_new:
+            raise ValueError(
+                f"old world consumed epoch-order position {max(consumed)} "
+                f"but the {M}-shard epoch only covers positions 0.."
+                f"{L_new - 1} — this boundary sits inside the old world's "
+                "remainder tail and cannot be represented exactly-once at "
+                f"the new size; finish the epoch at {N} shards (or "
+                "reshard one step earlier) instead")
+
+        out = []
+        for j in range(M):
+            npos = (L_new - j + M - 1) // M  # positions j, j+M, ... < L_new
+            cur = 0
+            while cur < npos and (j + cur * M) in consumed:
+                cur += 1
+            ahead = sorted(i for i in range(cur + 1, npos)
+                           if (j + i * M) in consumed)
+            st = {"epoch": epoch, "cursor": cur,
+                  "base_seed": int(ref["base_seed"]),
+                  "num_shards": M, "shard_index": j,
+                  "shuffle": bool(ref["shuffle"]),
+                  "drop_remainder": bool(ref["drop_remainder"]),
+                  "dataset_len": n}
+            if ahead:
+                st["consumed_ahead"] = ahead
+            out.append(st)
+        return out

@@ -33,14 +33,45 @@ __all__ = ["launch", "ElasticManager", "main"]
 #: consuming the ``max_restarts`` crash budget.
 from paddle_tpu.resilience.preemption import (  # noqa: E402
     RESUMABLE_EXIT_CODE, preempt_stop_key)
+#: Trainers exiting with this code left at a consensus RESIZE boundary
+#: (resilience.elastic): the surviving ranks carry the full state in
+#: memory and keep training — a membership change, never a crash.
+from paddle_tpu.resilience.elastic import (  # noqa: E402
+    RESIZE_EXIT_CODE, elastic_prefix)
 
 _RESUME_GRACE = 60.0   # wait this long for peers' coordinated final saves
+_RESIZE_GRACE = 5.0    # window to tell an in-place resize (survivors keep
+                       # running) from a coordinated resize-relaunch (all
+                       # ranks exit 83 together)
 
 
 def _max_resumes(value: Optional[int]) -> int:
     if value is not None:
         return int(value)
     return int(os.environ.get("PADDLE_TPU_MAX_RESUMES", "8"))
+
+
+def _max_resizes() -> int:
+    return int(os.environ.get("PADDLE_TPU_MAX_RESIZES", "8"))
+
+
+def _resize_target_world(store, epoch) -> Optional[int]:
+    """The consensus resize verdict's agreed world size, if one was
+    published for this restart epoch (``__elastic/{epoch}/g{gen}/stop``
+    holds ``stop_at:new_world:reason``; survivors bump ``gen`` after an
+    in-place resize, so check the current and previous generation)."""
+    try:
+        raw = store.get(f"__elastic/{epoch}/gen")
+        gen = int(raw) if raw else 0
+        for g in (gen, gen - 1):
+            if g < 0:
+                continue
+            v = store.get(f"{elastic_prefix(g, str(epoch))}/stop")
+            if v:
+                return int(v.decode(errors="replace").split(":")[1])
+    except Exception:
+        pass
+    return None
 
 
 class ElasticManager:
@@ -207,6 +238,10 @@ def launch(script: str, script_args: Optional[List[str]] = None,
     attempts = 0  # local relaunch budget (epoch can over-bump on races)
     resumes = 0   # preemption relaunch budget (separate from crashes)
     resume_budget = _max_resumes(max_resumes)
+    resizes = 0   # consensus resize count (separate from both budgets)
+    resize_budget = _max_resizes()
+    resize_relaunch = False  # next relaunch gap bins `reshard`, not
+                             # `restart` (planned membership change)
     cur_np = nproc_per_node  # this epoch's local trainer count (elastic)
     scale_seen = int(store.add("__scale_out", 0))
     down_at = None  # when the previous attempt's trainers were all dead
@@ -228,9 +263,12 @@ def launch(script: str, script_args: Optional[List[str]] = None,
             })
             if down_at is not None:
                 # relaunch: stamp the previous incarnation's death time
-                # so the child's GoodputLedger bins the crash→resume gap
-                # as restart badput (docs/OBSERVABILITY.md#goodput)
-                env["PADDLE_TPU_GOODPUT_DOWN_AT"] = repr(down_at)
+                # so the child's GoodputLedger bins the gap — `reshard`
+                # after a planned membership change (scale/resize),
+                # `restart` badput otherwise
+                # (docs/OBSERVABILITY.md#goodput)
+                env["PADDLE_TPU_GOODPUT_RESIZE_AT" if resize_relaunch
+                    else "PADDLE_TPU_GOODPUT_DOWN_AT"] = repr(down_at)
             if log_dir:
                 os.makedirs(log_dir, exist_ok=True)
                 lf = open(os.path.join(log_dir, f"worker.{rank}.log"), "w")
@@ -247,8 +285,45 @@ def launch(script: str, script_args: Optional[List[str]] = None,
         fail_code = None
         scale_event = None  # "in" | "out"
         resume_event = False
+        resize_event = False
+        resize_relaunch = False  # consumed by the spawn above
         while True:
             codes = [p.poll() for p in procs]
+            if any(c == RESIZE_EXIT_CODE for c in codes) and \
+                    all(c in (None, 0, RESIZE_EXIT_CODE) for c in codes):
+                # consensus resize boundary (resilience.elastic): ranks
+                # exiting 83 DEPARTED at an agreed step — a membership
+                # change, never a crash. Distinguish the two flavors
+                # within a short window: survivors still RUNNING means an
+                # in-place resize (they hold the full state — just retire
+                # the departed lanes and keep supervising); everyone
+                # exiting 0/83 means a coordinated resize-relaunch at the
+                # agreed world size.
+                deadline = time.monotonic() + _RESIZE_GRACE
+                while any(p.poll() is None for p in procs) and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.1)
+                codes = [p.poll() for p in procs]
+                if any(c is None for c in codes):
+                    keep_p, keep_l = [], []
+                    for i, p in enumerate(procs):
+                        if p.poll() == RESIZE_EXIT_CODE:
+                            if logs:
+                                logs[i].close()
+                        else:
+                            keep_p.append(p)
+                            if logs:
+                                keep_l.append(logs[i])
+                    procs, logs = keep_p, keep_l
+                    cur_np = len(procs)
+                    resizes += 1
+                    continue
+                if all(c in (0, RESIZE_EXIT_CODE) for c in codes):
+                    resize_event = True
+                    if int(store.add("__restart_epoch", 0)) == epoch:
+                        store.add("__restart_epoch", 1)
+                    break
+                # else: a real crash raced the boundary — fall through
             if any(c not in (None, 0) for c in codes):
                 nonzero = [c for c in codes if c not in (None, 0)]
                 if all(c == RESUMABLE_EXIT_CODE for c in nonzero):
@@ -302,6 +377,7 @@ def launch(script: str, script_args: Optional[List[str]] = None,
             time.sleep(0.2)
 
         if fail_code is None and scale_event is None and not resume_event \
+                and not resize_event \
                 and int(store.add("__restart_epoch", 0)) > epoch:
             # a PEER bumped the epoch before our own trainers' exit codes
             # were read. If this epoch carries a preemption verdict (the
@@ -350,6 +426,28 @@ def launch(script: str, script_args: Optional[List[str]] = None,
             cur_np = len(procs)
 
         new_epoch = int(store.add("__restart_epoch", 0))
+        if resize_event:
+            # coordinated resize-relaunch (resilience.elastic): every
+            # rank left at the agreed boundary — relaunch at the agreed
+            # world size, stamping the gap into the goodput `reshard` bin
+            # (PADDLE_TPU_GOODPUT_RESIZE_AT) and spending only the
+            # PADDLE_TPU_MAX_RESIZES budget, never max_restarts/resumes
+            resizes += 1
+            if resizes > resize_budget:
+                return _exit(RESIZE_EXIT_CODE)
+            tgt = _resize_target_world(store, epoch)
+            if tgt is not None:
+                start = node_rank * cur_np
+                new_local = max(0, min(cur_np, tgt - start))
+                if new_local == 0:
+                    return _exit(0)  # every rank of this host departed
+                cur_np = new_local
+            resize_relaunch = True
+            if new_epoch == epoch:
+                store.add("__restart_epoch", 1)
+                new_epoch = int(store.add("__restart_epoch", 0))
+            epoch = new_epoch
+            continue
         if resume_event:
             # preemption stop, checkpoint committed: relaunch (trainers
             # resume from latest_step) without consuming max_restarts
@@ -366,6 +464,7 @@ def launch(script: str, script_args: Optional[List[str]] = None,
             # survivors at the new size without consuming max_restarts.
             # The epoch ALWAYS advances through the store counter, so
             # epoch-namespaced rendezvous keys can never be reused.
+            resize_relaunch = True  # goodput: a resize, not a restart
             if new_epoch == epoch:
                 store.add("__restart_epoch", 1)
                 new_epoch = int(store.add("__restart_epoch", 0))
@@ -477,6 +576,7 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
         return int(store.add("__restart_epoch", 0))
 
     down_at = None  # when the previous round's trainer died (goodput)
+    resize_relaunch = False  # next round's gap bins `reshard` (planned)
     while True:
         beat()
         store.set(f"__join/{epoch}/{node_rank}", b"1")
@@ -555,8 +655,11 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
         })
         if down_at is not None:
             # relaunch round: stamp the previous trainer's death time for
-            # the child's goodput restart bin
-            env["PADDLE_TPU_GOODPUT_DOWN_AT"] = repr(down_at)
+            # the child's goodput accounting — `reshard` after a planned
+            # membership change, `restart` otherwise
+            env["PADDLE_TPU_GOODPUT_RESIZE_AT" if resize_relaunch
+                else "PADDLE_TPU_GOODPUT_DOWN_AT"] = repr(down_at)
+        resize_relaunch = False
         lf = None
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
@@ -605,11 +708,15 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
             if bumped > scale_seen:
                 scale_seen = bumped
                 if world < np_max:
+                    resize_relaunch = True  # planned membership growth
                     bump_if_current(epoch)
                     break
             if now > grace:
+                # a host that DEPARTED at a consensus resize boundary
+                # stops beating on purpose — never read that as a death
                 stale = [n for n in members if n != node_rank
-                         and lhb_stale(n)]
+                         and lhb_stale(n) and
+                         store.get(f"__departed/{epoch}/{n}") is None]
                 if stale:
                     bump_if_current(epoch)
                     break
@@ -621,6 +728,18 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
         down_at = time.time()  # goodput restart-gap stamp for relaunch
         if lf:
             lf.close()
+
+        if proc.returncode == RESIZE_EXIT_CODE:
+            # this host's rank departed at a consensus resize boundary
+            # (resilience.elastic): the surviving members carry the full
+            # state and continue IN PLACE. Mark the departure (so peers
+            # don't read our stopping heartbeat as a death) and leave the
+            # job cleanly — no epoch bump, no budget spent.
+            try:
+                store.set(f"__departed/{epoch}/{node_rank}", b"1")
+            except Exception:
+                pass
+            return mn_exit(0, epoch, [])
 
         if fail_code is None and proc.returncode == 0 and \
                 int(store.add("__restart_epoch", 0)) == epoch:
@@ -637,9 +756,11 @@ def _elastic_multinode_loop(script, script_args, master_addr, store,
                     # run one more round at the bigger size instead of
                     # exiting and tearing the store down under it
                     scale_seen = bumped
+                    resize_relaunch = True
                     bump_if_current(epoch)
                     break
-                if all(store.get(f"__done/{epoch}/{n}") is not None
+                if all(store.get(f"__done/{epoch}/{n}") is not None or
+                       store.get(f"__departed/{epoch}/{n}") is not None
                        for n in members):
                     return mn_exit(0, epoch, members)
                 time.sleep(0.2)

@@ -42,7 +42,7 @@ from . import flight_recorder, goodput
 from .metrics import MetricsRegistry, get_registry
 
 __all__ = ["HeartbeatPublisher", "FleetAggregator", "fleet_metrics",
-           "publish_step", "note_step", "last_step_age_seconds",
+           "publish_step", "depart", "note_step", "last_step_age_seconds",
            "healthz_fields", "fleetz_snapshot", "recent_heartbeats",
            "enable", "disable", "maybe_enable_from_env"]
 
@@ -101,6 +101,10 @@ def fleet_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
         "missing": r.gauge(
             "fleet_ranks_missing",
             "ranks whose last heartbeat is past the staleness window"),
+        "departed": r.gauge(
+            "fleet_ranks_departed",
+            "ranks retired at a consensus resize boundary (planned "
+            "departure, not a failure)"),
         "median": r.gauge("fleet_step_seconds_median",
                           "fleet-wide rolling-median step time"),
     }
@@ -153,6 +157,19 @@ class HeartbeatPublisher:
                               "wall_s": snap["wall_s"],
                               "fraction": snap["job_goodput_fraction"]}
         self.recent.append(rec)
+        self._set(rec)
+
+    def depart(self, step: int, reason: str = "resize"):
+        """Publish the rank's FINAL heartbeat, marked ``departed`` — a
+        planned exit at a consensus resize boundary. The aggregator
+        retires the lane (status ``departed``) instead of aging it into
+        ``missing``, so a downsize raises no straggler/missing alarms."""
+        rec = {"rank": self.rank, "pid": os.getpid(), "step": int(step),
+               "t": time.time(), "departed": True, "reason": str(reason)}
+        self.recent.append(rec)
+        self._set(rec)
+
+    def _set(self, rec: dict):
         if self._broken:
             return
         try:
@@ -213,6 +230,7 @@ class FleetAggregator:
         self.lanes: dict = {}           # rank -> last parsed record
         self._seen_step: dict = {}      # rank -> last step id counted
         self._slow_streak: dict = {}    # rank -> consecutive slow steps
+        self._departed_noted: set = set()  # lanes retired (FR event fired)
         self.stragglers: set = set()
         self.fleet_goodput: Optional[dict] = None
         self._stop = threading.Event()
@@ -246,13 +264,29 @@ class FleetAggregator:
             pass  # store unreachable this tick: age-out still runs
         with self._lock:
             lanes = dict(self.lanes)
-        live, missing = [], []
+        live, missing, departed = [], [], []
         for rank, rec in lanes.items():
+            if rec.get("departed"):
+                # a planned resize exit: retire the lane — it must never
+                # age into `missing` or trip the straggler detector
+                departed.append(rank)
+                if rank not in self._departed_noted:
+                    self._departed_noted.add(rank)
+                    self.stragglers.discard(rank)
+                    self._m["straggler"].set(0, rank=rank)
+                    t = time.time_ns()
+                    flight_recorder.record(
+                        flight_recorder.KIND_USER,
+                        f"fleet_departed_rank{rank}", t, t, aux=rank,
+                        args={"step": rec.get("step"),
+                              "reason": rec.get("reason", "resize")})
+                continue
             (missing if now - rec.get("t", 0) > self.stale_s
              else live).append(rank)
         self._detect_stragglers(lanes, live)
         self._fold_goodput(lanes)
         self._m["live"].set(len(live))
+        self._m["departed"].set(len(departed))
         self._m["missing"].set(len(missing) +
                                max(self.world - len(lanes), 0))
         return self.rollup(now=now)
@@ -312,9 +346,14 @@ class FleetAggregator:
         ranks = {}
         for rank, rec in sorted(lanes.items()):
             age = now - rec.get("t", now)
+            if rec.get("departed"):
+                status = "departed"
+            elif age > self.stale_s:
+                status = "missing"
+            else:
+                status = "live"
             ranks[str(rank)] = {
-                **rec, "age_s": round(age, 3),
-                "status": "missing" if age > self.stale_s else "live",
+                **rec, "age_s": round(age, 3), "status": status,
                 "straggler": rank in self.stragglers}
         return {"world": self.world, "ranks": ranks,
                 "stragglers": sorted(self.stragglers),
@@ -368,6 +407,14 @@ def publish_step(step: int, stats: dict):
     pub = _publisher
     if pub is not None:
         pub.publish(step, stats)
+
+
+def depart(step: int, reason: str = "resize"):
+    """Retire this rank's heartbeat lane (planned resize exit) — no-op
+    when the bus is off."""
+    pub = _publisher
+    if pub is not None:
+        pub.depart(step, reason=reason)
 
 
 def recent_heartbeats() -> list:

@@ -42,8 +42,12 @@ __all__ = ["GoodputLedger", "BINS", "get_ledger", "on_step", "snapshot",
            "record_compile", "discard_recent_steps", "goodput_metrics"]
 
 #: the taxonomy — every second of wall-clock lands in exactly one bin
+#: (``reshard``: planned elastic resizes — in-place membership changes
+#: and the launcher's resize relaunch gap — kept apart from ``restart``
+#: so riding a preemption down to a smaller world reads as cheap
+#: elasticity, not a crash)
 BINS = ("productive", "compile", "checkpoint", "data_stall",
-        "exposed_collective", "restart", "rollback_discarded",
+        "exposed_collective", "restart", "reshard", "rollback_discarded",
         "other_overhead")
 
 #: how many per-step productive contributions the ledger remembers for
@@ -126,6 +130,20 @@ class GoodputLedger:
             gap = self.start_unix - down_at
             if gap > 0:
                 self._add("restart", gap)
+        # a planned elastic resize stamps its own mark instead — the gap
+        # is downtime too, but it bins as `reshard`, not `restart`. It
+        # predates this ledger's wall (unlike in-process resize seconds
+        # recorded later), so track it for the snapshot's span.
+        self._prewall_reshard_s = 0.0
+        raw = os.environ.get("PADDLE_TPU_GOODPUT_RESIZE_AT")
+        if raw:
+            try:
+                gap = self.start_unix - float(raw)
+            except ValueError:
+                gap = 0.0
+            if gap > 0:
+                self._prewall_reshard_s = gap
+                self._add("reshard", gap)
 
     # -- feeds -------------------------------------------------------------
     def _add(self, bin: str, seconds: float):
@@ -207,9 +225,10 @@ class GoodputLedger:
         wall = max(now - self._start_mono, 0.0)
         with self._lock:
             bins = dict(self._bins)
-        # restart badput predates the ledger's own wall: the accounted
-        # span is (down_at .. now), not (start .. now)
-        span = wall + bins.get("restart", 0.0)
+        # restart badput (and a resize relaunch gap) predates the
+        # ledger's own wall: the accounted span is (down_at .. now), not
+        # (start .. now) — in-process reshard seconds are inside the wall
+        span = wall + bins.get("restart", 0.0) + self._prewall_reshard_s
         explicit = sum(bins.values())
         bins["other_overhead"] = max(span - explicit, 0.0)
         # clamp: perf_counter vs caller-supplied data_time drift can put

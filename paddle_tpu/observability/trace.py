@@ -212,7 +212,11 @@ def _goodput_rollup(ranks: List[dict], aligned: List[tuple]) -> dict:
     Per lane: each step span's wall splits into its bins; the gaps
     *between* consecutive step spans are ``other_overhead``. Per rank: a
     relaunch (second lane, new pid) makes the gap between the first
-    lane's last event and the second's first event ``restart`` badput.
+    lane's last event and the second's first event ``restart`` badput —
+    unless the successor lane opens with an elastic ``resize`` marker
+    (the launcher's planned-resize relaunch), in which case the gap is
+    ``reshard``. In-place resizes ride as ``cat="elastic"`` spans whose
+    wall bins as ``reshard`` directly.
     """
     from .goodput import BINS
     bins = {b: 0.0 for b in BINS}
@@ -220,17 +224,30 @@ def _goodput_rollup(ranks: List[dict], aligned: List[tuple]) -> dict:
     for ts, r, ev in aligned:
         lane = lanes.setdefault(
             r["label"], {"rank": r["rank"], "steps": [],
-                         "first_ns": ts, "last_ns": ts})
+                         "first_ns": ts, "last_ns": ts,
+                         "resized": False})
         end = ts + int(ev.get("dur", 0)) if ev.get("type") == "span" else ts
         lane["first_ns"] = min(lane["first_ns"], ts)
         lane["last_ns"] = max(lane["last_ns"], end)
         if ev.get("cat") == "step" and ev.get("type") == "span":
             lane["steps"].append((ts, end, ev.get("args") or {}))
+        elif ev.get("cat") == "elastic":
+            if str(ev.get("name", "")).startswith("resize"):
+                lane["resized"] = True
+            if ev.get("type") == "span":
+                lane["steps"].append((ts, end, {"__elastic__": True}))
     steps = 0
     for lane in lanes.values():
         lane["steps"].sort()
         prev_end = None
         for ts, end, a in lane["steps"]:
+            if a.get("__elastic__"):
+                # an in-place resize span: its whole wall is reshard
+                bins["reshard"] += (end - ts) / 1e9
+                if prev_end is not None and ts > prev_end:
+                    bins["other_overhead"] += (ts - prev_end) / 1e9
+                prev_end = max(prev_end or end, end)
+                continue
             dur = float(a.get("step_time_s", (end - ts) / 1e9))
             shares = {
                 "data_stall": float(a.get("data_time_s", 0.0)),
@@ -256,7 +273,9 @@ def _goodput_rollup(ranks: List[dict], aligned: List[tuple]) -> dict:
         for prev, nxt in zip(group, group[1:]):
             gap = (nxt["first_ns"] - prev["last_ns"]) / 1e9
             if gap > 0:
-                bins["restart"] += gap
+                # a successor lane born from a planned resize marks
+                # itself; its rebirth gap is elasticity, not a crash
+                bins["reshard" if nxt["resized"] else "restart"] += gap
     wall = sum(bins.values())
     return {"bins": {b: round(v, 6) for b, v in bins.items()},
             "wall_s": round(wall, 6), "steps": steps,

@@ -14,14 +14,20 @@ Four cooperating pieces, each usable standalone and composed by
 * :mod:`~paddle_tpu.resilience.chaos` — env-driven fault injection
   (kill-at-step, hang-collective, poison-batch, corrupt-loss) proving
   mean-time-to-recovery end to end.
+* :mod:`~paddle_tpu.resilience.elastic` — live elastic resharding: a
+  membership change is an in-place *resize* (consensus boundary +
+  in-memory shard exchange + data-order remap), not a restart;
+  departing ranks exit :data:`RESIZE_EXIT_CODE`.
 """
 from .counters import record_nonfinite  # noqa: F401
 from .preemption import RESUMABLE_EXIT_CODE, PreemptionListener  # noqa: F401
+from .elastic import RESIZE_EXIT_CODE, ElasticResizeListener  # noqa: F401
 from .watchdog import Watchdog, WatchdogExpired  # noqa: F401
 from .nan_guard import NaNGuard, NumericError  # noqa: F401
 from .fit import FitResilience  # noqa: F401
 from . import chaos  # noqa: F401
 
-__all__ = ["RESUMABLE_EXIT_CODE", "PreemptionListener", "Watchdog",
+__all__ = ["RESUMABLE_EXIT_CODE", "PreemptionListener",
+           "RESIZE_EXIT_CODE", "ElasticResizeListener", "Watchdog",
            "WatchdogExpired", "NaNGuard", "NumericError", "FitResilience",
            "record_nonfinite", "chaos"]

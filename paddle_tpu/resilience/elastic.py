@@ -1,0 +1,434 @@
+"""Live elastic resharding — a membership change is a *resize*, not a
+restart.
+
+The classic recovery story for a mesh-membership change (host preempted,
+capacity granted back) is kill → checkpoint-reshard on disk → relaunch:
+every rank pays a full checkpoint round trip through the filesystem plus
+process death and rebirth. This module fuses the pieces the repo already
+has — cross-mesh bit-identical shard assembly (PR 3,
+``checkpoint/reshard.py``), the consensus stop-step protocol (PR 4,
+``resilience/preemption.py``), exactly-once data state (PR 5,
+``data/pipeline.py``) and the goodput/heartbeat observability (PR 13) —
+into an in-place resize, the membership-change discipline Pathways-style
+single-controller and MegaScale-style fault-tolerant training loops ride
+preemptions with (PAPERS.md):
+
+1. **Notice** — a scale-down arrives through the preemption seam; a
+   scale-up (or operator-driven downsize) through the elastic seam:
+   ``PADDLE_TPU_ELASTIC_RESIZE=<new_world>`` (env),
+   ``PADDLE_TPU_ELASTIC_RESIZE_FILE`` (a file whose *content* is the
+   target world size), or the job-store key ``__elastic/…/target``.
+2. **Consensus boundary** — the PR 4 claim pattern under ``__elastic``
+   keys: the first rank to observe the notice wins ``store.add`` and
+   publishes ``stop_at = its step + 1``; every rank steps to exactly
+   that boundary, so the exchange sees ONE coherent state.
+3. **In-memory exchange** — *no filesystem*: each old rank snapshots
+   model+opt to host (``checkpoint.writer.snapshot``), publishes the
+   shards it owns (the writer's ``plan_grid`` / ``owner = flat_pos %
+   world`` rule, raw bytes + crc32) onto the job TCPStore; every
+   new-world rank assembles full tensors through
+   ``checkpoint.reshard.assemble_from`` — literally the same offset-
+   pasting loop the file path runs, so the result is bit-identical to a
+   checkpoint-reshard **by construction**. (The store transport is the
+   CPU/test path; an all-gather over the accelerator fabric slots into
+   the same ``fetch`` seam as the TPU follow-up.)
+4. **Data remap** — old ranks publish their ``DataPipeline`` states;
+   every new rank folds them through
+   ``DataPipeline.reshard_state(states, new_world)`` (global sample
+   order and packer carry preserved — exactly-once ledger digests
+   unchanged) and loads its own remapped shard.
+5. **Continue / depart / join** — survivors rebuild mesh/TrainStep and
+   keep training; departing ranks retire their heartbeat lane
+   (``fleet.depart`` → status ``departed``, never ``missing``) and exit
+   :data:`RESIZE_EXIT_CODE` (83) — the launcher classifies that as a
+   planned resize (``reshard`` goodput bin via
+   ``PADDLE_TPU_GOODPUT_RESIZE_AT``), not a crash; joining ranks sync
+   state from the same store keys a live peer published.
+
+Rank mapping is deterministic: old ranks ``0..new_world-1`` survive (and
+keep their index), old ranks ``>= new_world`` depart; at a scale-up new
+ranks ``old_world..new_world-1`` join.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["RESIZE_EXIT_CODE", "ElasticResizeListener",
+           "publish_state", "collect_state", "exchange_reshard",
+           "publish_data_state", "collect_data_states", "perform_resize",
+           "elastic_prefix"]
+
+#: Exit status meaning "left the job at a consensus resize boundary; the
+#: surviving ranks carry the full state". 83 sits next to (but distinct
+#: from) the preemption contract's 79 — the launcher must NOT relaunch
+#: this rank, just shrink the world and keep the survivors running.
+RESIZE_EXIT_CODE = 83
+
+STORE_KEY = "__elastic"
+
+NOTICE_ENV = "PADDLE_TPU_ELASTIC_RESIZE"
+NOTICE_FILE_ENV = "PADDLE_TPU_ELASTIC_RESIZE_FILE"
+
+
+def _epoch() -> str:
+    return os.environ.get("PADDLE_RESTART_EPOCH", "0")
+
+
+def elastic_prefix(gen: int, epoch: Optional[str] = None) -> str:
+    """Store-key prefix for resize generation ``gen`` — namespaced by the
+    launcher restart epoch (like ``__preempt``) so a relaunched attempt
+    never consumes a previous attempt's stale verdict, and by ``gen`` so
+    several in-place resizes within one incarnation stay disjoint."""
+    return f"{STORE_KEY}/{epoch if epoch is not None else _epoch()}/g{gen}"
+
+
+class ElasticResizeListener:
+    """Consensus resize observer — the PR 4 stop-step protocol pointed at
+    membership changes. Poll :meth:`should_resize` at step boundaries;
+    it returns True for every rank at the SAME step, after which
+    :attr:`target_world` holds the agreed new world size.
+
+    Channels: ``PADDLE_TPU_ELASTIC_RESIZE=<M>`` (env), a notice file
+    whose content is ``<M>`` (``PADDLE_TPU_ELASTIC_RESIZE_FILE``), the
+    store key ``{prefix}/target`` (operator/launcher seam), or the
+    programmatic :meth:`request`. Without a job store a locally observed
+    notice resizes at the next boundary (single-process drills).
+    """
+
+    def __init__(self, store=None, notice_file: Optional[str] = None,
+                 check_interval: float = 0.0):
+        self._store = store
+        self._store_failed = False
+        self._notice_file = notice_file
+        self._check_interval = float(check_interval)
+        self._last_poll = 0.0
+        self._flagged = False
+        self._broadcast_done = False
+        self._decided = False
+        self.target_world: Optional[int] = None
+        self.reason: Optional[str] = None
+        self.boundary_step: Optional[int] = None
+        self.generation = 0
+
+    # -- channels ----------------------------------------------------------
+    def request(self, new_world: int, reason: str = "request"):
+        """Programmatic resize notice (chaos drills, operator tooling)."""
+        if not self._flagged:
+            self._flagged = True
+            self.target_world = int(new_world)
+            self.reason = reason
+
+    def _poll_notice(self):
+        raw = os.environ.get(NOTICE_ENV, "").strip()
+        if raw and raw != "0":
+            try:
+                self.request(int(raw), "notice_env")
+            except ValueError:
+                pass
+        path = self._notice_file or os.environ.get(NOTICE_FILE_ENV)
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self.request(int(f.read().strip()), "notice_file")
+            except (OSError, ValueError):
+                pass
+
+    def _job_store(self):
+        if self._store is not None or self._store_failed:
+            return self._store
+        if not os.environ.get("PADDLE_MASTER"):
+            self._store_failed = True
+            return None
+        try:
+            from paddle_tpu.distributed.tcp_store import job_store
+            self._store = job_store()
+        except Exception:
+            self._store_failed = True
+        return self._store
+
+    def _gen_key(self) -> str:
+        return f"{STORE_KEY}/{_epoch()}/gen"
+
+    def _refresh_generation(self, store) -> str:
+        try:
+            raw = store.get(self._gen_key())
+            self.generation = int(raw) if raw else 0
+        except Exception:
+            pass
+        return elastic_prefix(self.generation)
+
+    # -- the step-boundary query ------------------------------------------
+    def should_resize(self, step: Optional[int] = None) -> bool:
+        """True once the cluster-agreed resize boundary is reached — all
+        ranks return True at the SAME step (see PreemptionListener: the
+        first observer claims ``{prefix}/armed`` and publishes
+        ``stop_at:new_world:reason`` at ``{prefix}/stop``)."""
+        if self._decided:
+            return True
+        now = time.monotonic()
+        if now - self._last_poll >= self._check_interval:
+            self._last_poll = now
+            self._poll_notice()
+        store = self._job_store()
+        if store is None:
+            if self._flagged:
+                self._decided = True
+                self.boundary_step = step
+            return self._decided
+        try:
+            prefix = self._refresh_generation(store)
+            if not self._flagged:
+                raw = store.get(f"{prefix}/target")
+                if raw:
+                    t, _, r = raw.decode(
+                        errors="replace").partition(":")
+                    try:
+                        self.request(int(t), f"store:{r or 'target'}")
+                    except ValueError:
+                        pass
+            if self._flagged and not self._broadcast_done:
+                if int(store.add(f"{prefix}/armed", 1)) == 1:
+                    stop_at = 0 if step is None else int(step) + 1
+                    store.set(
+                        f"{prefix}/stop",
+                        f"{stop_at}:{self.target_world}:"
+                        f"{self.reason or '?'}".encode())
+                self._broadcast_done = True
+            v = store.get(f"{prefix}/stop")
+            if v is None:
+                return False
+            stop_s, _, rest = v.decode(errors="replace").partition(":")
+            world_s, _, reason = rest.partition(":")
+            if not self._flagged:
+                self._flagged = True
+                self.reason = f"store:{reason}"
+            self.target_world = int(world_s)
+            stop_at = int(stop_s)
+            if step is None or stop_at == 0 or int(step) >= stop_at:
+                self._decided = True
+                self.boundary_step = stop_at if stop_at else step
+            return self._decided
+        except Exception:
+            # control-plane death must never kill the training step
+            self._store_failed = True
+            if self._flagged:
+                self._decided = True
+                self.boundary_step = step
+            return self._decided
+
+    @property
+    def resize_pending(self) -> bool:
+        return self._flagged
+
+    def reset(self):
+        """Re-arm for the next resize (survivors call this after a
+        completed in-place resize; the store generation was bumped so
+        stale verdict keys are never re-read)."""
+        self._flagged = False
+        self._broadcast_done = False
+        self._decided = False
+        self.target_world = None
+        self.reason = None
+        self.boundary_step = None
+
+
+# ---------------------------------------------------------------------------
+# In-memory model+opt exchange over the job store — zero filesystem I/O.
+# ---------------------------------------------------------------------------
+
+def _shard_key(prefix: str, key: str, flat_pos: int) -> str:
+    return f"{prefix}/t/{key}/{flat_pos:03d}"
+
+
+def publish_state(store, prefix: str, state, world: int, rank: int,
+                  nshards: Optional[int] = None) -> dict:
+    """Host-snapshot ``state`` and publish this rank's owned shards.
+
+    Mirrors ``checkpoint.writer.write_step`` exactly — same
+    ``plan_grid``, same ``owner = flat_pos % world``, same raw C-order
+    bytes + crc32 — except the bytes land on the job store instead of a
+    step directory, so assembly is bit-identical to the file path. Rank
+    0 additionally publishes the pickled manifest + state skeleton.
+    Returns the manifest (every rank computes an identical one).
+    """
+    from paddle_tpu.checkpoint.layout import (crc32_of, iter_shards,
+                                              plan_grid)
+    from paddle_tpu.checkpoint.writer import snapshot
+
+    nshards = max(int(nshards if nshards is not None else world), 1)
+    snap = snapshot(state)
+    tensors: Dict[str, dict] = {}
+    for key in sorted(snap.tensors):
+        arr, ref = snap.tensors[key]
+        grid = plan_grid(arr.shape, nshards)
+        entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                 "grid": grid, "kind": ref.kind, "shards": []}
+        for flat_pos, offset, shard_shape, slices in iter_shards(
+                arr.shape, grid):
+            owner = flat_pos % world
+            rec = {"offset": offset, "shape": shard_shape, "owner": owner,
+                   "store_key": _shard_key(prefix, key, flat_pos)}
+            if owner == rank:
+                data = np.asarray(arr[slices]).tobytes()
+                rec["crc32"] = crc32_of(data)
+                rec["nbytes"] = len(data)
+                store.set(rec["store_key"], data)
+            entry["shards"].append(rec)
+        tensors[key] = entry
+    manifest = {"tensors": tensors, "world": int(world),
+                "aux_crc": None}
+    if rank == 0:
+        manifest["aux_crc"] = crc32_of(snap.skeleton_bytes)
+        store.set(f"{prefix}/aux", snap.skeleton_bytes)
+        store.set(f"{prefix}/manifest", pickle.dumps(manifest, protocol=4))
+    store.set(f"{prefix}/published/{rank}", b"1")
+    return manifest
+
+
+def collect_state(store, prefix: str, verify: bool = True, mesh=None,
+                  timeout: Optional[float] = None):
+    """Assemble the full state tree from a :func:`publish_state` round.
+
+    Every shard's bytes are pulled through ``store.wait`` and pasted by
+    ``checkpoint.reshard.assemble_from`` — the exact code path the
+    checkpoint-file reshard runs, crc-verified against the manifest.
+    With ``mesh``, tensors are placed onto it (``place_on_mesh``), the
+    same largest-divisible-dim rule as the restore path.
+    """
+    from paddle_tpu.checkpoint.layout import (CheckpointIntegrityError,
+                                              crc32_of, unflatten_state)
+    from paddle_tpu.checkpoint.reshard import assemble_from, place_on_mesh
+
+    manifest = pickle.loads(store.wait(f"{prefix}/manifest", timeout))
+    skel_bytes = store.wait(f"{prefix}/aux", timeout)
+    if verify and manifest.get("aux_crc") is not None and \
+            crc32_of(skel_bytes) != manifest["aux_crc"]:
+        raise CheckpointIntegrityError(
+            "checksum mismatch on exchanged state skeleton")
+    skeleton = pickle.loads(skel_bytes)
+
+    def fetch(rec):
+        return store.wait(rec["store_key"], timeout)
+
+    arrays: Dict[str, np.ndarray] = {}
+    for key, entry in manifest["tensors"].items():
+        full = assemble_from(entry, fetch, verify=verify)
+        if mesh is not None and entry.get("kind") != "ndarray":
+            full = place_on_mesh(full, mesh)
+        arrays[key] = full
+    return unflatten_state(skeleton, arrays)
+
+
+def exchange_reshard(store, prefix: str, state, world: int, rank: int,
+                     new_world: int, verify: bool = True, mesh=None,
+                     timeout: Optional[float] = None):
+    """One full in-memory reshard round for one rank: publish this
+    rank's shards, then (ranks surviving into the new world) assemble
+    the full state. Departing ranks (``rank >= new_world``) return None
+    after publishing — their shards are on the store, so they may exit.
+    """
+    publish_state(store, prefix, state, world, rank)
+    if rank >= int(new_world):
+        return None
+    return collect_state(store, prefix, verify=verify, mesh=mesh,
+                         timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# Data-state exchange + remap.
+# ---------------------------------------------------------------------------
+
+def publish_data_state(store, prefix: str, data_state: dict, rank: int):
+    """Publish one rank's ``DataPipeline.state_dict()`` (pickled — it
+    carries numpy pending batches)."""
+    store.set(f"{prefix}/data/{rank}",
+              pickle.dumps(data_state, protocol=4))
+
+
+def collect_data_states(store, prefix: str, world: int,
+                        timeout: Optional[float] = None) -> List[dict]:
+    """Gather every old rank's published pipeline state."""
+    return [pickle.loads(store.wait(f"{prefix}/data/{r}", timeout))
+            for r in range(int(world))]
+
+
+# ---------------------------------------------------------------------------
+# Orchestration.
+# ---------------------------------------------------------------------------
+
+def perform_resize(store, *, state, data_state: Optional[dict],
+                   world: int, rank: int, new_world: int,
+                   generation: Optional[int] = None,
+                   mesh=None, verify: bool = True,
+                   pad_id: int = 0, ignore_label: int = -100,
+                   boundary_step: Optional[int] = None,
+                   timeout: Optional[float] = None):
+    """Run one rank's side of a consensus resize, end to end:
+
+    publish model+opt shards and the data state → barrier on every old
+    rank having published → departing ranks retire their heartbeat lane
+    and return ``(None, None)`` (caller exits :data:`RESIZE_EXIT_CODE`);
+    surviving ranks assemble the new-mesh state, remap the data order,
+    bump the store generation (rank 0), record the resize wall into the
+    goodput ``reshard`` bin and an ``elastic`` trace span, and return
+    ``(state, data_state)`` for the caller to apply and continue with.
+
+    No filesystem I/O happens anywhere on this path.
+    """
+    t0 = time.perf_counter()
+    gen = generation if generation is not None else 0
+    prefix = elastic_prefix(gen)
+    world, new_world = int(world), int(new_world)
+
+    publish_state(store, prefix, state, world, rank)
+    if data_state is not None:
+        publish_data_state(store, prefix, data_state, rank)
+    # barrier: survivors must not assemble until every old rank (the
+    # departing ones included — they own shards) has published
+    for r in range(world):
+        store.wait(f"{prefix}/published/{r}", timeout)
+
+    departing = rank >= new_world
+    if departing:
+        try:
+            from paddle_tpu.observability import fleet
+            fleet.depart(int(boundary_step or 0), reason="resize")
+        except Exception:
+            pass
+        return None, None
+
+    new_state = collect_state(store, prefix, verify=verify, mesh=mesh,
+                              timeout=timeout)
+    new_data = None
+    if data_state is not None:
+        from paddle_tpu.data.pipeline import DataPipeline
+        states = collect_data_states(store, prefix, world, timeout)
+        new_data = DataPipeline.reshard_state(
+            states, new_world, pad_id=pad_id,
+            ignore_label=ignore_label)[rank]
+
+    if rank == 0:
+        # open the next generation so a later resize never re-reads
+        # this round's verdict/shard keys
+        try:
+            store.set(f"{STORE_KEY}/{_epoch()}/gen", str(gen + 1).encode())
+        except Exception:
+            pass
+
+    dt = time.perf_counter() - t0
+    try:
+        from paddle_tpu.observability import goodput, trace
+        goodput.get_ledger().record("reshard", dt)
+        now = time.perf_counter_ns()
+        trace.span("elastic", f"elastic_resize_{world}to{new_world}",
+                   now - int(dt * 1e9), now,
+                   args={"world": world, "new_world": new_world,
+                         "step": boundary_step, "reshard_s": round(dt, 6)})
+    except Exception:
+        pass
+    return new_state, new_data

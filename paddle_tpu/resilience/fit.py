@@ -48,7 +48,8 @@ class FitResilience(Callback):
                  watchdog_action: str = "dump",
                  nan_guard: bool = False, max_rollbacks: int = 3,
                  spike_window: int = 0, spike_factor: float = 10.0,
-                 registry=None, pipeline=None):
+                 registry=None, pipeline=None,
+                 elastic: bool = False, elastic_listener=None):
         """``pipeline``: a ``paddle_tpu.data.DataPipeline`` (or anything
         with ``state_dict``/``load_state_dict``) whose iterator state is
         committed under the ``"data"`` key of EVERY save — atomically in
@@ -80,7 +81,12 @@ class FitResilience(Callback):
                                       registry=registry)
         self._registry = registry
         self.pipeline = pipeline
+        self._want_elastic = elastic
+        self.elastic_listener = elastic_listener
         self.preempted = False
+        self.resized = False
+        self.resize_target: Optional[int] = None
+        self.resize_boundary_step: Optional[int] = None
         self.final_step: Optional[int] = None
         self._step0 = 0          # global-step offset after a resume
         self._cur_step = 0
@@ -132,6 +138,9 @@ class FitResilience(Callback):
             chaos.refresh()
         if self._want_preemption and self.listener is None:
             self.listener = PreemptionListener(registry=self._registry)
+        if self._want_elastic and self.elastic_listener is None:
+            from .elastic import ElasticResizeListener
+            self.elastic_listener = ElasticResizeListener()
         if self.listener is not None and not self._installed_listener:
             self.listener.install()
             self._installed_listener = True
@@ -166,6 +175,10 @@ class FitResilience(Callback):
         if self.listener is not None and not self.preempted and \
                 self.listener.should_stop(step=gs):
             self._final_save(gs)
+        if self.elastic_listener is not None and not self.preempted and \
+                not self.resized and \
+                self.elastic_listener.should_resize(step=gs):
+            self._resize_stop(gs)
 
     def on_train_end(self, logs=None):
         if self.manager is not None:
@@ -210,6 +223,25 @@ class FitResilience(Callback):
                 gs, self._state(), async_=False, overwrite=True,
                 metadata={"global_step": gs, "preempted": True,
                           "reason": getattr(self.listener, "reason", None)})
+        self.model._stop_training = True
+
+    def _resize_stop(self, gs: int):
+        """The elastic boundary: the cluster agreed to resize at this
+        step, so break out of fit WITHOUT a checkpoint — the state stays
+        live in memory and ``elastic.perform_resize`` reshards it over
+        the store (the whole point: no filesystem round trip). Survivors
+        refit after the in-place resize; departing ranks exit
+        :data:`~.elastic.RESIZE_EXIT_CODE`."""
+        self.resized = True
+        self.resize_target = self.elastic_listener.target_world
+        self.resize_boundary_step = gs
+        try:
+            from paddle_tpu.observability import trace
+            trace.mark("elastic", "resize_boundary",
+                       args={"step": gs, "target": self.resize_target,
+                             "reason": self.elastic_listener.reason})
+        except Exception:
+            pass
         self.model._stop_training = True
 
     @property

@@ -170,7 +170,7 @@ class TestShardedStream:
                            shuffle=False)
         s2 = ShardedStream(Pairs(12), shard_index=0, num_shards=3,
                            shuffle=False)
-        with pytest.raises(ValueError, match="mesh-size-preserving"):
+        with pytest.raises(ValueError, match="reshard_state"):
             s2.load_state_dict(s1.state_dict())
 
     def test_geometry_disagreement_refused(self):

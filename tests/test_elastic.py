@@ -1,0 +1,667 @@
+"""Live elastic resharding (resilience.elastic).
+
+Covers: the cross-mesh reshard property sweep (8→6→4→6, non-power-of-two
+membership, uneven largest-dim splits, bf16 + fused flats) asserting the
+in-memory exchange is bit-identical to the source state AND to the
+checkpoint-file reshard path; the no-filesystem guarantee (write spy);
+the consensus resize listener (every rank stops at the same boundary,
+env/file/store notice channels, generation isolation); the data-order
+remap (exactly-once under membership change, packer carry preserved,
+refusals); ``perform_resize`` end to end; the fleet ``departed`` lane
+status; the goodput ``reshard`` bin; and the offline trace rollup's
+resize classification.
+"""
+import builtins
+import json
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from paddle_tpu.checkpoint.layout import flatten_state
+from paddle_tpu.data.pipeline import DataPipeline
+from paddle_tpu.data.stream import ShardedStream
+from paddle_tpu.observability import fleet, goodput
+from paddle_tpu.observability.fleet import (FleetAggregator,
+                                            HeartbeatPublisher)
+from paddle_tpu.observability.goodput import BINS, GoodputLedger
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.resilience import elastic
+from paddle_tpu.resilience.elastic import (RESIZE_EXIT_CODE,
+                                           ElasticResizeListener)
+
+
+class MemStore:
+    """Dict-backed TCPStore stand-in (set/get/add/wait) for tests that
+    never need cross-thread blocking."""
+
+    def __init__(self):
+        self.d = {}
+
+    def set(self, key, value):
+        self.d[key] = value if isinstance(value, bytes) \
+            else str(value).encode()
+
+    def get(self, key):
+        return self.d.get(key)
+
+    def add(self, key, n):
+        cur = int(self.d.get(key, b"0")) + int(n)
+        self.d[key] = str(cur).encode()
+        return cur
+
+    def wait(self, key, timeout=None):
+        v = self.d.get(key)
+        if v is None:
+            raise KeyError(key)
+        return v
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    goodput.reset_ledger()
+    yield
+    fleet.disable()
+    goodput.reset_ledger()
+
+
+class _Spy:
+    """Write-mode open() spy: the resize path must never touch files."""
+
+    def __enter__(self):
+        self.writes = []
+        self._orig = builtins.open
+
+        def spy(f, mode="r", *a, **k):
+            if any(c in str(mode) for c in "wxa+"):
+                self.writes.append(str(f))
+            return self._orig(f, mode, *a, **k)
+
+        builtins.open = spy
+        return self
+
+    def __exit__(self, *exc):
+        builtins.open = self._orig
+        return False
+
+
+# ---------------- data-order remap (ShardedStream) ---------------------------
+class _Ints:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return i
+
+
+def _streams(n, world, drop, shuffle=True):
+    return [ShardedStream(_Ints(n), base_seed=7, shuffle=shuffle,
+                          shard_index=k, num_shards=world,
+                          drop_remainder=drop) for k in range(world)]
+
+
+def _epoch_cover(n, world, drop, shuffle=True):
+    """The sample multiset one epoch covers at this world size."""
+    from paddle_tpu.io.sampler import epoch_seed
+    order = (np.random.RandomState(epoch_seed(7, 0)).permutation(n)
+             if shuffle else np.arange(n))
+    rem = n % world
+    if rem == 0:
+        full = order
+    elif drop:
+        full = order[:n - rem]
+    else:
+        full = np.concatenate([order, order[:world - rem]])
+    return Counter(int(x) for x in full)
+
+
+class TestStreamReshard:
+    @pytest.mark.parametrize("n,N,M,drop,shuffle", [
+        (64, 8, 6, True, True),
+        (61, 8, 6, True, True),     # uneven: 61 % 8, 61 % 6 both != 0
+        (61, 8, 6, False, True),    # wrap tail remaps too
+        (61, 6, 4, True, True),
+        (61, 4, 6, False, True),    # scale UP mid-epoch
+        (17, 3, 5, True, True),     # non-power-of-two both sides
+        (17, 5, 1, True, True),     # collapse to one shard
+        (31, 8, 6, True, False),    # unshuffled arange order
+    ])
+    def test_exactly_once_under_membership_change(self, n, N, M, drop,
+                                                  shuffle):
+        streams = _streams(n, N, drop, shuffle)
+        rng = np.random.RandomState(N * M)
+        rem = n % N
+        per_old = (n - rem if drop and rem else
+                   n + (N - rem) % N if not drop else n) // N
+        pre = []
+        for k, st in enumerate(streams):
+            it = iter(st)
+            # stay strictly mid-epoch: a fully-consumed shard has rolled
+            # into the next epoch and reshard rightly refuses mixed epochs
+            for _ in range(int(rng.randint(0, min(4, per_old)))):
+                pre.append(next(it))
+        new_states = ShardedStream.reshard_state(
+            [st.state_dict() for st in streams], M)
+        post = []
+        for j in range(M):
+            s = ShardedStream(_Ints(n), base_seed=7, shuffle=shuffle,
+                              shard_index=j, num_shards=M,
+                              drop_remainder=drop)
+            s.load_state_dict(new_states[j])
+            post.extend(iter(s))
+        want = _epoch_cover(n, M, drop, shuffle)
+        have = Counter(pre) + Counter(post)
+        # every sample of the new world's epoch seen at least its
+        # multiplicity; any extras must come from pre-boundary
+        # consumption under the OLD world (coverage difference)
+        for s_, cnt in want.items():
+            assert have[s_] >= cnt, f"sample {s_} lost in reshard"
+        extras = have - want
+        assert sum(extras.values()) <= len(pre), "duplicates after remap"
+
+    def test_chain_8_6_4_6(self):
+        """Two consecutive mid-epoch reshards then a scale-up — the
+        consumed_ahead bookkeeping survives chaining."""
+        n = 48  # divisible by 8, 6, 4 → identical coverage at all sizes
+        streams = _streams(n, 8, True)
+        seen = []
+        for world_next, consume in ((6, 2), (4, 1), (6, 1)):
+            for st in streams:
+                it = iter(st)
+                for _ in range(consume):
+                    seen.append(next(it))
+            new_states = ShardedStream.reshard_state(
+                [st.state_dict() for st in streams], world_next)
+            streams = []
+            for j, state in enumerate(new_states):
+                s = ShardedStream(_Ints(n), base_seed=7, shuffle=True,
+                                  shard_index=j, num_shards=world_next,
+                                  drop_remainder=True)
+                s.load_state_dict(state)
+                streams.append(s)
+        for st in streams:
+            seen.extend(iter(st))
+        assert Counter(seen) == _epoch_cover(n, 6, True)
+
+    def test_refuses_mixed_epochs(self):
+        streams = _streams(16, 4, True)
+        it = iter(streams[0])
+        for _ in range(4):  # shard 0 rolls into the next epoch
+            next(it)
+        with pytest.raises(ValueError, match="different epochs"):
+            ShardedStream.reshard_state(
+                [st.state_dict() for st in streams], 2)
+
+    def test_refuses_consumed_beyond_new_coverage(self):
+        # drop_remainder coverage shrinks 17→15 going 1→3 shards: a
+        # position consumed under world 1 can sit past world 3's epoch
+        streams = _streams(17, 1, True)
+        it = iter(streams[0])
+        for _ in range(17):
+            pass
+        for _ in range(16):
+            next(it)
+        with pytest.raises(ValueError, match="only covers"):
+            ShardedStream.reshard_state([streams[0].state_dict()], 3)
+
+    def test_mismatch_refusal_points_at_reshard_state(self):
+        st = _streams(16, 4, True)[0]
+        state = st.state_dict()
+        other = ShardedStream(_Ints(16), base_seed=7, shard_index=0,
+                              num_shards=2)
+        with pytest.raises(ValueError, match="reshard_state"):
+            other.load_state_dict(state)
+
+    def test_consumed_ahead_roundtrip(self):
+        st = _streams(24, 4, True)[0]
+        st.consumed_ahead = {3, 5}
+        st.cursor = 1
+        state = st.state_dict()
+        assert state["consumed_ahead"] == [3, 5]
+        st2 = _streams(24, 4, True)[0]
+        st2.load_state_dict(state)
+        assert st2.consumed_ahead == {3, 5}
+        # iteration skips the ahead positions without yielding them
+        got = list(iter(st2))
+        assert len(got) == 6 - 1 - 2  # per-shard epoch len - cursor - ahead
+
+
+# ---------------- data-order remap (DataPipeline, packed) --------------------
+class _Docs:
+    def __init__(self, docs):
+        self.docs = docs
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i]
+
+
+def _doc_pipes(docs, world):
+    return [DataPipeline(_Docs(docs), batch_size=2, seq_len=16, pack=True,
+                         base_seed=5, shuffle=True, shard_index=k,
+                         num_shards=world, drop_last=False)
+            for k in range(world)]
+
+
+def _tokens(batches):
+    c = Counter()
+    for b in batches:
+        ids, m = b["input_ids"], b["attention_mask"]
+        c.update(ids[m > 0].tolist())
+    return c
+
+
+class TestPipelineReshard:
+    def test_packed_exactly_once_8_to_6(self):
+        rng = np.random.RandomState(0)
+        docs = [rng.randint(1, 100, size=rng.randint(3, 40))
+                .astype(np.int32) for _ in range(96)]  # 96 % 8 == 96 % 6 == 0
+        pipes = _doc_pipes(docs, 8)
+        pre = []
+        iters = [iter(p) for p in pipes]
+        for k, it in enumerate(iters):
+            for _ in range(2 + (k % 2)):
+                pre.append(next(it))
+        new_states = DataPipeline.reshard_state(
+            [p.state_dict() for p in pipes], 6)
+        assert len(new_states) == 6
+        newp = _doc_pipes(docs, 6)
+        post = []
+        for j, p in enumerate(newp):
+            p.load_state_dict(new_states[j])
+        # the mid-epoch flag keeps every new shard in the SAME epoch
+        assert len({p.epoch for p in newp}) == 1
+        for p in newp:
+            e0 = p.epoch
+            while p.epoch == e0:
+                post.extend(iter(p))
+                break
+        want = Counter()
+        for d in docs:
+            want.update(d.tolist())
+        assert _tokens(pre) + _tokens(post) == want
+
+    def test_pendings_and_carry_redistributed(self):
+        rng = np.random.RandomState(3)
+        docs = [rng.randint(1, 100, size=rng.randint(3, 30))
+                .astype(np.int32) for _ in range(48)]
+        pipes = _doc_pipes(docs, 4)
+        for p in pipes:
+            next(iter(p))
+        states = [p.state_dict() for p in pipes]
+        new_states = DataPipeline.reshard_state(states, 3)
+        # no token lost: open bins + pendings all land on SOME new shard
+        def open_tok(ss):
+            c = Counter()
+            for s in ss:
+                for b in s.get("packer", {}).get("bins", []):
+                    for doc in b:
+                        c.update(np.asarray(doc).tolist())
+                for pend in s.get("pending", []):
+                    ids = np.asarray(pend["input_ids"])
+                    m = np.asarray(pend["attention_mask"])
+                    c.update(ids[m > 0].tolist())
+            return c
+        assert open_tok(new_states) == open_tok(states)
+
+
+# ---------------- in-memory exchange: bit-identity ---------------------------
+def _mixed_state(rng):
+    """Uneven largest-dim splits, a scalar, a fused 1-D flat, a reduced-
+    precision master — the shapes plan_grid struggles hardest with."""
+    import jax.numpy as jnp
+    return {
+        "model": {"w1": rng.randn(13, 7).astype(np.float32),
+                  "emb": rng.randn(31, 5).astype(np.float32),
+                  "scalar": np.float32(rng.randn())},
+        "opt": {"m": rng.randn(13, 7).astype(np.float32),
+                "fused_flat": rng.randn(769).astype(np.float32),
+                "step": np.int64(42)},
+        "master_bf16": jnp.asarray(rng.randn(9, 6), dtype=jnp.bfloat16),
+    }
+
+
+def _flat_bytes(state):
+    _, flat = flatten_state(state)
+    return {k: (str(v[0].dtype), v[0].shape, v[0].tobytes())
+            for k, v in flat.items()}
+
+
+class TestExchangeBitIdentity:
+    def test_membership_sweep_matches_source(self):
+        """8→6→4→6: at every world size the store round trip reassembles
+        the exact bytes — and never opens a file."""
+        from paddle_tpu.distributed.tcp_store import TCPStore
+        rng = np.random.RandomState(1)
+        state = _mixed_state(rng)
+        src = _flat_bytes(state)
+        store = TCPStore(is_master=True, world_size=1)
+        with _Spy() as spy:
+            for g, world in enumerate((8, 6, 4, 6)):
+                prefix = f"__elastic/t/g{g}"
+                for r in range(world):
+                    elastic.publish_state(store, prefix, state, world, r)
+                out = elastic.collect_state(store, prefix)
+                assert _flat_bytes(out) == src, f"world {world}"
+                state = out  # chain: reshard the resharded state
+        assert spy.writes == []
+
+    @pytest.mark.slow  # multi-rank checkpoint write via thread barrier
+    def test_matches_checkpoint_file_reshard_path(self, tmp_path):
+        from paddle_tpu.checkpoint.reshard import read_state
+        from paddle_tpu.checkpoint.writer import snapshot, write_step
+        from paddle_tpu.distributed.tcp_store import TCPStore
+        rng = np.random.RandomState(2)
+        state = _mixed_state(rng)
+        world = 4
+        ths = [threading.Thread(
+            target=write_step, args=(str(tmp_path), 1, snapshot(state)),
+            kwargs=dict(process_index=r, process_count=world))
+            for r in range(1, world)]
+        for t in ths:
+            t.start()
+        time.sleep(0.2)
+        step_dir = write_step(str(tmp_path), 1, snapshot(state),
+                              process_index=0, process_count=world)
+        for t in ths:
+            t.join(timeout=120)
+        file_state = read_state(step_dir)
+
+        store = TCPStore(is_master=True, world_size=1)
+        for r in range(world):
+            elastic.publish_state(store, "__elastic/t/gf", state, world, r)
+        mem_state = elastic.collect_state(store, "__elastic/t/gf")
+        assert _flat_bytes(mem_state) == _flat_bytes(file_state)
+
+    def test_crc_verification_rejects_corruption(self):
+        from paddle_tpu.checkpoint.layout import CheckpointIntegrityError
+        rng = np.random.RandomState(3)
+        state = {"w": rng.randn(8, 8).astype(np.float32)}
+        store = MemStore()
+        elastic.publish_state(store, "p", state, 1, 0)
+        key = next(k for k in store.d if k.startswith("p/t/"))
+        store.d[key] = store.d[key][:-4] + b"\x00\x00\x00\x01"
+        with pytest.raises(CheckpointIntegrityError):
+            elastic.collect_state(store, "p")
+
+
+# ---------------- consensus listener -----------------------------------------
+class TestConsensusListener:
+    def test_all_ranks_stop_at_same_boundary(self):
+        store = MemStore()
+        lns = [ElasticResizeListener(store=store) for _ in range(4)]
+        lns[2].request(3, "test")
+        # at the notice step nobody stops (stop_at = step + 1) …
+        assert not any(ln.should_resize(step=5) for ln in lns)
+        # … at the next boundary EVERY rank stops, on the same verdict
+        assert all(ln.should_resize(step=6) for ln in lns)
+        assert {ln.target_world for ln in lns} == {3}
+        assert {ln.boundary_step for ln in lns} == {6}
+
+    def test_env_notice_channel(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_ELASTIC_RESIZE", "6")
+        ln = ElasticResizeListener(store=MemStore())
+        ln.should_resize(step=1)
+        assert ln.should_resize(step=2)
+        assert ln.target_world == 6
+
+    def test_file_notice_channel(self, tmp_path):
+        notice = tmp_path / "resize"
+        notice.write_text("2\n")
+        ln = ElasticResizeListener(store=MemStore(),
+                                   notice_file=str(notice))
+        ln.should_resize(step=1)
+        assert ln.should_resize(step=2)
+        assert ln.target_world == 2
+
+    def test_store_target_key_channel(self):
+        store = MemStore()
+        ln = ElasticResizeListener(store=store)
+        store.set(f"{elastic.elastic_prefix(0)}/target", b"4:operator")
+        ln.should_resize(step=1)
+        assert ln.should_resize(step=2)
+        assert ln.target_world == 4
+
+    def test_no_store_decides_locally(self):
+        ln = ElasticResizeListener(store=None)
+        ln._store_failed = True
+        ln.request(2)
+        assert ln.should_resize(step=7)
+        assert ln.target_world == 2
+
+    def test_generation_isolates_completed_resizes(self):
+        store = MemStore()
+        lns = [ElasticResizeListener(store=store) for _ in range(2)]
+        lns[0].request(1, "round1")
+        lns[0].should_resize(step=1)
+        assert all(ln.should_resize(step=2) for ln in lns)
+        # survivors bump the generation after the resize completes …
+        store.set("__elastic/0/gen", b"1")
+        late = ElasticResizeListener(store=store)
+        # … so a fresh listener can never replay the stale verdict
+        assert not late.should_resize(step=9)
+
+
+# ---------------- perform_resize end to end ----------------------------------
+class TestPerformResize:
+    def test_kill_2_of_8_continue_on_6(self):
+        """The drill in miniature: every old rank runs its side
+        concurrently; survivors assemble bit-identical state + remapped
+        data shards, departing ranks get None — zero file writes."""
+        from paddle_tpu.distributed.tcp_store import TCPStore
+        OLD, NEW = 8, 6
+        rng = np.random.RandomState(4)
+        state = {"w": rng.randn(24, 5).astype(np.float32),
+                 "m": rng.randn(24, 5).astype(np.float32)}
+        src = _flat_bytes(state)
+        docs = [rng.randint(1, 50, size=rng.randint(3, 20))
+                .astype(np.int32) for _ in range(48)]
+        pipes = _doc_pipes(docs, 8)
+        for p in pipes:
+            next(iter(p))
+        server = TCPStore(is_master=True, world_size=1)
+        clients = [TCPStore(host="127.0.0.1", port=server.port,
+                            is_master=False, world_size=1)
+                   for _ in range(OLD)]
+        results = [None] * OLD
+
+        def run(r):
+            results[r] = elastic.perform_resize(
+                clients[r], state=state,
+                data_state=pipes[r].state_dict(), world=OLD, rank=r,
+                new_world=NEW, generation=0, boundary_step=3, timeout=60)
+
+        with _Spy() as spy:
+            ths = [threading.Thread(target=run, args=(r,))
+                   for r in range(OLD)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=120)
+        assert spy.writes == [], "filesystem touched on the resize path"
+        for s, d in results[NEW:]:
+            assert s is None and d is None
+        for j, (s, d) in enumerate(results[:NEW]):
+            assert _flat_bytes(s) == src
+            assert d["stream"]["num_shards"] == NEW
+            assert d["stream"]["shard_index"] == j
+        # the resize wall landed in the goodput `reshard` bin
+        snap = goodput.get_ledger().snapshot()
+        assert snap["bins"]["reshard"] > 0
+        assert snap["bins"]["restart"] == 0
+        # rank 0 opened the next generation for the store listeners
+        assert server.get("__elastic/0/gen") == b"1"
+
+
+# ---------------- fleet: departed, not missing -------------------------------
+class FakeStore:
+    def __init__(self):
+        self.d = {}
+
+    def set(self, key, value):
+        self.d[key] = value
+
+    def get(self, key):
+        return self.d.get(key)
+
+
+class TestFleetDeparted:
+    def test_departed_rank_retires_cleanly(self):
+        reg = MetricsRegistry()
+        store = FakeStore()
+        pubs = [HeartbeatPublisher(store=store, rank=r, registry=reg)
+                for r in range(3)]
+        agg = FleetAggregator(store=store, world=3, stale_s=15,
+                              registry=reg)
+        stats = {"step_time_s": 0.1, "data_time_s": 0.0,
+                 "exposed_collective_time_s": 0.0}
+        for step in (1, 2):
+            for p in pubs:
+                p.publish(step, stats)
+            agg.poll_once()
+        # rank 2 leaves at the consensus resize boundary
+        pubs[2].depart(2, reason="resize")
+        roll = agg.poll_once(now=time.time() + 100)  # way past stale_s
+        assert roll["ranks"]["2"]["status"] == "departed"
+        assert reg.get("fleet_ranks_departed").value() == 1
+        # ranks 0/1 went silent for real and DO alarm; the planned exit
+        # of rank 2 never joins them in the missing count
+        assert roll["ranks"]["0"]["status"] == "missing"
+        assert roll["ranks"]["1"]["status"] == "missing"
+        assert reg.get("fleet_ranks_missing").value() == 2
+        assert 2 not in agg.stragglers
+        # departed is sticky across polls, not a one-shot
+        roll = agg.poll_once(now=time.time() + 200)
+        assert roll["ranks"]["2"]["status"] == "departed"
+        assert reg.get("fleet_ranks_departed").value() == 1
+
+
+# ---------------- goodput: the reshard bin -----------------------------------
+class TestGoodputReshard:
+    def test_reshard_in_bins(self):
+        assert "reshard" in BINS
+
+    def test_resize_gap_binned_reshard_not_restart(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_GOODPUT_RESIZE_AT",
+                           repr(time.time() - 2.0))
+        led = GoodputLedger(registry=MetricsRegistry())
+        snap = led.snapshot()
+        assert snap["bins"]["reshard"] == pytest.approx(2.0, abs=0.25)
+        assert snap["bins"]["restart"] == 0.0
+        # the pre-wall gap is inside the accounted span (sums hold)
+        assert snap["wall_s"] >= snap["bins"]["reshard"]
+        assert sum(snap["bins"].values()) == pytest.approx(
+            snap["wall_s"], rel=1e-3)
+
+    def test_in_process_resize_records_reshard(self):
+        led = GoodputLedger(registry=MetricsRegistry())
+        led.record("productive", 1.0)
+        led.record("reshard", 0.25)
+        snap = led.snapshot()
+        assert snap["bins"]["reshard"] == pytest.approx(0.25)
+        assert snap["bins"]["restart"] == 0.0
+
+
+# ---------------- offline trace rollup ---------------------------------------
+def _write_lane(path, pid, spans, marks=()):
+    anchor = (time.perf_counter_ns(), time.time_ns())
+    lines = [{"type": "header", "version": 1, "rank": 0, "pid": pid,
+              "clock": {"perf_ns": anchor[0], "unix_ns": anchor[1]}}]
+    for cat, name, t0, t1, args in spans:
+        lines.append({"type": "span", "cat": cat, "name": name,
+                      "ts": anchor[0] + t0, "dur": t1 - t0, "tid": 0,
+                      "args": args})
+    for cat, name, t0 in marks:
+        lines.append({"type": "mark", "cat": cat, "name": name,
+                      "ts": anchor[0] + t0, "tid": 0, "args": {}})
+    with open(path, "w") as f:
+        f.write("\n".join(json.dumps(ln) for ln in lines) + "\n")
+
+
+class TestTraceReshardRollup:
+    def test_elastic_span_bins_reshard(self, tmp_path):
+        import paddle_tpu.observability.trace as tr
+        s = int(1e9)
+        _write_lane(tmp_path / "trace_rank0_a.jsonl", 1, [
+            ("step", "train_step", 0, s,
+             {"step": 1, "step_time_s": 1.0}),
+            ("elastic", "elastic_resize_8to6", s, 2 * s,
+             {"world": 8, "new_world": 6}),
+            ("step", "train_step", 2 * s, 3 * s,
+             {"step": 2, "step_time_s": 1.0}),
+        ])
+        off = tr.merge(str(tmp_path), goodput=True)["goodput"]
+        assert off["bins"]["reshard"] == pytest.approx(1.0, rel=0.01)
+        assert off["bins"]["restart"] == 0.0
+        assert off["bins"]["productive"] == pytest.approx(2.0, rel=0.01)
+
+    def test_resized_lane_succession_gap_is_reshard(self, tmp_path):
+        """Same rank, two lanes (a resize-relaunch): the gap bins as
+        reshard when the successor carries a resize event — the offline
+        mirror of PADDLE_TPU_GOODPUT_RESIZE_AT — and restart otherwise."""
+        import paddle_tpu.observability.trace as tr
+        s = int(1e9)
+        _write_lane(tmp_path / "trace_rank0_a.jsonl", 1, [
+            ("step", "train_step", 0, s, {"step": 1, "step_time_s": 1.0}),
+        ])
+        _write_lane(tmp_path / "trace_rank0_b.jsonl", 2, [
+            ("step", "train_step", 3 * s, 4 * s,
+             {"step": 2, "step_time_s": 1.0}),
+        ], marks=[("elastic", "resize_boundary", 3 * s)])
+        off = tr.merge(str(tmp_path), goodput=True)["goodput"]
+        assert off["bins"]["reshard"] == pytest.approx(2.0, rel=0.01)
+        assert off["bins"]["restart"] == 0.0
+
+
+# ---------------- launcher classification ------------------------------------
+class TestLauncherResize:
+    def test_exit_codes_distinct(self):
+        from paddle_tpu.resilience.preemption import RESUMABLE_EXIT_CODE
+        assert RESIZE_EXIT_CODE == 83
+        assert RESIZE_EXIT_CODE != RESUMABLE_EXIT_CODE
+
+    def test_resize_target_world_reads_verdict(self):
+        from paddle_tpu.distributed.launch import _resize_target_world
+        store = MemStore()
+        assert _resize_target_world(store, 0) is None
+        store.set(f"{elastic.elastic_prefix(0, '0')}/stop",
+                  b"6:4:preempt")
+        assert _resize_target_world(store, 0) == 4
+        # after survivors bump the generation the verdict still resolves
+        store.set("__elastic/0/gen", b"1")
+        assert _resize_target_world(store, 0) == 4
+
+    def test_fit_resilience_stops_at_boundary(self):
+        """FitResilience + elastic listener: fit breaks at the agreed
+        step with resize bookkeeping set and NO checkpoint written."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn as nn
+        from paddle_tpu.resilience import FitResilience
+        store = MemStore()
+        ln = ElasticResizeListener(store=store)
+        model = pt.hapi.Model(nn.Linear(4, 2))
+        model.prepare(pt.optimizer.SGD(learning_rate=0.01,
+                                       parameters=model.parameters()),
+                      nn.MSELoss())
+        fr = FitResilience(preemption=False, elastic_listener=ln)
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(2, 4).astype(np.float32),
+                 rng.randn(2, 2).astype(np.float32)) for _ in range(8)]
+
+        class Trigger(pt.callbacks.Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if fr.global_step == 2:
+                    ln.request(1, "test")
+
+        model.fit(data, epochs=4, verbose=0, callbacks=[Trigger(), fr])
+        assert fr.resized
+        assert fr.resize_target == 1
+        assert fr.resize_boundary_step == 3  # the step AFTER the notice
+        assert not fr.preempted and fr.exit_code == 0
