@@ -431,15 +431,17 @@ def bench_serve():
     if on_tpu:
         model.bfloat16()
 
-    def run_trace(impl, ledger=True):
+    def run_trace(impl, ledger=True, **extra_kw):
         # ledger=False builds a disarmed engine (the hot path pays only
         # attribute reads on None) — the pair prices the request ledger
-        # for the serving_request_ledger_overhead_frac headline
+        # for the serving_request_ledger_overhead_frac headline;
+        # extra_kw rides through to the engine (quantize=, kv_dtype=)
         env_prev = os.environ.get("PADDLE_TPU_REQUEST_LEDGER")
         if not ledger:
             os.environ["PADDLE_TPU_REQUEST_LEDGER"] = "0"
         try:
-            engine = ServingEngine(model, attn_impl=impl, **eng_kw)
+            engine = ServingEngine(model, attn_impl=impl, **eng_kw,
+                                   **extra_kw)
         finally:
             if not ledger:
                 if env_prev is None:
@@ -581,6 +583,100 @@ def bench_serve():
     print(json.dumps({"shared_prefix": shared}), file=sys.stderr,
           flush=True)
     gc.collect()
+
+    # quantized + multi-tenant serving (ISSUE 20): the int8 weight-only
+    # twin of the primary trace prices quantization in tokens/sec, a
+    # greedy-parity probe prices it in quality, the doubled-batch int8
+    # KV engine must fit the full-precision engine's pool bytes, and an
+    # 8-slot LoRA engine serves one request per tenant from ONE
+    # compiled step.
+    int8_trace = run_trace(impls[0], quantize="int8_wo")
+    out["int8_wo"] = int8_trace
+    gc.collect()
+
+    def greedy_probe(**kw):
+        engine = ServingEngine(model, attn_impl=impls[0], **eng_kw, **kw)
+        engine.start()
+        prng = np.random.RandomState(3)
+        prompts = [list(prng.randint(1, cfg.vocab_size, 12))
+                   for _ in range(4)]
+        hs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        engine.drain(timeout=600)
+        outs = [tuple(h.result(timeout=5)["token_ids"]) for h in hs]
+        engine.shutdown()
+        return outs
+
+    base_greedy = greedy_probe()
+    int8_greedy = greedy_probe(quantize="int8_wo")
+    int8_match = float(np.mean([a == b for a, b
+                                in zip(base_greedy, int8_greedy)]))
+    out["int8_wo"]["greedy_match_frac"] = int8_match
+    gc.collect()
+
+    def pool_bytes(engine):
+        c = engine.cache
+        leaves = (list(c.k_pools) + list(c.v_pools)
+                  + list(c.k_scales) + list(c.v_scales))
+        return int(sum(x.nbytes for x in leaves))
+
+    ref_engine = ServingEngine(model, attn_impl=impls[0], **eng_kw)
+    ref_bytes = pool_bytes(ref_engine)
+    del ref_engine
+    kv_kw = dict(eng_kw)
+    kv_kw["max_batch"] = eng_kw["max_batch"] * 2
+    kv_kw["max_blocks"] = eng_kw["max_blocks"] * 2
+    kv_engine = ServingEngine(model, attn_impl=impls[0],
+                              kv_dtype="int8", **kv_kw)
+    kv_bytes = pool_bytes(kv_engine)
+    kv_engine.start()
+    prng = np.random.RandomState(4)
+    hs = [kv_engine.submit(list(prng.randint(1, cfg.vocab_size, 8)),
+                           max_new_tokens=4)
+          for _ in range(kv_kw["max_batch"])]
+    kv_engine.drain(timeout=600)
+    kv_served = int(sum(h.result(timeout=5)["num_generated"] > 0
+                        for h in hs))
+    kv_engine.shutdown()
+    kv_quant_max_batch = kv_kw["max_batch"] if kv_bytes <= ref_bytes \
+        else eng_kw["max_batch"]
+    out["kv_int8"] = {
+        "max_batch": kv_quant_max_batch, "served": kv_served,
+        "pool_bytes": kv_bytes, "full_precision_pool_bytes": ref_bytes}
+    print(json.dumps({"kv_int8": out["kv_int8"]}), file=sys.stderr,
+          flush=True)
+    gc.collect()
+
+    from paddle_tpu import tuning
+    lora_model = LlamaForCausalLM(cfg)
+    lora_model.eval()
+    if on_tpu:
+        lora_model.bfloat16()
+    tuning.apply_lora(lora_model, tuning.LoRAConfig(rank=4), n_slots=8)
+    lora_engine = ServingEngine(lora_model, attn_impl=impls[0],
+                                quantize="int8_wo", **eng_kw)
+    prng = np.random.RandomState(5)
+    for s in range(1, 9):
+        state = {k: (prng.randn(*v.shape[1:]) * 0.01).astype(np.float32)
+                 for k, v in lora_engine._st.items()
+                 if k.rsplit(".", 1)[-1].startswith("lora_")}
+        lora_engine.load_adapter(s, state, name=f"tenant-{s}")
+    lora_engine.start()
+    hs = [lora_engine.submit(list(prng.randint(1, cfg.vocab_size, 8)),
+                             max_new_tokens=4, adapter_id=s)
+          for s in range(1, 9)]
+    lora_engine.drain(timeout=600)
+    adapters_served = int(sum(h.result(timeout=5)["num_generated"] > 0
+                              for h in hs))
+    lora_stats = lora_engine.stats()
+    lora_engine.shutdown()
+    out["lora"] = {"slots": lora_stats["adapters"]["slots"],
+                   "loaded": lora_stats["adapters"]["loaded"],
+                   "served": adapters_served,
+                   "step_compiles": lora_stats["step_compiles"]}
+    print(json.dumps({"int8_wo": out["int8_wo"], "lora": out["lora"]}),
+          file=sys.stderr, flush=True)
+    gc.collect()
+
     primary = out[impls[0]]
     # flatten the primary impl's numbers at the top level (the committed
     # BENCH_r0*.json "parsed" shape earlier rounds gated on)
@@ -616,6 +712,15 @@ def bench_serve():
                       f"serving_request_ledger_overhead_frac{sfx}",
                       "value": out["ledger_overhead_frac"],
                       "unit": "fraction"}))
+    print(json.dumps({"metric": f"serving_int8_tokens_per_sec{sfx}",
+                      "value": int8_trace["tokens_per_sec"],
+                      "unit": "tokens/sec"}))
+    print(json.dumps({"metric": f"serving_kv_quant_max_batch{sfx}",
+                      "value": kv_quant_max_batch,
+                      "unit": "sequences"}))
+    print(json.dumps({"metric": f"serving_adapters_served{sfx}",
+                      "value": adapters_served,
+                      "unit": "adapters"}))
     return out
 
 
@@ -1532,6 +1637,13 @@ REPORT_HIGHER_BETTER = {
     # cache-on/cache-off effective-throughput ratio on the same trace
     "serving_prefix_cache_hit_rate",
     "serving_shared_prefix_speedup",
+    # quantized + multi-tenant serving (ISSUE 20): int8 weight-only
+    # decode rate on the primary Poisson trace, the batch the int8 KV
+    # cache sustains inside the full-precision engine's pool bytes,
+    # and the tenants served concurrently from one compiled step
+    "serving_int8_tokens_per_sec",
+    "serving_kv_quant_max_batch",
+    "serving_adapters_served",
 }
 REPORT_LOWER_BETTER = {"step_ms", "layer_step_ms",
                        # step-glue fusion/overlap trajectory (ISSUE 7):

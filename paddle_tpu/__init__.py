@@ -46,6 +46,7 @@ from paddle_tpu import observability  # noqa: F401
 from paddle_tpu import vision  # noqa: F401
 from paddle_tpu import sparse  # noqa: F401
 from paddle_tpu import quantization  # noqa: F401
+from paddle_tpu import tuning  # noqa: F401
 from paddle_tpu import incubate  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import serving  # noqa: F401

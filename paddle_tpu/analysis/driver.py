@@ -448,6 +448,37 @@ def _geo_serving_mp2():
             "leaf_names": None, "gather_ok": False}
 
 
+def _geo_serving_mp2_int8():
+    """The quantized tensor-parallel unified serving step (ISSUE 20):
+    same tiny mpu Llama and {mp: 2} mesh as ``serving_mp2`` but with
+    ``quantize="int8_wo"`` — int8 weight values + f32 scales sharded in
+    place of the bf16 leaves, dequantized inside the trace. The pinned
+    fact: dequant is LOCAL, so the mp-axis comm bytes must NOT grow
+    over the bf16 geometry's ledger."""
+    import jax
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.serving import ServingEngine
+
+    if jax.device_count() < 2:
+        raise RuntimeError("needs >= 2 devices for the mp=2 mesh")
+    mesh = dist.init_mesh({"mp": 2}, devices=jax.devices()[:2])
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=448,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=512,
+        tie_word_embeddings=True, tensor_parallel=True)
+    model, _ = _tiny_llama(cfg=cfg)
+    engine = ServingEngine(model, max_batch=2, max_blocks=16,
+                           block_size=4, prefill_chunk=8,
+                           attn_impl="gather", mesh=mesh,
+                           quantize="int8_wo")
+    lowered = engine._lowered_step()
+    return {"hlo": lowered.compile().as_text(), "mesh": mesh,
+            "leaf_names": None, "gather_ok": False}
+
+
 #: label -> builder; labels are baseline keys — NEVER rename casually
 #: (a rename orphans the pinned ledger and reports everything as new)
 COMMPLAN_GEOMETRIES = (
@@ -460,6 +491,7 @@ COMMPLAN_GEOMETRIES = (
     ("ep", _geo_ep),
     ("serving", _geo_serving),
     ("serving_mp2", _geo_serving_mp2),
+    ("serving_mp2_int8", _geo_serving_mp2_int8),
 )
 
 

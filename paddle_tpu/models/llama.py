@@ -410,6 +410,34 @@ class LlamaAttention(nn.Layer):
         table_len = self.cfg.max_position_embeddings
         scale = 1.0 / math.sqrt(hd)
 
+        if cache.k_scale is not None:
+            # int8-KV pools (ISSUE 20): the step quantizes the fresh
+            # K/V per (token, head) and threads the scale pools
+            # alongside the value pools
+            def fq(qa, ka, va, kp, vp, ksc, vsc, bt, cu, ctx, sid, pos,
+                   ssq, sbk):
+                pidx = jnp.clip(pos.astype(jnp.int32), 0, table_len - 1)
+                cos, sin = _gather_rope(pidx[None, :], hd, theta,
+                                        str(qa.dtype), table_len)
+                cos, sin = cos[0], sin[0]
+                return pa.ragged_paged_attention_step(
+                    _rot_interleaved(qa, cos, sin),
+                    _rot_interleaved(ka, cos, sin), va, kp, vp,
+                    bt, cu, ctx, sid, pos, ssq, sbk, scale=scale,
+                    k_scale=ksc, v_scale=vsc)
+
+            out, kp2, vp2, ks2, vs2 = apply_op(
+                fq, q, k, v, cache.k_pool, cache.v_pool, cache.k_scale,
+                cache.v_scale, cache.block_tables, cache.cu_seqlens,
+                cache.context_lens, cache.seq_ids, cache.positions,
+                cache.step_seq, cache.step_blk,
+                op_name="ragged_paged_kv_attention_int8")
+            return self.o_proj(ops.reshape(out, [1, T, -1])), \
+                pa.RaggedLayerCache(
+                    kp2, vp2, cache.block_tables, cache.cu_seqlens,
+                    cache.context_lens, cache.seq_ids, cache.positions,
+                    cache.step_seq, cache.step_blk, ks2, vs2)
+
         def f(qa, ka, va, kp, vp, bt, cu, ctx, sid, pos, ssq, sbk):
             pidx = jnp.clip(pos.astype(jnp.int32), 0, table_len - 1)
             cos, sin = _gather_rope(pidx[None, :], hd, theta,

@@ -156,6 +156,8 @@ class RequestRecord:
     arrival_s: float                  # perf_counter clock
     prompt_len: int
     max_new_tokens: int
+    #: LoRA tenant slot the request decoded against (0 = base model)
+    adapter_id: int = 0
     state: str = "queued"             # queued|running|done|failed
     queue_wait_s: Optional[float] = None
     prefill_chunks: int = 0
@@ -189,6 +191,7 @@ class RequestRecord:
             "state": self.state,
             "prompt_len": self.prompt_len,
             "max_new_tokens": self.max_new_tokens,
+            "adapter_id": self.adapter_id,
             "queue_wait_s": r6(self.queue_wait_s),
             "prefill_chunks": self.prefill_chunks,
             "prefilled_tokens": self.prefilled_tokens,
@@ -253,7 +256,8 @@ class RequestLedger:
         rec = RequestRecord(
             req_id=req.req_id, trace_id=req.trace_id,
             arrival_s=req.arrival_time, prompt_len=len(req.prompt_tokens),
-            max_new_tokens=req.max_new_tokens)
+            max_new_tokens=req.max_new_tokens,
+            adapter_id=getattr(req, "adapter_id", 0))
         with self._lock:
             self._inflight[req.req_id] = rec
         return rec

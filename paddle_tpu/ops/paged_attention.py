@@ -54,7 +54,8 @@ import jax.numpy as jnp
 __all__ = ["PagedLayerCache", "RaggedLayerCache", "write_to_pool",
            "write_tokens_to_pool", "gather_pool", "paged_attention_step",
            "ragged_gather_attention", "ragged_paged_attention_step",
-           "paged_attention_impl", "impl_override", "mesh_override"]
+           "paged_attention_impl", "impl_override", "mesh_override",
+           "quantize_kv_slots", "write_kv_scales_to_pool"]
 
 
 class PagedLayerCache(NamedTuple):
@@ -167,6 +168,10 @@ class RaggedLayerCache(NamedTuple):
     positions: object     # [T] int32 absolute position per token
     step_seq: object      # [num_q_tiles, max_steps] int32 kernel work map
     step_blk: object      # [num_q_tiles, max_steps] int32 kernel work map
+    # int8-KV quantization (ISSUE 20): per-token-slot, per-head dequant
+    # multipliers paged like the pools; None on unquantized engines
+    k_scale: object = None  # [num_blocks + 1, block_size, n_kv] f32
+    v_scale: object = None  # [num_blocks + 1, block_size, n_kv] f32
 
 
 # thread-local: two engines may trace their unified steps concurrently
@@ -250,8 +255,35 @@ def write_tokens_to_pool(pool, new, block_tables, seq_ids, positions):
     return pool.at[phys, slot].set(new.astype(pool.dtype))
 
 
+def quantize_kv_slots(x):
+    """Symmetric per-token, per-head int8 quantization of KV rows:
+    ``x [..., n_kv, hd]`` → ``(q int8 [..., n_kv, hd], scale f32
+    [..., n_kv])`` with scale = absmax/127 (the dequant multiplier).
+    The granularity matches the paged scale pools — one scalar per
+    ``(token slot, kv head)`` — so dequantization is a broadcast
+    multiply XLA fuses into the attention reads."""
+    f = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(f), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(f / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def write_kv_scales_to_pool(scale_pool, scales, block_tables, seq_ids,
+                            positions):
+    """Scatter per-token dequant ``scales`` [T, n_kv] into the paged
+    scale pool at the same (physical block, slot) the quantized values
+    landed in — padding redirects to the null block like the values."""
+    bs, nblk = scale_pool.shape[1], block_tables.shape[1]
+    blk = jnp.clip(positions.astype(jnp.int32) // bs, 0, nblk - 1)
+    phys = block_tables[seq_ids, blk]
+    slot = jnp.where(phys == 0, 0, positions.astype(jnp.int32) % bs)
+    return scale_pool.at[phys, slot].set(scales.astype(scale_pool.dtype))
+
+
 def ragged_gather_attention(q, k_pool, v_pool, block_tables, seq_ids,
-                            positions, *, scale):
+                            positions, *, scale, k_scale=None,
+                            v_scale=None):
     """Token-packed GQA attention via the XLA-gather fallback: gather
     every sequence's whole padded context, pick each token's row, dense
     masked softmax. Semantically identical to the rpa kernel (the parity
@@ -263,6 +295,13 @@ def ragged_gather_attention(q, k_pool, v_pool, block_tables, seq_ids,
     vals = gather_pool(v_pool, block_tables)
     kt = keys[seq_ids]                         # [T, L, n_kv, hd]
     vt = vals[seq_ids]
+    if k_scale is not None:
+        # int8 pools: dequantize the gathered context in f32 (the
+        # scale pools page/gather identically to the value pools)
+        ksc = gather_pool(k_scale, block_tables)[seq_ids]  # [T, L, n_kv]
+        vsc = gather_pool(v_scale, block_tables)[seq_ids]
+        kt = kt.astype(jnp.float32) * ksc[..., None]
+        vt = vt.astype(jnp.float32) * vsc[..., None]
     L = kt.shape[1]
     qg = q.reshape(T, n_kv, grp, hd)
     s = jnp.einsum("tkgh,tlkh->tkgl", qg.astype(jnp.float32),
@@ -279,7 +318,7 @@ def ragged_gather_attention(q, k_pool, v_pool, block_tables, seq_ids,
 def ragged_paged_attention_step(q, k, v, k_pool, v_pool, block_tables,
                                 cu_seqlens, context_lens, seq_ids,
                                 positions, step_seq, step_blk, *,
-                                scale=None):
+                                scale=None, k_scale=None, v_scale=None):
     """One unified serving step over the token-packed ragged layout.
 
     ``q`` [T, n_heads, hd] and ``k``/``v`` [T, n_kv, hd] are the
@@ -290,10 +329,34 @@ def ragged_paged_attention_step(q, k, v, k_pool, v_pool, block_tables,
     fallback. Returns ``(out [T, n_heads*hd], k_pool', v_pool')``;
     outputs at padding tokens are garbage (gather) or 0 (rpa) and must
     be discarded by the caller either way.
+
+    With int8-KV pools (``k_scale``/``v_scale`` scale pools given), the
+    new K/V are quantized per (token, head) before the scatter and the
+    read path dequantizes on the fly; the return grows to
+    ``(out, k_pool', v_pool', k_scale', v_scale')``. Only the gather
+    path reads quantized pools (the Pallas kernel streams raw pages —
+    the engine forces ``gather`` for int8 KV).
     """
     T, n_heads, hd = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(hd)
+    if k_scale is not None:
+        kq, ks = quantize_kv_slots(k)
+        vq, vs = quantize_kv_slots(v)
+        k_pool = write_tokens_to_pool(k_pool, kq, block_tables, seq_ids,
+                                      positions)
+        v_pool = write_tokens_to_pool(v_pool, vq, block_tables, seq_ids,
+                                      positions)
+        k_scale = write_kv_scales_to_pool(k_scale, ks, block_tables,
+                                          seq_ids, positions)
+        v_scale = write_kv_scales_to_pool(v_scale, vs, block_tables,
+                                          seq_ids, positions)
+        out = ragged_gather_attention(
+            q, k_pool, v_pool, block_tables, seq_ids, positions,
+            scale=scale, k_scale=k_scale, v_scale=v_scale)
+        out = out.astype(q.dtype)
+        return (out.reshape(T, n_heads * hd), k_pool, v_pool,
+                k_scale, v_scale)
     k_pool = write_tokens_to_pool(k_pool, k, block_tables, seq_ids,
                                   positions)
     v_pool = write_tokens_to_pool(v_pool, v, block_tables, seq_ids,

@@ -19,9 +19,16 @@ from .wrapper import QuantedLinear, QuantedConv2D  # noqa: F401
 from .int8 import (  # noqa: F401
     Int8Linear, Int8Conv2D, convert_to_int8,
 )
+from .weight_only import (  # noqa: F401
+    QuantizedLeaf, WEIGHT_MODES, quantize_state, quantized_bytes,
+    calibrate, calibration_from_checkpoint, quantization_metrics,
+)
 
 __all__ = ["QuantConfig", "SingleLayerConfig", "QuanterFactory", "quanter",
            "BaseQuanter", "BaseObserver", "FakeQuanterWithAbsMaxObserver",
            "AbsmaxObserver", "PerChannelAbsmaxObserver", "QAT", "PTQ",
            "QuantedLinear",
-           "QuantedConv2D", "Int8Linear", "Int8Conv2D", "convert_to_int8"]
+           "QuantedConv2D", "Int8Linear", "Int8Conv2D", "convert_to_int8",
+           "QuantizedLeaf", "WEIGHT_MODES", "quantize_state",
+           "quantized_bytes", "calibrate", "calibration_from_checkpoint",
+           "quantization_metrics"]

@@ -1,0 +1,328 @@
+"""Weight-only PTQ for serving (ISSUE 20): ``(values, scales)`` leaves.
+
+The QAT/PTQ framework in this package rewrites *layers* (fake-quant
+wrappers, ``convert_to_int8``). Serving wants something orthogonal: the
+``ServingEngine`` threads a flat functional state dict through ONE
+compiled step, so quantization has to happen at the *leaf* level —
+replace a selected weight leaf with a :class:`QuantizedLeaf` pytree node
+holding ``(int8 values, f32 per-channel scales)`` and dequantize INSIDE
+the traced step, right before ``swap_state`` hands the weights to the
+unmodified model. XLA fuses the dequant multiply into the consuming
+matmul, the model code never changes, and the Megatron sharding specs
+keep working (``values`` shard exactly like the original 2-D weight,
+the 1-D scales like its channel axis).
+
+Calibration comes from the numerics observatory's per-tap range
+sketches (PR 14): a training checkpoint's ``"numerics"`` aux key, or a
+one-batch :func:`calibrate` pass when no checkpoint exists. Sketches
+gate *sensitivity*: a layer whose activation absmax/p99 ratio exceeds
+``PADDLE_TPU_QUANT_OUTLIER_RATIO`` keeps its original dtype (outlier-
+heavy activations are where weight-only quantization bites hardest).
+
+Modes (``WEIGHT_MODES``): ``int8_wo`` — symmetric per-channel int8,
+scale = absmax/127; ``fp8_wo`` — ``float8_e4m3fn`` storage, scale =
+absmax/448 (gated on the running jax exposing the dtype).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["QuantizedLeaf", "WEIGHT_MODES", "quantize_state",
+           "quantized_bytes", "calibrate", "calibration_from_checkpoint",
+           "sensitive_params", "quantization_metrics"]
+
+#: mode -> (storage dtype name, max representable magnitude of the grid)
+WEIGHT_MODES = {
+    "int8_wo": ("int8", 127.0),
+    "fp8_wo": ("float8_e4m3fn", 448.0),
+}
+
+#: projection weights quantized by default (Llama-family); everything
+#: else (norms, embeddings, adapters) keeps its dtype
+_DEFAULT_TARGET_SUFFIXES = (
+    "q_proj.weight", "k_proj.weight", "v_proj.weight", "o_proj.weight",
+    "gate_proj.weight", "up_proj.weight", "down_proj.weight",
+    "lm_head.weight",
+)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedLeaf:
+    """A quantized weight living where a float leaf used to.
+
+    Registered as a pytree node, so ``jax.jit`` flattens it into its
+    ``(values, scale)`` arrays transparently — the engine's state dict
+    keeps its keys, ``tree_bytes`` counts the real storage, and
+    ``device_put`` per child lets values and scales shard differently.
+    ``axis`` is the channel axis the per-channel scales vary along;
+    ``orig_dtype`` is the logical dtype :meth:`dequantize` restores.
+    """
+
+    __slots__ = ("q", "scale", "axis", "orig_dtype")
+
+    def __init__(self, q, scale, axis: int, orig_dtype: str):
+        self.q = q
+        self.scale = scale
+        self.axis = int(axis)
+        self.orig_dtype = str(orig_dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), (self.axis, self.orig_dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scale = children
+        return cls(q, scale, aux[0], aux[1])
+
+    # logical view: code that sniffs a state leaf's shape/dtype (the
+    # load_weights dtype guard, stats()) sees the pre-quantization tensor
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self.orig_dtype)
+
+    @property
+    def storage_dtype(self):
+        return jnp.dtype(self.q.dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.nbytes) + int(self.scale.nbytes)
+
+    def dequantize(self):
+        """``values * scales`` back in ``orig_dtype`` — called inside
+        the compiled step, where XLA fuses it into the consumer."""
+        bshape = [1] * self.q.ndim
+        bshape[self.axis] = -1
+        w = self.q.astype(jnp.float32) * self.scale.reshape(bshape)
+        return w.astype(self.orig_dtype)
+
+    def __repr__(self):
+        return (f"QuantizedLeaf(shape={tuple(self.q.shape)}, "
+                f"storage={self.q.dtype}, axis={self.axis}, "
+                f"orig={self.orig_dtype})")
+
+
+def _storage_dtype(mode: str):
+    name, bound = WEIGHT_MODES[mode]
+    dt = getattr(jnp, name, None) if name.startswith("float8") else \
+        jnp.dtype(name)
+    if dt is None:
+        raise RuntimeError(
+            f"weight mode {mode!r} needs jnp.{name}, which this jax "
+            f"does not provide — use int8_wo")
+    return jnp.dtype(dt), bound
+
+
+def quantize_leaf(arr, mode: str, axis: int = 1) -> QuantizedLeaf:
+    """Symmetric per-channel quantization of one 2-D weight: absmax
+    grid along ``axis`` (the output-channel axis of an ``[in, out]``
+    projection), computed in f32."""
+    dt, bound = _storage_dtype(mode)
+    f = jnp.asarray(arr).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(f), axis=tuple(
+        i for i in range(f.ndim) if i != axis))
+    scale = jnp.maximum(absmax, 1e-12) / bound
+    bshape = [1] * f.ndim
+    bshape[axis] = -1
+    g = f / scale.reshape(bshape)
+    if dt == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.round(g), -bound, bound).astype(jnp.int8)
+    else:
+        q = g.astype(dt)
+    return QuantizedLeaf(q, scale.astype(jnp.float32), axis,
+                         str(jnp.asarray(arr).dtype))
+
+
+# -- calibration --------------------------------------------------------------
+
+def _tap_for_param(name: str) -> Optional[str]:
+    """Map a qualified param name to the numerics tap whose range sketch
+    judges its layer's activation health (``layers.{i}.attn`` for the
+    attention projections, ``layers.{i}.mlp_act`` for the MLP)."""
+    m = re.search(r"layers\.(\d+)\.", name)
+    if m is None:
+        return None
+    i = m.group(1)
+    leaf = name.rsplit(".", 2)[-2] if name.endswith(".weight") else ""
+    if leaf in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        return f"layers.{i}.attn"
+    if leaf in ("gate_proj", "up_proj", "down_proj"):
+        return f"layers.{i}.mlp_act"
+    return None
+
+
+def _outlier_ratio_limit() -> float:
+    try:
+        return float(os.environ.get(
+            "PADDLE_TPU_QUANT_OUTLIER_RATIO", "32.0"))
+    except ValueError:
+        return 32.0
+
+
+def sensitive_params(names, calibration: Optional[dict],
+                     ratio: Optional[float] = None) -> set:
+    """Param names whose layer's calibration sketch shows outlier-heavy
+    activations (absmax/p99 past the ratio) — left unquantized.
+    ``calibration`` is a ``{"version": 1, "taps": {...}}`` summary
+    (checkpoint ``"numerics"`` aux / :func:`calibrate`); None gates
+    nothing."""
+    if not calibration:
+        return set()
+    taps = calibration.get("taps") or {}
+    limit = _outlier_ratio_limit() if ratio is None else float(ratio)
+    out = set()
+    for name in names:
+        tap = _tap_for_param(name)
+        sk = taps.get(tap) if tap else None
+        if not sk:
+            continue
+        p99 = float(sk.get("p99") or 0.0)
+        absmax = float(sk.get("absmax") or 0.0)
+        if p99 > 0.0 and absmax / p99 > limit:
+            out.add(name)
+    return out
+
+
+def calibrate(model, input_ids) -> dict:
+    """One-batch calibration fallback when no training checkpoint's
+    ``"numerics"`` aux exists: run a single eager forward under the
+    numerics collector and shape the tap abs-maxes like the
+    observatory's sketch summary (a single sample has no distribution,
+    so p50/p99 collapse to the absmax)."""
+    from paddle_tpu.core.autograd import no_grad
+    from paddle_tpu.observability import numerics
+
+    with no_grad(), numerics.collect(True) as col:
+        model(input_ids)
+    taps = {}
+    for name, st in col.taps.items():
+        absmax = float(jax.device_get(st[0]))
+        taps[name] = {"n": 1, "absmax": absmax, "p50": absmax,
+                      "p99": absmax, "buckets": {}}
+    return {"version": 1, "taps": taps}
+
+
+def calibration_from_checkpoint(path: str,
+                                step: Optional[int] = None
+                                ) -> Optional[dict]:
+    """The ``"numerics"`` aux a training run committed alongside its
+    weights (``FitResilience`` exports the observatory's sketches every
+    checkpoint) — or None when the checkpoint predates the observatory."""
+    import os as _os
+
+    from paddle_tpu.checkpoint import load_state_dir
+    if not _os.path.isdir(path):
+        from paddle_tpu.framework.io import load
+        state = load(path)
+    else:
+        state = load_state_dir(path, step=step)
+    if isinstance(state, dict):
+        doc = state.get("numerics")
+        if isinstance(doc, dict) and doc.get("taps"):
+            return doc
+    return None
+
+
+# -- state-dict quantization --------------------------------------------------
+
+def default_target(name: str, arr) -> bool:
+    """The default quantization surface: 2-D matmul projection weights.
+    Embeddings stay (they are a gather, and the decode paths sniff their
+    dtype); norms/biases/adapters stay (tiny, range-critical)."""
+    if getattr(arr, "ndim", 0) != 2:
+        return False
+    return name.endswith(_DEFAULT_TARGET_SUFFIXES)
+
+
+def quantize_state(state: Dict[str, object], mode: str, *,
+                   calibration: Optional[dict] = None,
+                   targets=None, axis: int = 1) -> Dict[str, object]:
+    """Quantize the targeted leaves of a functional state dict.
+
+    Returns a NEW dict whose selected leaves are :class:`QuantizedLeaf`
+    (keys unchanged — ``swap_state`` name validation still holds).
+    ``targets`` overrides the default name/shape predicate;
+    ``calibration`` applies the sketch-based sensitivity gate."""
+    if mode not in WEIGHT_MODES:
+        raise ValueError(
+            f"quantize mode {mode!r} (want one of "
+            f"{sorted(WEIGHT_MODES)})")
+    pred = targets or default_target
+    picked = [k for k, v in state.items()
+              if not isinstance(v, QuantizedLeaf) and pred(k, v)]
+    skip = sensitive_params(picked, calibration)
+    out = dict(state)
+    for k in picked:
+        if k in skip:
+            continue
+        out[k] = quantize_leaf(state[k], mode, axis=axis)
+    m = quantization_metrics()
+    m["weight_leaves"].set(sum(
+        1 for v in out.values() if isinstance(v, QuantizedLeaf)))
+    m["skipped_leaves"].set(len(skip))
+    m["weight_bytes"].set(quantized_bytes(out))
+    return out
+
+
+def quantized_bytes(state: Dict[str, object]) -> int:
+    """Bytes of quantized weight storage (values + scales) in a state."""
+    return sum(v.nbytes for v in state.values()
+               if isinstance(v, QuantizedLeaf))
+
+
+def shard_quantized(leaf: QuantizedLeaf, mesh, spec):
+    """Tensor-parallel placement of one quantized leaf: values carry the
+    original weight's PartitionSpec, the 1-D scales the spec's entry at
+    the channel axis (column-parallel → sharded scales, row-parallel →
+    replicated — dequant stays collective-free either way)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    parts = tuple(spec) if spec is not None else ()
+    scale_part = parts[leaf.axis] if leaf.axis < len(parts) else None
+    q = jax.device_put(leaf.q, NamedSharding(
+        mesh, spec if spec is not None else PartitionSpec()))
+    s = jax.device_put(leaf.scale, NamedSharding(
+        mesh, PartitionSpec(scale_part)))
+    return QuantizedLeaf(q, s, leaf.axis, leaf.orig_dtype)
+
+
+# -- metrics ------------------------------------------------------------------
+
+_quant_metrics_cache = None
+
+
+def quantization_metrics(registry=None) -> dict:
+    """The ``quantization_*`` metric families (created on first use) —
+    published by :func:`quantize_state` and the serving engine's KV
+    quantization; names documented in docs/QUANTIZATION.md."""
+    global _quant_metrics_cache
+    if registry is None and _quant_metrics_cache is not None:
+        return _quant_metrics_cache
+    from paddle_tpu.observability import get_registry
+    reg = registry if registry is not None else get_registry()
+    d = {
+        "weight_leaves": reg.gauge(
+            "quantization_weight_leaves",
+            "model state leaves stored as (values, scales) pairs"),
+        "skipped_leaves": reg.gauge(
+            "quantization_skipped_leaves",
+            "target leaves left unquantized by the calibration "
+            "sensitivity gate (activation absmax/p99 past the ratio)"),
+        "weight_bytes": reg.gauge(
+            "quantization_weight_bytes",
+            "bytes of quantized weight storage, values + scales"),
+        "kv_scale_bytes": reg.gauge(
+            "quantization_kv_scale_bytes",
+            "bytes of per-slot KV-cache dequantization scales"),
+    }
+    if registry is None:
+        _quant_metrics_cache = d
+    return d

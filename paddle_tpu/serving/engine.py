@@ -140,6 +140,20 @@ def _build_serving_metrics(reg) -> dict:
             "serving_prefix_cached_token_fraction",
             "cumulative fraction of prompt tokens served from the prefix "
             "cache instead of being prefilled"),
+        # multi-tenant LoRA slots (ISSUE 20)
+        "adapter_slots": reg.gauge(
+            "serving_adapter_slots",
+            "LoRA tenant slots the engine was built with (0 = plain "
+            "single-model engine)"),
+        "adapter_slots_loaded": reg.gauge(
+            "serving_adapter_slots_loaded",
+            "tenant slots currently holding a loaded adapter"),
+        "adapter_requests": reg.counter(
+            "serving_adapter_requests_total",
+            "requests dispatched to a non-base adapter slot, by adapter"),
+        "adapter_loads": reg.counter(
+            "serving_adapter_loads_total",
+            "adapter installs via load_adapter (no-retrace slot writes)"),
     }
 
 
@@ -197,7 +211,8 @@ class ServingEngine:
                  warm_start_from: Optional[str] = None,
                  attn_impl: Optional[str] = None,
                  prefix_cache: Optional[bool] = None,
-                 mesh=None):
+                 mesh=None, quantize: Optional[str] = None,
+                 kv_dtype: Optional[str] = None, calibration=None):
         import os
 
         from paddle_tpu.jit.functional import functional_state
@@ -205,6 +220,9 @@ class ServingEngine:
         from paddle_tpu.ops import paged_attention as pa
         from paddle_tpu.ops.pallas.ragged_paged_attention import (
             DEFAULT_TILE_Q, build_step_maps, rpa_max_steps, rpa_tile_q)
+        from paddle_tpu.quantization.weight_only import (
+            WEIGHT_MODES, calibration_from_checkpoint, quantization_metrics,
+            quantize_state)
         self._build_step_maps = build_step_maps  # hot path: import once
 
         model.eval()
@@ -216,6 +234,41 @@ class ServingEngine:
         self._st = {**train, **frozen, **buffers}
         self._backbone, self._project, dtype = decode_surfaces(
             model, self._st)
+        # weight-only quantization (ISSUE 20): replace the projection
+        # leaves with (values, scales) pairs dequantized inside the
+        # compiled step. After decode_surfaces (which sniffs the embed
+        # leaf's dtype), before _shard_state (which places the pairs).
+        self.quantize = quantize or \
+            os.environ.get("PADDLE_TPU_QUANT_WEIGHTS") or None
+        if self.quantize is not None and self.quantize not in WEIGHT_MODES:
+            raise ValueError(
+                f"quantize={self.quantize!r} (want one of "
+                f"{sorted(WEIGHT_MODES)})")
+        if isinstance(calibration, str):
+            calibration = calibration_from_checkpoint(calibration)
+        self._calibration = calibration
+        if self.quantize is not None:
+            self._st = quantize_state(self._st, self.quantize,
+                                      calibration=self._calibration)
+            self._weight_dtype = WEIGHT_MODES[self.quantize][0]
+        else:
+            self._weight_dtype = str(jnp.dtype(dtype))
+        # paged-KV quantization (ISSUE 20): int8 pool blocks +
+        # per-(slot, head) scale pools, dequantized in the gather read
+        self.kv_dtype = kv_dtype or \
+            os.environ.get("PADDLE_TPU_QUANT_KV") or None
+        if self.kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype={self.kv_dtype!r} (want None or 'int8')")
+        # multi-tenant LoRA slots (ISSUE 20): a model prepared with
+        # tuning.apply_lora(n_slots=N) carries stacked [N+1, ...]
+        # adapter params; batch rows dispatch by slot id, row 0 = base
+        self.n_adapter_slots = int(getattr(model, "_lora_slots", 0) or 0)
+        self._adapters = {}  # slot -> adapter name
+        # per-slot load generation: seeds the prefix-cache chain so KV
+        # computed under one adapter (or one load of a slot) never
+        # answers a request decoding under another
+        self._adapter_gen = {}  # slot -> int
 
         nl = cfg.num_hidden_layers
         n_kv = cfg.num_key_value_heads
@@ -272,9 +325,14 @@ class ServingEngine:
             max_blocks_per_seq = min(max_blocks, -(-max_pos // block_size))
         self.cache = PagedKVCache(nl, max_blocks, block_size, n_kv, hd,
                                   max_blocks_per_seq, dtype,
-                                  prefix_cache=self.prefix_cache_enabled)
+                                  prefix_cache=self.prefix_cache_enabled,
+                                  kv_dtype=self.kv_dtype)
         if self.mesh is not None:
             self.cache.shard_pools(self.mesh, self._mp_axis)
+        if self.kv_dtype is not None:
+            quantization_metrics()["kv_scale_bytes"].set(
+                sum(int(s.nbytes) for s in
+                    self.cache.k_scales + self.cache.v_scales))
         self.max_model_len = min(self.cache.max_seq_len, max_pos)
         self.max_batch = int(max_batch)
         self.prefill_chunk = int(prefill_chunk)
@@ -285,6 +343,13 @@ class ServingEngine:
         if self.attn_impl not in ("rpa", "gather"):
             raise ValueError(
                 f"attn_impl {self.attn_impl!r} (want rpa|gather)")
+        if self.kv_dtype is not None and self.attn_impl == "rpa":
+            # the Pallas kernel streams raw pages and knows nothing of
+            # the scale pools; int8 KV rides the gather read path
+            warnings.warn(
+                "kv_dtype='int8' forces attn_impl='gather' (the RPA "
+                "kernel reads unquantized pools)", RuntimeWarning)
+            self.attn_impl = "gather"
         # unified-step geometry: the flat token budget covers every
         # decode slot plus one full prefill chunk, rounded up to the RPA
         # kernel's q-tile height (autotunable on chip); max_steps is the
@@ -348,7 +413,7 @@ class ServingEngine:
 
     # -- weights -----------------------------------------------------------
     @staticmethod
-    def _load_into_model(model, path: str, step: Optional[int] = None):
+    def _read_checkpoint_state(path: str, step: Optional[int] = None):
         import os
         from paddle_tpu.framework.io import load
         if os.path.isdir(path):
@@ -361,7 +426,11 @@ class ServingEngine:
         # qualified param name, never a bare "model" dict)
         if isinstance(state, dict) and isinstance(state.get("model"), dict):
             state = state["model"]
-        model.set_state_dict(state)
+        return state
+
+    @classmethod
+    def _load_into_model(cls, model, path: str, step: Optional[int] = None):
+        model.set_state_dict(cls._read_checkpoint_state(path, step))
 
     def load_weights(self, path: str, step: Optional[int] = None):
         """Warm-start: swap in weights from a checkpoint — a training
@@ -373,8 +442,16 @@ class ServingEngine:
 
         Refuses while requests are in flight: their KV cache was computed
         under the old weights, and decoding on would silently garble the
-        rest of their output — ``drain()`` first."""
+        rest of their output — ``drain()`` first.
+
+        Dtype guard (ISSUE 20): every incoming leaf must land with the
+        dtype the compiled step was traced against (a quantized leaf's
+        LOGICAL dtype — the fresh weights are re-quantized afterwards).
+        A floating→floating mismatch is cast loudly; anything else
+        refuses with the leaf's name, so a bf16 checkpoint can never be
+        device_put as garbage bits into an f32/int8 engine."""
         from paddle_tpu.jit.functional import functional_state
+        from paddle_tpu.quantization.weight_only import quantize_state
         with self._lock:
             active = self.scheduler.num_running + self.scheduler.num_waiting
             if active:
@@ -382,9 +459,43 @@ class ServingEngine:
                     f"cannot swap weights with {active} request(s) in "
                     f"flight (their KV cache predates the new weights); "
                     f"drain() the engine first")
-            self._load_into_model(self.model, path, step)
+            # the guard must read the RAW checkpoint leaves: Layer
+            # set_value casts silently, so a post-load functional_state
+            # always looks clean even when the checkpoint was not
+            raw = self._read_checkpoint_state(path, step)
+            checked = {}
+            for k, v in raw.items():
+                arr = v.data if hasattr(v, "data") else v
+                exp = self._st.get(k)
+                if exp is not None:
+                    want = jnp.dtype(exp.dtype)  # QuantizedLeaf -> logical
+                    got = jnp.dtype(getattr(arr, "dtype",
+                                            np.asarray(arr).dtype))
+                    if got != want:
+                        if jnp.issubdtype(got, jnp.floating) and \
+                                jnp.issubdtype(want, jnp.floating):
+                            warnings.warn(
+                                f"load_weights: casting leaf '{k}' "
+                                f"{got} -> {want} to match the compiled "
+                                f"step", RuntimeWarning)
+                            arr = jnp.asarray(
+                                np.asarray(arr)).astype(want)
+                        else:
+                            raise ValueError(
+                                f"load_weights: leaf '{k}' is {got} but "
+                                f"the engine serves it as {want} — "
+                                f"refusing the checkpoint")
+                checked[k] = arr
+            self.model.set_state_dict(checked)
             train, frozen, buffers = functional_state(self.model)
-            self._st = {**train, **frozen, **buffers}
+            new = {**train, **frozen, **buffers}
+            if self.quantize is not None:
+                # same deterministic target set as at construction, so
+                # the step's input structure (and the one executable)
+                # is unchanged
+                new = quantize_state(new, self.quantize,
+                                     calibration=self._calibration)
+            self._st = new
             if self.mesh is not None:
                 self._shard_state()
 
@@ -398,42 +509,74 @@ class ServingEngine:
         from jax.sharding import NamedSharding, PartitionSpec
 
         from paddle_tpu.distributed import spec_of
+        from paddle_tpu.quantization.weight_only import (
+            QuantizedLeaf, shard_quantized)
 
         named = dict(self.model.named_parameters())
         for n, b in self.model.named_buffers():
             if b is not None:
                 named[n] = b
         rep = PartitionSpec()
-        self._st = {
-            k: jax.device_put(v, NamedSharding(
-                self.mesh, spec_of(named[k]) if k in named else rep))
-            for k, v in self._st.items()}
+        out = {}
+        for k, v in self._st.items():
+            spec = spec_of(named[k]) if k in named else rep
+            if isinstance(v, QuantizedLeaf):
+                # values carry the weight's spec, the 1-D scales its
+                # channel-axis entry (dequant stays collective-free)
+                out[k] = shard_quantized(v, self.mesh, spec)
+            else:
+                out[k] = jax.device_put(v, NamedSharding(self.mesh, spec))
+        self._st = out
 
     # -- the one compiled step ---------------------------------------------
     def _build_step(self, instrument: bool = False):
+        import contextlib
+
         from paddle_tpu.core.autograd import no_grad
         from paddle_tpu.core.tensor import Tensor
         from paddle_tpu.jit.functional import swap_state
         from paddle_tpu.observability import numerics
         from paddle_tpu.ops import paged_attention as pa
+        from paddle_tpu.quantization.weight_only import QuantizedLeaf
+        from paddle_tpu.tuning import lora
 
         model, backbone, project = self.model, self._backbone, self._project
         nl = self.model.cfg.num_hidden_layers
         impl = self.attn_impl
+        kv_quant = self.kv_dtype is not None
+        n_slots = self.n_adapter_slots
         tap_order = [] if instrument else None
 
-        def step(stt, tokens, k_pools, v_pools, bt, cu, ctx, sid, pos,
-                 ssq, sbk, last_idx):
+        def step(stt, tokens, k_pools, v_pools, k_scales, v_scales,
+                 bt, cu, ctx, sid, pos, ssq, sbk, last_idx, aid):
             # executes at trace time only — counting compiles is the
             # point (the compile-once guard tests read it)
             self.step_traces += 1  # analysis: allow(trace-attr-mutation)
-            caches = [pa.RaggedLayerCache(
-                Tensor(k_pools[i]), Tensor(v_pools[i]), Tensor(bt),
-                Tensor(cu), Tensor(ctx), Tensor(sid), Tensor(pos),
-                Tensor(ssq), Tensor(sbk)) for i in range(nl)]
+            # weight-only quantization: dequantize the (values, scales)
+            # leaves HERE, inside the trace, so XLA fuses the multiply
+            # into the consuming matmuls and swap_state sees plain
+            # arrays of the model's dtype
+            stt = {k: (v.dequantize() if isinstance(v, QuantizedLeaf)
+                       else v) for k, v in stt.items()}
+            if kv_quant:
+                caches = [pa.RaggedLayerCache(
+                    Tensor(k_pools[i]), Tensor(v_pools[i]), Tensor(bt),
+                    Tensor(cu), Tensor(ctx), Tensor(sid), Tensor(pos),
+                    Tensor(ssq), Tensor(sbk), Tensor(k_scales[i]),
+                    Tensor(v_scales[i])) for i in range(nl)]
+            else:
+                caches = [pa.RaggedLayerCache(
+                    Tensor(k_pools[i]), Tensor(v_pools[i]), Tensor(bt),
+                    Tensor(cu), Tensor(ctx), Tensor(sid), Tensor(pos),
+                    Tensor(ssq), Tensor(sbk)) for i in range(nl)]
+            # per-row LoRA dispatch: pin this step's token->slot ids for
+            # the adapter hooks traced inside the backbone call
+            adapters = (lora.adapter_ids(aid) if n_slots
+                        else contextlib.nullcontext())
             with numerics.collect(instrument) as col, no_grad(), \
                     swap_state(model, stt, collect_buffers=False), \
-                    pa.impl_override(impl), pa.mesh_override(self.mesh):
+                    pa.impl_override(impl), pa.mesh_override(self.mesh), \
+                    adapters:
                 h, new_caches = backbone(Tensor(tokens), caches=caches)
                 # logits at each sequence's LAST packed token (rows of
                 # empty metadata slots gather token 0 — discarded by the
@@ -442,7 +585,13 @@ class ServingEngine:
                 logits = project(hsel)             # [max_batch, 1, V]
             kps = tuple(c.k_pool.data for c in new_caches)
             vps = tuple(c.v_pool.data for c in new_caches)
-            out = logits.data[:, 0].astype(jnp.float32), kps, vps
+            if kv_quant:
+                kss = tuple(c.k_scale.data for c in new_caches)
+                vss = tuple(c.v_scale.data for c in new_caches)
+            else:
+                kss, vss = (), ()
+            out = (logits.data[:, 0].astype(jnp.float32), kps, vps,
+                   kss, vss)
             if not instrument:
                 return out
             # trace-time fill of the execution-order cell (jax pytrees
@@ -450,9 +599,10 @@ class ServingEngine:
             tap_order[:] = list(col.taps)
             return out + (col.taps,)
 
-        # donating the pools lets XLA update them in place on TPU; the
-        # CPU backend can't honor donation (harmless warning), so gate it
-        donate = (2, 3) if jax.default_backend() == "tpu" else ()
+        # donating the pools (and scale pools) lets XLA update them in
+        # place on TPU; the CPU backend can't honor donation (harmless
+        # warning), so gate it
+        donate = (2, 3, 4, 5) if jax.default_backend() == "tpu" else ()
         fn = jax.jit(step, donate_argnums=donate)
         return (fn, tap_order) if instrument else fn
 
@@ -497,15 +647,17 @@ class ServingEngine:
         sid = np.full((T,), S, np.int32)
         pos = np.zeros((T,), np.int32)
         last_idx = np.zeros((S,), np.int32)
+        aid = np.zeros((T,), np.int32)
         ssq, sbk = self._null_step_maps
         with self._lock:
             try:
                 return self._step.lower(
                     self._st, jnp.asarray(tokens), self.cache.k_pools,
-                    self.cache.v_pools, jnp.asarray(bt), jnp.asarray(cu),
+                    self.cache.v_pools, self.cache.k_scales,
+                    self.cache.v_scales, jnp.asarray(bt), jnp.asarray(cu),
                     jnp.asarray(ctx), jnp.asarray(sid), jnp.asarray(pos),
                     jnp.asarray(ssq), jnp.asarray(sbk),
-                    jnp.asarray(last_idx))
+                    jnp.asarray(last_idx), jnp.asarray(aid))
             finally:
                 self._clear_model_side_effects()
 
@@ -532,6 +684,9 @@ class ServingEngine:
         self._m_prefix_hits = m["prefix_hits"]
         self._m_prefix_evictions = m["prefix_evictions"]
         self._m_prefix_token_fraction = m["prefix_token_fraction"]
+        self._m_adapter_requests = m["adapter_requests"]
+        m["adapter_slots"].set(self.n_adapter_slots)
+        m["adapter_slots_loaded"].set(len(self._adapters))
         self.cache.gauge_in_use()
         self._register_memory_owners()
 
@@ -552,7 +707,10 @@ class ServingEngine:
             eng = wself()
             if eng is None:
                 return None
-            return (eng.cache.k_pools, eng.cache.v_pools)
+            # int8-KV engines: the scale pools are part of the cache's
+            # HBM bill (the ledger pins the doubled-max_batch headroom)
+            return (eng.cache.k_pools, eng.cache.v_pools,
+                    eng.cache.k_scales, eng.cache.v_scales)
 
         def _model_state():
             eng = wself()
@@ -618,22 +776,100 @@ class ServingEngine:
         except Exception:
             pass  # the memory instrument must never fail a step
 
+    # -- multi-tenant LoRA slots (ISSUE 20) --------------------------------
+    def load_adapter(self, slot: int, state: dict,
+                     name: Optional[str] = None):
+        """Install a trained adapter (``tuning.load_adapter_state``'s
+        ``{param name: array}``) into tenant ``slot`` (1..n_slots).
+        Pure ``.at[slot].set`` on the stacked state leaves — shapes and
+        dtypes unchanged, so the ONE compiled step is untouched (the
+        ``load_weights``-without-retrace seam, per slot). Refuses while
+        any in-flight request decodes against that slot."""
+        if not self.n_adapter_slots:
+            raise RuntimeError(
+                "engine has no adapter slots — build the model with "
+                "tuning.apply_lora(model, cfg, n_slots=N)")
+        if not 1 <= int(slot) <= self.n_adapter_slots:
+            raise ValueError(
+                f"adapter slot {slot} out of range 1.."
+                f"{self.n_adapter_slots}")
+        slot = int(slot)
+        with self._lock:
+            busy = [r.req_id for r in list(self.scheduler.slotted())
+                    + list(self.scheduler.waiting)
+                    if r.adapter_id == slot]
+            if busy:
+                raise RuntimeError(
+                    f"adapter slot {slot} has {len(busy)} request(s) in "
+                    f"flight; drain or abort them first")
+            unknown = [k for k in state if k not in self._st]
+            if unknown:
+                raise KeyError(
+                    f"adapter state names unknown to this model: "
+                    f"{sorted(unknown)[:3]}")
+            for k, v in state.items():
+                tgt = self._st[k]
+                arr = jnp.asarray(v)
+                if arr.shape != tgt.shape[1:]:
+                    raise ValueError(
+                        f"adapter leaf '{k}' has shape {arr.shape}, "
+                        f"slot expects {tuple(tgt.shape[1:])}")
+                self._st[k] = tgt.at[slot].set(arr.astype(tgt.dtype))
+            self._adapters[slot] = name or f"adapter-{slot}"
+            # new slot contents -> new prefix-cache namespace: blocks
+            # registered under the previous occupant can never match
+            self._adapter_gen[slot] = self._adapter_gen.get(slot, 0) + 1
+        m = serving_metrics()
+        m["adapter_loads"].inc()
+        m["adapter_slots_loaded"].set(len(self._adapters))
+
+    def unload_adapter(self, slot: int):
+        """Zero tenant ``slot``'s rows (delta back to exactly 0) and
+        free the slot. Same no-retrace contract as :meth:`load_adapter`."""
+        slot = int(slot)
+        with self._lock:
+            busy = [r.req_id for r in list(self.scheduler.slotted())
+                    + list(self.scheduler.waiting)
+                    if r.adapter_id == slot]
+            if busy:
+                raise RuntimeError(
+                    f"adapter slot {slot} has {len(busy)} request(s) in "
+                    f"flight; drain or abort them first")
+            for k, v in self._st.items():
+                if k.rsplit(".", 1)[-1].startswith("lora_"):
+                    self._st[k] = v.at[slot].set(0)
+            self._adapters.pop(slot, None)
+            self._adapter_gen[slot] = self._adapter_gen.get(slot, 0) + 1
+        serving_metrics()["adapter_slots_loaded"].set(len(self._adapters))
+
     # -- submission --------------------------------------------------------
     def submit(self, prompt_tokens: Sequence[int], max_new_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                eos_token_id: Optional[int] = None,
                on_token: Optional[Callable] = None,
-               trace_id: Optional[str] = None) -> RequestHandle:
+               trace_id: Optional[str] = None,
+               adapter_id: int = 0) -> RequestHandle:
         """Enqueue a request; returns immediately with a handle. Tokens
         stream through ``on_token(request, token_id)`` as they decode.
         ``trace_id`` carries a client-supplied W3C trace id (the server's
         ``traceparent`` parse); absent, the engine mints one — either
-        way every span/response for the request carries it."""
+        way every span/response for the request carries it.
+        ``adapter_id`` picks the tenant's LoRA slot (0 = base model)."""
         prompt_tokens = list(prompt_tokens)
         if not prompt_tokens:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        adapter_id = int(adapter_id)
+        if adapter_id:
+            if not 1 <= adapter_id <= self.n_adapter_slots:
+                raise ValueError(
+                    f"adapter_id {adapter_id} out of range (engine has "
+                    f"{self.n_adapter_slots} slots)")
+            if adapter_id not in self._adapters:
+                raise ValueError(
+                    f"adapter slot {adapter_id} is empty — load_adapter "
+                    f"first")
         total = len(prompt_tokens) + max_new_tokens
         if total > self.max_model_len:
             raise ValueError(
@@ -647,12 +883,26 @@ class ServingEngine:
                 f"{self.cache.allocator.capacity} (table width "
                 f"{self.cache.max_blocks_per_seq}) — raise max_blocks or "
                 "shorten the request")
+        # non-base tenants hash their KV blocks under an adapter-specific
+        # chain seed (slot + load generation): identical prompts under
+        # different adapters produce different KV, so they must never
+        # share prefix-cache entries. Slot 0 keeps the None (base) root —
+        # cross-replica sketches and the pre-adapter index stay valid.
+        seed = (chain_hash(None,
+                           [adapter_id, self._adapter_gen[adapter_id]])
+                if adapter_id else None)
         req = Request(prompt_tokens=prompt_tokens,
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
                       top_p=float(top_p), eos_token_id=eos_token_id,
                       on_token=on_token,
-                      trace_id=trace_id or self._new_trace_id())
+                      trace_id=trace_id or self._new_trace_id(),
+                      adapter_id=adapter_id,
+                      cache_seed=seed, committed_hash=seed)
+        if adapter_id:
+            self._m_adapter_requests.inc(
+                adapter=self._adapters.get(adapter_id,
+                                           str(adapter_id)))
         handle = RequestHandle(req)
         with self._cv:
             if self._shutdown:
@@ -743,6 +993,7 @@ class ServingEngine:
         sid = np.full((T,), S, np.int32)   # sentinel = padding
         pos = np.zeros((T,), np.int32)
         last_idx = np.zeros((S,), np.int32)
+        aid = np.zeros((T,), np.int32)     # padding -> slot 0 (base)
         kv_lens = []
         off = 0
         for i, (seq, n, is_prefill) in enumerate(entries):
@@ -757,6 +1008,7 @@ class ServingEngine:
             ctx[i] = c
             sid[off:off + n] = i
             pos[off:off + n] = c + np.arange(n)
+            aid[off:off + n] = seq.adapter_id
             cu[i + 1] = off + n
             last_idx[i] = off + n - 1
             kv_lens.append(c + n)
@@ -793,13 +1045,15 @@ class ServingEngine:
         try:
             out = step_fn(
                 self._st, jnp.asarray(tokens), self.cache.k_pools,
-                self.cache.v_pools, jnp.asarray(bt), jnp.asarray(cu),
+                self.cache.v_pools, self.cache.k_scales,
+                self.cache.v_scales, jnp.asarray(bt), jnp.asarray(cu),
                 jnp.asarray(ctx), jnp.asarray(sid), jnp.asarray(pos),
-                jnp.asarray(ssq), jnp.asarray(sbk), jnp.asarray(last_idx))
+                jnp.asarray(ssq), jnp.asarray(sbk), jnp.asarray(last_idx),
+                jnp.asarray(aid))
             if step_fn is self._step:
-                logits, kps, vps = out
+                logits, kps, vps, kss, vss = out
             else:
-                logits, kps, vps, taps_out = out
+                logits, kps, vps, kss, vss, taps_out = out
         except Exception as e:
             # RESOURCE_EXHAUSTED gets one postmortem (ledger owners +
             # the unified step's memory report) before re-raising into
@@ -808,7 +1062,7 @@ class ServingEngine:
             _obs_memory.handle_oom(e, source="serving_step",
                                    report_fn=self.memory_report)
             raise
-        self.cache.update_pools(kps, vps)
+        self.cache.update_pools(kps, vps, kss, vss)
         self._clear_model_side_effects()
         t1 = time.perf_counter_ns()
         compiled = self.step_traces - compiles0
@@ -1137,6 +1391,18 @@ class ServingEngine:
             "prefix_cache": None,
             "tensor_parallel": (int(self.mesh.shape[self._mp_axis])
                                 if self.mesh is not None else 1),
+            # quantization + multi-tenancy surface (ISSUE 20): what
+            # dtype the weights/KV actually serve in, and which tenant
+            # slots are occupied — /healthz and /statusz republish these
+            "weight_dtype": self._weight_dtype,
+            "quantize": self.quantize,
+            "kv_dtype": self.kv_dtype or str(self.cache.compute_dtype),
+            "adapters": {
+                "slots": self.n_adapter_slots,
+                "loaded": len(self._adapters),
+                "occupancy": {str(s): n for s, n in
+                              sorted(self._adapters.items())},
+            },
         }
         if pc is not None:
             s = pc.stats()

@@ -511,7 +511,8 @@ class FleetRouter:
                 temperature=kw.get("temperature", 0.0),
                 top_k=kw.get("top_k", 0), top_p=kw.get("top_p", 1.0),
                 eos_token_id=kw.get("eos_token_id"),
-                on_token=on_token, trace_id=trace_id)
+                on_token=on_token, trace_id=trace_id,
+                adapter_id=kw.get("adapter_id", 0))
         except RuntimeError:
             self._mark_dead(rep)
             raise
@@ -532,16 +533,21 @@ class FleetRouter:
                top_k: int = 0, top_p: float = 1.0,
                eos_token_id: Optional[int] = None,
                on_token: Optional[Callable] = None,
-               trace_id: Optional[str] = None) -> RouteHandle:
+               trace_id: Optional[str] = None,
+               adapter_id: int = 0) -> RouteHandle:
         """Place and start a request; returns a handle whose ``wait``
-        drives failover/handoff (engine-``submit``-compatible)."""
+        drives failover/handoff (engine-``submit``-compatible).
+        ``adapter_id`` rides to whichever replica the request lands on
+        (every replica of a multi-tenant fleet serves the same slot
+        layout — the warm-start seam replicates adapters like weights)."""
         from paddle_tpu.observability import requests as obs_requests
         prompt_tokens = [int(t) for t in prompt_tokens]
         if not prompt_tokens:
             raise ValueError("empty prompt")
         kw = {"max_new_tokens": int(max_new_tokens),
               "temperature": float(temperature), "top_k": int(top_k),
-              "top_p": float(top_p), "eos_token_id": eos_token_id}
+              "top_p": float(top_p), "eos_token_id": eos_token_id,
+              "adapter_id": int(adapter_id)}
         handle = RouteHandle(self, prompt_tokens, kw, on_token,
                              trace_id or obs_requests.new_trace_id())
         pre = None

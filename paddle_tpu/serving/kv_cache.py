@@ -281,17 +281,21 @@ class PrefixCache:
     def lookup(self, digest: bytes) -> Optional[int]:
         return self._index.get(digest)
 
-    def match(self, tokens: Sequence[int]) -> Tuple[List[int], List[bytes]]:
+    def match(self, tokens: Sequence[int],
+              seed: Optional[bytes] = None) -> Tuple[List[int], List[bytes]]:
         """Longest registered full-block prefix of ``tokens``: returns
         the CLAIMED physical blocks (one reference each, caller owns)
         and their digests. The caller applies the at-least-one-token
         prefill cap (scheduler admission) — this walk is pure content
-        matching at block granularity."""
+        matching at block granularity. ``seed`` roots the chain in a
+        namespace (the engine passes the LoRA adapter slot's digest so
+        KV computed under one adapter never matches another tenant's
+        identical prompt); ``None`` is the base-model namespace."""
         self.lookups += 1
         bs = self.block_size
         blocks: List[int] = []
         digests: List[bytes] = []
-        parent = None
+        parent = seed
         for i in range(len(tokens) // bs):
             d = chain_hash(parent, tokens[i * bs:(i + 1) * bs])
             b = self._index.get(d)
@@ -349,9 +353,12 @@ class PagedKVCache:
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
                  num_kv_heads: int, head_dim: int,
                  max_blocks_per_seq: Optional[int] = None,
-                 dtype=jnp.float32, prefix_cache: bool = False):
+                 dtype=jnp.float32, prefix_cache: bool = False,
+                 kv_dtype: Optional[str] = None):
         if block_size < 1:
             raise ValueError("block_size must be >= 1")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype={kv_dtype!r} (want None or 'int8')")
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
@@ -359,12 +366,28 @@ class PagedKVCache:
         self.allocator = BlockAllocator(num_blocks)
         self.prefix_cache = (PrefixCache(self.allocator, block_size)
                              if prefix_cache else None)
+        #: compute dtype of the attention math / block transfers; the
+        #: storage dtype below may be narrower
+        self.compute_dtype = jnp.dtype(dtype)
+        self.kv_dtype = kv_dtype
         # +1: physical block 0 is the null block and backs no sequence
         shape = (num_blocks + 1, block_size, num_kv_heads, head_dim)
-        self.k_pools = tuple(jnp.zeros(shape, dtype)
+        store = jnp.int8 if kv_dtype == "int8" else dtype
+        self.k_pools = tuple(jnp.zeros(shape, store)
                              for _ in range(num_layers))
-        self.v_pools = tuple(jnp.zeros(shape, dtype)
+        self.v_pools = tuple(jnp.zeros(shape, store)
                              for _ in range(num_layers))
+        if kv_dtype == "int8":
+            # per-token-slot, per-head dequant multipliers, paged like
+            # the pools themselves so block tables address both
+            sshape = (num_blocks + 1, block_size, num_kv_heads)
+            self.k_scales = tuple(jnp.zeros(sshape, jnp.float32)
+                                  for _ in range(num_layers))
+            self.v_scales = tuple(jnp.zeros(sshape, jnp.float32)
+                                  for _ in range(num_layers))
+        else:
+            self.k_scales = ()
+            self.v_scales = ()
         self._copy_fn = None  # lazily-jitted COW block copy
 
     @property
@@ -375,11 +398,15 @@ class PagedKVCache:
     def blocks_for(self, num_tokens: int) -> int:
         return -(-num_tokens // self.block_size)  # ceil div
 
-    def update_pools(self, k_pools, v_pools):
+    def update_pools(self, k_pools, v_pools, k_scales=None, v_scales=None):
         """Swap in the pools returned by a compiled step (functional
         threading: the old arrays are dropped, nothing recompiles)."""
         self.k_pools = tuple(k_pools)
         self.v_pools = tuple(v_pools)
+        if k_scales is not None:
+            self.k_scales = tuple(k_scales)
+        if v_scales is not None:
+            self.v_scales = tuple(v_scales)
 
     def shard_pools(self, mesh, axis: str):
         """Tensor-parallel serving: place every pool with the KV-head
@@ -391,6 +418,12 @@ class PagedKVCache:
         sh = NamedSharding(mesh, P(None, None, axis, None))
         self.k_pools = tuple(jax.device_put(p, sh) for p in self.k_pools)
         self.v_pools = tuple(jax.device_put(p, sh) for p in self.v_pools)
+        if self.k_scales:
+            ssh = NamedSharding(mesh, P(None, None, axis))
+            self.k_scales = tuple(jax.device_put(p, ssh)
+                                  for p in self.k_scales)
+            self.v_scales = tuple(jax.device_put(p, ssh)
+                                  for p in self.v_scales)
 
     def copy_block(self, src: int, dst: int):
         """Copy-on-write: duplicate physical block ``src`` into ``dst``
@@ -400,13 +433,17 @@ class PagedKVCache:
         import jax
 
         if self._copy_fn is None:
-            def _copy(kps, vps, s, d):
+            def _copy(kps, vps, kss, vss, s, d):
                 return (tuple(p.at[d].set(p[s]) for p in kps),
-                        tuple(p.at[d].set(p[s]) for p in vps))
-            donate = (0, 1) if jax.default_backend() == "tpu" else ()
+                        tuple(p.at[d].set(p[s]) for p in vps),
+                        tuple(p.at[d].set(p[s]) for p in kss),
+                        tuple(p.at[d].set(p[s]) for p in vss))
+            donate = (0, 1, 2, 3) if jax.default_backend() == "tpu" else ()
             self._copy_fn = jax.jit(_copy, donate_argnums=donate)
-        self.k_pools, self.v_pools = self._copy_fn(
-            self.k_pools, self.v_pools, jnp.int32(src), jnp.int32(dst))
+        (self.k_pools, self.v_pools, self.k_scales,
+         self.v_scales) = self._copy_fn(
+            self.k_pools, self.v_pools, self.k_scales, self.v_scales,
+            jnp.int32(src), jnp.int32(dst))
 
     # -- cross-replica block transfer (fleet disaggregation) ---------------
     def export_block(self, block_id: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -417,6 +454,14 @@ class PagedKVCache:
         fleet handoff claims one via ``reuse_cached`` before calling)."""
         k = np.stack([np.asarray(p[block_id]) for p in self.k_pools])
         v = np.stack([np.asarray(p[block_id]) for p in self.v_pools])
+        if self.kv_dtype == "int8":
+            # wire format stays the compute dtype so handoffs work
+            # between quantized and unquantized replicas
+            ks = np.stack([np.asarray(p[block_id]) for p in self.k_scales])
+            vs = np.stack([np.asarray(p[block_id]) for p in self.v_scales])
+            cd = self.compute_dtype
+            k = (k.astype(np.float32) * ks[..., None]).astype(cd)
+            v = (v.astype(np.float32) * vs[..., None]).astype(cd)
         return k, v
 
     def import_block(self, block_id: int, k: np.ndarray, v: np.ndarray):
@@ -429,16 +474,34 @@ class PagedKVCache:
         import jax
 
         if getattr(self, "_import_fn", None) is None:
-            def _imp(kps, vps, kr, vr, d):
-                return (tuple(p.at[d].set(kr[i])
-                              for i, p in enumerate(kps)),
-                        tuple(p.at[d].set(vr[i])
-                              for i, p in enumerate(vps)))
+            if self.kv_dtype == "int8":
+                from paddle_tpu.ops.paged_attention import \
+                    quantize_kv_slots as _quantize_kv_rows
+
+                def _imp(kps, vps, kss, vss, kr, vr, d):
+                    kq, ks = _quantize_kv_rows(kr)
+                    vq, vs = _quantize_kv_rows(vr)
+                    return (tuple(p.at[d].set(kq[i])
+                                  for i, p in enumerate(kps)),
+                            tuple(p.at[d].set(vq[i])
+                                  for i, p in enumerate(vps)),
+                            tuple(p.at[d].set(ks[i])
+                                  for i, p in enumerate(kss)),
+                            tuple(p.at[d].set(vs[i])
+                                  for i, p in enumerate(vss)))
+            else:
+                def _imp(kps, vps, kss, vss, kr, vr, d):
+                    return (tuple(p.at[d].set(kr[i])
+                                  for i, p in enumerate(kps)),
+                            tuple(p.at[d].set(vr[i])
+                                  for i, p in enumerate(vps)),
+                            kss, vss)
             self._import_fn = jax.jit(_imp)
-        dt = self.k_pools[0].dtype
-        self.k_pools, self.v_pools = self._import_fn(
-            self.k_pools, self.v_pools, jnp.asarray(k, dt),
-            jnp.asarray(v, dt), jnp.int32(block_id))
+        dt = self.compute_dtype
+        (self.k_pools, self.v_pools, self.k_scales,
+         self.v_scales) = self._import_fn(
+            self.k_pools, self.v_pools, self.k_scales, self.v_scales,
+            jnp.asarray(k, dt), jnp.asarray(v, dt), jnp.int32(block_id))
 
     def pad_block_table(self, block_ids: Sequence[int]) -> np.ndarray:
         """[max_blocks_per_seq] int32 row, null-padded."""

@@ -88,6 +88,9 @@ class Request:
     #: trace span of this request so ``trace merge --requests`` can
     #: stitch the cross-process chain
     trace_id: Optional[str] = None
+    #: LoRA adapter slot applied to this request's rows (ISSUE 20);
+    #: 0 = the bare base model
+    adapter_id: int = 0
 
     # -- runtime state (engine/scheduler managed) --------------------------
     state: RequestState = RequestState.WAITING
@@ -117,6 +120,10 @@ class Request:
     #: digest of the last one (the next block's hash parent)
     committed_blocks: int = 0
     committed_hash: Optional[bytes] = None
+    #: prefix-cache chain root (ISSUE 20): non-base adapters hash their
+    #: blocks under an adapter-specific seed so one tenant's KV never
+    #: answers another tenant's identical prompt; ``None`` = base model
+    cache_seed: Optional[bytes] = None
     #: copy-on-write: claimed source block + the logical index of the
     #: private destination block the engine copies it into pre-step
     cow_src: Optional[int] = None
@@ -257,7 +264,7 @@ class Scheduler:
         tokens = seq.pending_tokens
         if len(tokens) <= self.cache.block_size:
             return  # no full block can match under the one-token cap
-        blocks, digests = pc.match(tokens)
+        blocks, digests = pc.match(tokens, seed=seq.cache_seed)
         if not blocks:
             return
         matched = len(blocks) * self.cache.block_size
@@ -274,7 +281,8 @@ class Scheduler:
         seq.cached_prompt_tokens = matched
         seq.cached_tokens_total += matched
         seq.committed_blocks = len(blocks)
-        seq.committed_hash = digests[len(blocks) - 1] if blocks else None
+        seq.committed_hash = (digests[len(blocks) - 1] if blocks
+                              else seq.cache_seed)
         pc.hit_tokens += matched
 
     def _release_cow(self, seq: Request):
@@ -390,7 +398,7 @@ class Scheduler:
         seq.num_cached = 0
         seq.cached_prompt_tokens = 0
         seq.committed_blocks = 0
-        seq.committed_hash = None
+        seq.committed_hash = seq.cache_seed
         seq.state = RequestState.WAITING
         seq.preemptions += 1
         self.num_preemptions += 1

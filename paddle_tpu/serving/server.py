@@ -9,6 +9,7 @@ Stdlib-only (``http.server`` on daemon threads, mirroring
        "max_new_tokens": 32,             # optional sampling params
        "temperature": 0.0, "top_k": 0, "top_p": 1.0,
        "eos_token_id": null,
+       "adapter_id": 0,                  # LoRA tenant slot (0 = base)
        "stream": false}
 
   Non-streaming responses return one JSON object with ``token_ids``,
@@ -227,7 +228,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 top_p=float(body.get("top_p", 1.0)),
                 eos_token_id=body.get("eos_token_id"),
                 on_token=on_token if stream else None,
-                trace_id=trace_id)
+                trace_id=trace_id,
+                adapter_id=int(body.get("adapter_id", 0)))
         except (ValueError, TypeError, RuntimeError) as e:
             # TypeError: well-formed JSON, wrong field types
             # (e.g. "max_new_tokens": null) — still a 400

@@ -1,0 +1,11 @@
+"""Parameter-efficient tuning (ISSUE 20): LoRA adapters over frozen
+base models — trainable through the existing ``Model.fit``/TrainStep
+path, checkpointed as a tiny separate state, and served multi-tenant
+from ONE engine via stacked adapter slots (see ``tuning/lora.py``)."""
+from .lora import (  # noqa: F401
+    LoRAConfig, apply_lora, adapter_ids, lora_state_dict,
+    save_adapter, load_adapter_state, lora_param_bytes,
+)
+
+__all__ = ["LoRAConfig", "apply_lora", "adapter_ids", "lora_state_dict",
+           "save_adapter", "load_adapter_state", "lora_param_bytes"]

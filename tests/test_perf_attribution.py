@@ -244,7 +244,8 @@ class TestBenchReportGate:
 #: family-name prefixes owned by this framework's telemetry
 _FAMILY_PREFIXES = ("comm_", "train_", "serving_", "ckpt_",
                     "resilience_", "data_", "loader_", "attribution_",
-                    "hbm_", "fleet_", "goodput_", "job_", "numerics_")
+                    "hbm_", "fleet_", "goodput_", "job_", "numerics_",
+                    "quantization_")
 
 #: backticked doc tokens that look like families but are not registry
 #: metrics: `comm_bytes` is the chrome-trace counter-track name,
@@ -310,7 +311,17 @@ _NON_FAMILY_DOC_TOKENS = {"comm_bytes", "comm_scope", "comm_event",
                           # bench.py --serve ledger-cost headline
                           # (ISSUE 16) — a report-gate stdout line, not
                           # a registry family
-                          "serving_request_ledger_overhead_frac"}
+                          "serving_request_ledger_overhead_frac",
+                          # bench.py --serve quantization/multi-tenant
+                          # headlines (ISSUE 20, docs/QUANTIZATION.md) —
+                          # report-gate stdout lines, not registry
+                          # families
+                          "serving_int8_tokens_per_sec",
+                          "serving_kv_quant_max_batch",
+                          "serving_adapters_served",
+                          # commplan geometry label (ISSUE 20), not a
+                          # metric family
+                          "serving_mp2_int8"}
 
 
 def _documented_families():
@@ -366,6 +377,7 @@ def _registered_families():
     from paddle_tpu.resilience.counters import (
         nonfinite_counter, preemption_counter, rollback_counter,
         watchdog_metrics)
+    from paddle_tpu.quantization.weight_only import quantization_metrics
     from paddle_tpu.serving.engine import serving_metrics
     from paddle_tpu.serving.fleet.router import router_metrics
 
@@ -380,6 +392,7 @@ def _registered_families():
     numerics_metrics()
     serving_metrics()
     router_metrics()
+    quantization_metrics()
     request_metrics()
     slo_metrics()
     nonfinite_counter(), rollback_counter(), preemption_counter()

@@ -1,0 +1,227 @@
+"""Quantized serving backend (ISSUE 20): weight-only PTQ + int8 KV.
+
+Coverage contract: ``quantize_state`` leaf selection + roundtrip error
+bounds + calibration-gated skipping, the int8-weight engine matching
+the full-precision greedy oracle (and bounded logit MSE through the
+dequantized weights), the int8 paged-KV engine matching the same
+oracle, the ``load_weights`` dtype guard (cast loudly / refuse loudly,
+naming the leaf), and the memory-ledger-pinned claim that int8 KV
+serves 2x ``max_batch`` inside the full-precision engine's pool bytes
+— every engine here compiling its unified step exactly once.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.quantization.weight_only import (
+    QuantizedLeaf, quantize_state, quantized_bytes, sensitive_params)
+from paddle_tpu.serving import ServingEngine
+
+
+def _tiny(seed=0):
+    pt.seed(seed)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=True))
+    m.eval()
+    return m
+
+
+def _state_of(model):
+    from paddle_tpu.jit.functional import functional_state
+    train, frozen, buffers = functional_state(model)
+    return {**train, **frozen, **buffers}
+
+
+def _eager_continuation(model, prompt, max_new_tokens):
+    out = model.generate(pt.to_tensor(np.asarray(prompt)[None, :]),
+                         max_new_tokens=max_new_tokens,
+                         temperature=0.0).numpy()[0]
+    return [int(t) for t in out[len(prompt):]]
+
+
+# ---------------- quantize_state unit ----------------------------------------
+
+def test_quantize_state_targets_and_roundtrip():
+    model = _tiny(0)
+    state = _state_of(model)
+    qstate = quantize_state(state, "int8_wo")
+    quantized = {k for k, v in qstate.items()
+                 if isinstance(v, QuantizedLeaf)}
+    # every projection quantized, embeddings/norms untouched
+    assert any(k.endswith("q_proj.weight") for k in quantized)
+    assert any(k.endswith("down_proj.weight") for k in quantized)
+    assert not any("embed" in k or "norm" in k for k in quantized)
+    assert set(qstate) == set(state)  # keys unchanged
+    for k in quantized:
+        leaf, orig = qstate[k], np.asarray(state[k])
+        # logical view: shape/dtype of the tensor it replaced
+        assert tuple(leaf.shape) == tuple(orig.shape)
+        assert str(leaf.dtype) == str(orig.dtype)
+        assert str(leaf.storage_dtype) == "int8"
+        err = np.abs(np.asarray(leaf.dequantize()) - orig)
+        scale = np.abs(orig).max(axis=0)  # per-channel grid step bound
+        assert float((err - scale / 127.0 * 0.51).max()) <= 1e-6, k
+    assert quantized_bytes(qstate) > 0
+
+
+def test_calibration_gate_skips_outlier_layers():
+    model = _tiny(0)
+    state = _state_of(model)
+    # layer-0 attention tap screams outliers; layer-1 looks healthy
+    cal = {"version": 1, "taps": {
+        "layers.0.attn": {"absmax": 1000.0, "p99": 1.0},
+        "layers.1.attn": {"absmax": 2.0, "p99": 1.0},
+    }}
+    names = [k for k in state if k.endswith("q_proj.weight")]
+    skip = sensitive_params(names, cal)
+    assert any("layers.0." in k for k in skip)
+    assert not any("layers.1." in k for k in skip)
+    qstate = quantize_state(state, "int8_wo", calibration=cal)
+    for k in names:
+        is_q = isinstance(qstate[k], QuantizedLeaf)
+        assert is_q != ("layers.0." in k), k
+
+
+# ---------------- int8 weights vs the full-precision oracle ------------------
+
+def test_int8_weight_engine_greedy_parity_and_logit_mse():
+    model = _tiny(1)
+    prompt = list(np.random.RandomState(0).randint(1, 128, 12))
+    oracle = _eager_continuation(model, prompt, 8)
+
+    engine = ServingEngine(model, max_batch=4, max_blocks=32,
+                           block_size=4, prefill_chunk=4,
+                           quantize="int8_wo")
+    engine.start()
+    assert engine.stats()["weight_dtype"] == "int8"
+    got = engine.submit(prompt, max_new_tokens=8).result(
+        timeout=60)["token_ids"]
+    assert got == oracle
+    assert engine.step_traces == 1
+    engine.shutdown()
+
+    # logit MSE through the exact dequantized weights the step consumes
+    state = _state_of(model)
+    deq = {k: (v.dequantize() if isinstance(v, QuantizedLeaf) else v)
+           for k, v in quantize_state(state, "int8_wo").items()}
+    x = pt.to_tensor(np.asarray(prompt)[None, :])
+    ref = model(x).numpy()
+    model.set_state_dict({k: pt.to_tensor(np.asarray(v))
+                          for k, v in deq.items()})
+    quant_logits = model(x).numpy()
+    mse = float(np.mean((quant_logits - ref) ** 2))
+    assert mse < 1e-2, mse
+
+
+# ---------------- int8 paged KV vs the same oracle ---------------------------
+
+def test_int8_kv_engine_greedy_parity():
+    model = _tiny(2)
+    rng = np.random.RandomState(1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # rpa->gather fallback warning
+        engine = ServingEngine(model, max_batch=4, max_blocks=32,
+                               block_size=4, prefill_chunk=4,
+                               kv_dtype="int8")
+    engine.start()
+    assert engine.stats()["kv_dtype"] == "int8"
+    for seed in range(2):
+        prompt = list(rng.randint(1, 128, 10 + 3 * seed))
+        oracle = _eager_continuation(model, prompt, 6)
+        got = engine.submit(prompt, max_new_tokens=6).result(
+            timeout=60)["token_ids"]
+        assert got == oracle, f"prompt {seed}"
+    assert engine.step_traces == 1
+    engine.shutdown()
+
+
+# ---------------- load_weights dtype guard (satellite 2) ---------------------
+
+def test_load_weights_dtype_guard(tmp_path):
+    model = _tiny(3)
+    engine = ServingEngine(model, max_batch=2, max_blocks=16,
+                           block_size=4, prefill_chunk=4)
+    engine.start()
+    sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
+    victim = next(k for k in sd if k.endswith("q_proj.weight"))
+
+    # floating -> floating mismatch: cast loudly, engine keeps serving
+    cast_sd = dict(sd, **{victim: sd[victim].astype(np.float64)})
+    p64 = str(tmp_path / "cast.pdparams")
+    pt.save(cast_sd, p64)
+    with pytest.warns(RuntimeWarning, match=victim):
+        engine.load_weights(p64)
+    prompt = [3, 5, 7, 11]
+    got = engine.submit(prompt, max_new_tokens=4).result(
+        timeout=60)["token_ids"]
+    assert got == _eager_continuation(model, prompt, 4)
+    assert engine.step_traces == 1  # the swap never retraced
+
+    # anything non-floating refuses with the leaf named
+    bad_sd = dict(sd, **{victim: np.zeros(sd[victim].shape, np.int32)})
+    pbad = str(tmp_path / "refuse.pdparams")
+    pt.save(bad_sd, pbad)
+    with pytest.raises(ValueError, match=victim):
+        engine.load_weights(pbad)
+    engine.shutdown()
+
+
+def test_load_weights_dtype_guard_quantized_logical(tmp_path):
+    """The guard reads a QuantizedLeaf's LOGICAL dtype: a matching-dtype
+    checkpoint loads into an int8 engine (and is re-quantized), while
+    a float64 poke is cast loudly with the leaf named."""
+    model = _tiny(4)
+    engine = ServingEngine(model, max_batch=2, max_blocks=16,
+                           block_size=4, prefill_chunk=4,
+                           quantize="int8_wo")
+    sd = {k: np.asarray(v.numpy()) for k, v in model.state_dict().items()}
+    ok = str(tmp_path / "ok.pdparams")
+    pt.save(sd, ok)
+    engine.load_weights(ok)  # logical f32 == checkpoint f32: no error
+    assert any(isinstance(v, QuantizedLeaf)
+               for v in engine._st.values())  # re-quantized after swap
+    victim = next(k for k in sd if k.endswith("up_proj.weight"))
+    bad = dict(sd, **{victim: sd[victim].astype(np.float64)})
+    pbad = str(tmp_path / "bad.pdparams")
+    pt.save(bad, pbad)
+    with pytest.warns(RuntimeWarning, match=victim):
+        engine.load_weights(pbad)
+    assert engine.step_traces == 0  # never even compiled: still no trace
+    engine.shutdown()
+
+
+# ---------------- int8 KV doubles max_batch on the same pool bytes -----------
+
+def test_int8_kv_doubles_max_batch_within_pool_bytes():
+    from paddle_tpu.observability import memory as obs_memory
+
+    model = _tiny(5)
+    base_kw = dict(max_batch=2, max_blocks=16, block_size=4,
+                   prefill_chunk=4)
+    base = ServingEngine(model, **base_kw)
+    base_bytes = obs_memory.get_ledger().snapshot()["owners"]["kv_cache"]
+    assert base_bytes > 0
+    del base
+
+    dbl_kw = dict(base_kw, max_batch=base_kw["max_batch"] * 2,
+                  max_blocks=base_kw["max_blocks"] * 2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        dbl = ServingEngine(model, kv_dtype="int8", **dbl_kw)
+    dbl.start()
+    dbl_bytes = obs_memory.get_ledger().snapshot()["owners"]["kv_cache"]
+    # 2x the batch and 2x the blocks, yet inside the old pool budget
+    assert dbl_bytes <= base_bytes, (dbl_bytes, base_bytes)
+    # and it actually serves that doubled batch
+    rng = np.random.RandomState(2)
+    hs = [dbl.submit(list(rng.randint(1, 128, 6)), max_new_tokens=3)
+          for _ in range(dbl_kw["max_batch"])]
+    dbl.drain(timeout=60)
+    assert all(len(h.result(timeout=5)["token_ids"]) == 3 for h in hs)
+    assert dbl.step_traces == 1
+    dbl.shutdown()
